@@ -1,0 +1,82 @@
+"""Arithmetic / compression configuration.
+
+Maps an (uncompressed dtype, compressed dtype) operand pair to the kernel
+lanes that implement elementwise reduction and cast-compression. In the
+reference these lanes are AXIS TDEST values steering data through the
+reduce_ops and hp_compression HLS plugins
+(reference: driver/xrt/include/accl/arithconfig.hpp:30-119,
+kernels/plugins/reduce_ops/reduce_ops.cpp:75-107); here they are indices
+into the Pallas kernel registry in accl_tpu.ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .constants import DataType, dtype_nbytes
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithConfig:
+    """One row of the arithmetic configuration table.
+
+    Same field semantics as the reference ArithConfig
+    (arithconfig.hpp:33-41): element sizes for the (un)compressed domains,
+    log2 of the element-count ratio, compressor/decompressor kernel lanes,
+    whether reduction runs in the compressed domain, and the per-function
+    arithmetic kernel lanes (indexed by ReduceFunction).
+    """
+
+    uncompressed_elem_bytes: int
+    compressed_elem_bytes: int
+    elem_ratio_log: int
+    compressor_lane: int
+    decompressor_lane: int
+    arith_is_compressed: bool
+    arith_lanes: tuple[int, ...]
+
+    def addr(self) -> int:
+        """Exchange-memory offset where this config was written (set by the
+        driver at initialize time, arithconfig.hpp:73-79)."""
+        if not hasattr(self, "_exchmem_addr"):
+            raise RuntimeError("Arithmetic config address requested before set")
+        return self._exchmem_addr  # type: ignore[attr-defined]
+
+    def set_exchmem(self, address: int) -> None:
+        object.__setattr__(self, "_exchmem_addr", address)
+
+
+# Kernel lane numbering (see accl_tpu/ops/reduce_ops.py):
+#   arith lanes 0-4: SUM for fp32, fp64, i32, i64, fp16  — reference
+#     reduce_ops.cpp TDEST 0-4
+#   arith lanes 5-9: MAX for the same dtypes              — TDEST 5-9
+#   arith lanes 10/11: SUM/MAX bf16 (TPU-native extension)
+#   compressor lanes: 0 = fp32->fp16, 1 = fp16->fp32 (hp_compression analog),
+#     2 = fp32->bf16, 3 = bf16->fp32 (TPU-native extension)
+#
+# Default table mirrors DEFAULT_ARITH_CONFIG (arithconfig.hpp:102-119) and
+# adds bf16 rows.
+DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
+    (DataType.float16, DataType.float16): ArithConfig(2, 2, 0, 0, 0, False, (4, 9)),
+    (DataType.float32, DataType.float16): ArithConfig(4, 2, 0, 0, 1, True, (4, 9)),
+    (DataType.float32, DataType.float32): ArithConfig(4, 4, 0, 0, 0, False, (0, 5)),
+    (DataType.float64, DataType.float64): ArithConfig(8, 8, 0, 0, 0, False, (1, 6)),
+    (DataType.int32, DataType.int32): ArithConfig(4, 4, 0, 0, 0, False, (2, 7)),
+    (DataType.int64, DataType.int64): ArithConfig(8, 8, 0, 0, 0, False, (3, 8)),
+    # TPU-native: bf16 wire compression and bf16-domain arithmetic.
+    (DataType.bfloat16, DataType.bfloat16): ArithConfig(2, 2, 0, 2, 2, False, (10, 11)),
+    (DataType.float32, DataType.bfloat16): ArithConfig(4, 2, 0, 2, 3, True, (10, 11)),
+}
+
+
+def validate_arith_config(table: dict[tuple[DataType, DataType], ArithConfig]):
+    """Sanity-check a user-provided table the way initialize() does before
+    writing configs to exchange memory."""
+    for (unc, cmp_), cfg in table.items():
+        if cfg.uncompressed_elem_bytes != dtype_nbytes(unc):
+            raise ValueError(f"{unc}: uncompressed_elem_bytes mismatch")
+        if cfg.compressed_elem_bytes != dtype_nbytes(cmp_):
+            raise ValueError(f"{cmp_}: compressed_elem_bytes mismatch")
+        if len(cfg.arith_lanes) < 2:
+            raise ValueError("arith_lanes must cover SUM and MAX")
+    return table

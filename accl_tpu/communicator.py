@@ -1,0 +1,144 @@
+"""Communicator: the rank table of a collective group.
+
+Reference semantics: driver/xrt/include/accl/communicator.hpp:34-95 and the
+firmware-side communicator struct (ccl_offload_control.h:297-323). A
+communicator holds world size, the local rank, and one entry per rank with
+its endpoint plus per-peer inbound/outbound sequence numbers that enforce
+message ordering (dma_mover.cpp:581-657).
+
+TPU mapping: a rank is a device position on a jax mesh axis (ICI transport)
+or a host endpoint (ip, port) for the native emulator / DCN transport. Both
+carry session ids and segment-size limits so the same sequencer logic drives
+either transport.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .constants import MAX_SEG_SIZE
+
+
+@dataclasses.dataclass
+class Rank:
+    """One communicator entry (reference rank_t, accl.hpp + communicator.hpp:34).
+
+    ip/port address the native emulator / DCN transport; device_index is the
+    position on the mesh collective axis for the ICI transport. Sequence
+    numbers mirror the firmware's per-peer ordering state
+    (ccl_offload_control.h:297-310).
+    """
+
+    ip: str = ""
+    port: int = 0
+    session_id: int = 0xFFFFFFFF
+    max_segment_size: int = MAX_SEG_SIZE
+    device_index: int = -1
+    inbound_seq: int = 0
+    outbound_seq: int = 0
+
+
+class Communicator:
+    """A collective group with a dense rank table.
+
+    Mirrors the reference Communicator (communicator.cpp): construction
+    validates the local rank, and `exchmem_words`/`from_exchmem_words`
+    serialize the table to/from an exchange-memory image in the firmware
+    layout so the native runtime and tests can round-trip it.
+    """
+
+    def __init__(self, ranks: list[Rank], local_rank: int, exchmem_addr: int = 0):
+        if not 0 <= local_rank < len(ranks):
+            raise ValueError(f"local rank {local_rank} outside world of {len(ranks)}")
+        self.ranks = ranks
+        self.local_rank = local_rank
+        self.exchmem_addr = exchmem_addr
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def prev_rank(self, distance: int = 1) -> int:
+        return (self.local_rank - distance) % self.size
+
+    def next_rank(self, distance: int = 1) -> int:
+        return (self.local_rank + distance) % self.size
+
+    # -- exchange-memory serialization (firmware layout extended: one word
+    #    each of size and local_rank, then per rank: ip, port, inbound_seq,
+    #    outbound_seq, session, max_seg_size (ccl_offload_control.h:297-323)
+    #    plus a device_index word for the ICI transport)
+
+    WORDS_PER_RANK = 7
+
+    def exchmem_words(self) -> list[int]:
+        words = [self.size, self.local_rank]
+        for r in self.ranks:
+            ip_word = _pack_ip(r.ip)
+            words += [
+                ip_word,
+                r.port,
+                r.inbound_seq,
+                r.outbound_seq,
+                r.session_id & 0xFFFFFFFF,
+                r.max_segment_size,
+                r.device_index & 0xFFFFFFFF,
+            ]
+        return words
+
+    @classmethod
+    def from_exchmem_words(cls, words: list[int], exchmem_addr: int = 0):
+        size, local_rank = words[0], words[1]
+        w = cls.WORDS_PER_RANK
+        ranks = []
+        for i in range(size):
+            ip_w, port, inseq, outseq, sess, seg, dev = words[2 + w * i : 2 + w * (i + 1)]
+            if dev == 0xFFFFFFFF:  # sign-restore the -1 "no device" marker
+                dev = -1
+            ranks.append(
+                Rank(
+                    ip=_unpack_ip(ip_w),
+                    port=port,
+                    session_id=sess,
+                    max_segment_size=seg,
+                    inbound_seq=inseq,
+                    outbound_seq=outseq,
+                    device_index=dev,
+                )
+            )
+        return cls(ranks, local_rank, exchmem_addr)
+
+    def dump(self) -> str:
+        """Human-readable table (reference Communicator::dump)."""
+        lines = [f"Communicator: size={self.size} local_rank={self.local_rank}"]
+        for i, r in enumerate(self.ranks):
+            lines.append(
+                f"  rank {i}: ip={r.ip or '-'} port={r.port} dev={r.device_index} "
+                f"session={r.session_id:#x} seg={r.max_segment_size} "
+                f"seq(in={r.inbound_seq},out={r.outbound_seq})"
+            )
+        return "\n".join(lines)
+
+
+def _pack_ip(ip: str) -> int:
+    if not ip:
+        return 0
+    parts = [int(p) for p in ip.split(".")]
+    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+
+
+def _unpack_ip(word: int) -> str:
+    if word == 0:
+        return ""
+    return f"{(word >> 24) & 0xFF}.{(word >> 16) & 0xFF}.{(word >> 8) & 0xFF}.{word & 0xFF}"
+
+
+def generate_ranks(
+    count: int, start_port: int = 5500, base_ip: str = "127.0.0.1"
+) -> list[Rank]:
+    """Local-host rank table generator (accl_network_utils analog,
+    driver/utils/accl_network_utils/accl_network_utils.cpp generate_ranks)."""
+    return [
+        Rank(ip=base_ip, port=start_port + i, session_id=i, device_index=i)
+        for i in range(count)
+    ]

@@ -1,0 +1,305 @@
+"""Core enumerations and constants of the accl-tpu framework.
+
+Behavioral parity with the reference host driver's constant set
+(reference: driver/xrt/include/accl/constants.hpp:179-411) with TPU-native
+extensions (bfloat16 as a first-class dtype, transport kinds for ICI/DCN
+instead of TCP/UDP/RDMA protocol-offload engines).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Call scenarios (reference: constants.hpp:190-216 `enum class operation`)
+# ---------------------------------------------------------------------------
+
+
+class Operation(enum.IntEnum):
+    """The scenario field of a call descriptor."""
+
+    config = 0
+    copy = 1
+    combine = 2
+    send = 3
+    recv = 4
+    bcast = 5
+    scatter = 6
+    gather = 7
+    reduce = 8
+    allgather = 9
+    allreduce = 10
+    reduce_scatter = 11
+    barrier = 12
+    alltoall = 13
+    nop = 255
+
+
+class CfgFunc(enum.IntEnum):
+    """Housekeeping sub-functions of Operation.config.
+
+    Reference: constants.hpp:178-186 `enum class cfgFunc`.
+    """
+
+    reset_periph = 0
+    enable_pkt = 1
+    set_timeout = 2
+    set_max_eager_msg_size = 3
+    set_max_rendezvous_msg_size = 4
+
+
+class ReduceFunction(enum.IntEnum):
+    """Reference: constants.hpp:218-226 `enum class reduceFunction`."""
+
+    SUM = 0
+    MAX = 1
+
+
+class OperationStatus(enum.IntEnum):
+    """Status of an in-flight request (constants.hpp:228-236)."""
+
+    QUEUED = 0
+    EXECUTING = 1
+    COMPLETED = 2
+
+
+# ---------------------------------------------------------------------------
+# Data types (reference: constants.hpp:252-273). bfloat16 is a TPU-native
+# addition: it slots into the compression lanes exactly like float16.
+# ---------------------------------------------------------------------------
+
+
+class DataType(enum.IntEnum):
+    none = 0
+    int8 = 1
+    float16 = 2
+    float32 = 3
+    float64 = 4
+    int32 = 5
+    int64 = 6
+    bfloat16 = 7  # TPU-native extension
+
+
+DATATYPE_BITS: dict[DataType, int] = {
+    DataType.none: 0,
+    DataType.int8: 8,
+    DataType.float16: 16,
+    DataType.float32: 32,
+    DataType.float64: 64,
+    DataType.int32: 32,
+    DataType.int64: 64,
+    DataType.bfloat16: 16,
+}
+
+
+def dtype_nbytes(dt: DataType) -> int:
+    return DATATYPE_BITS[dt] // 8
+
+
+def to_numpy_dtype(dt: DataType) -> np.dtype:
+    import ml_dtypes
+
+    table = {
+        DataType.int8: np.dtype(np.int8),
+        DataType.float16: np.dtype(np.float16),
+        DataType.float32: np.dtype(np.float32),
+        DataType.float64: np.dtype(np.float64),
+        DataType.int32: np.dtype(np.int32),
+        DataType.int64: np.dtype(np.int64),
+        DataType.bfloat16: np.dtype(ml_dtypes.bfloat16),
+    }
+    return table[dt]
+
+
+def from_numpy_dtype(dt) -> DataType:
+    import ml_dtypes
+
+    dt = np.dtype(dt)
+    if dt == np.dtype(ml_dtypes.bfloat16):
+        return DataType.bfloat16
+    table = {
+        np.dtype(np.int8): DataType.int8,
+        np.dtype(np.float16): DataType.float16,
+        np.dtype(np.float32): DataType.float32,
+        np.dtype(np.float64): DataType.float64,
+        np.dtype(np.int32): DataType.int32,
+        np.dtype(np.int64): DataType.int64,
+    }
+    return table[dt]
+
+
+# ---------------------------------------------------------------------------
+# Flag words carried in the call descriptor
+# ---------------------------------------------------------------------------
+
+
+class StreamFlags(enum.IntFlag):
+    """Streamed-operand flags (constants.hpp:275-283)."""
+
+    NO_STREAM = 0
+    OP0_STREAM = 1
+    RES_STREAM = 2
+
+
+class HostFlags(enum.IntFlag):
+    """Host-resident-operand flags (constants.hpp:295-305).
+
+    On TPU "host" buffers map to pinned host memory staged over PCIe rather
+    than HBM; the flag propagation rules through collectives are identical.
+    """
+
+    NO_HOST = 0
+    OP0_HOST = 1
+    OP1_HOST = 2
+    RES_HOST = 4
+
+
+class CompressionFlags(enum.IntFlag):
+    """Compression flags (constants.hpp:317-327).
+
+    ETH_COMPRESSED requests wire (inter-chip) compression: payloads are cast
+    to the compressed dtype of the active arithmetic configuration before
+    crossing ICI/DCN and cast back on arrival.
+    """
+
+    NO_COMPRESSION = 0
+    OP0_COMPRESSED = 1
+    OP1_COMPRESSED = 2
+    RES_COMPRESSED = 4
+    ETH_COMPRESSED = 8
+
+
+class Transport(enum.IntEnum):
+    """Analog of networkProtocol (constants.hpp:329-339).
+
+    The reference selects a TCP/UDP/RDMA protocol-offload engine at build
+    time; we select how collective steps move bytes between ranks:
+      ICI  - XLA collectives / Pallas remote DMA across an intra-slice mesh
+      DCN  - inter-slice transfers through jax distributed + host network
+      EMU  - the native CPU emulator's socket transport (test/model analog)
+    """
+
+    ICI = 0
+    DCN = 1
+    EMU = 2
+
+
+# ---------------------------------------------------------------------------
+# Error codes (reference: constants.hpp:341-376). The sticky-bit contract is
+# preserved: any engine can OR bits into the call's return code and the host
+# driver raises with every set bit decoded.
+# ---------------------------------------------------------------------------
+
+
+class ErrorCode(enum.IntFlag):
+    COLLECTIVE_OP_SUCCESS = 0
+    DMA_MISMATCH_ERROR = 1 << 0
+    DMA_INTERNAL_ERROR = 1 << 1
+    DMA_DECODE_ERROR = 1 << 2
+    DMA_SLAVE_ERROR = 1 << 3
+    DMA_NOT_OKAY_ERROR = 1 << 4
+    DMA_NOT_END_OF_PACKET_ERROR = 1 << 5
+    DMA_NOT_EXPECTED_BTT_ERROR = 1 << 6
+    DMA_TIMEOUT_ERROR = 1 << 7
+    CONFIG_SWITCH_ERROR = 1 << 8
+    DEQUEUE_BUFFER_TIMEOUT_ERROR = 1 << 9
+    DEQUEUE_BUFFER_SPARE_BUFFER_STATUS_ERROR = 1 << 10
+    RECEIVE_TIMEOUT_ERROR = 1 << 11
+    DEQUEUE_BUFFER_SPARE_BUFFER_DMATAG_MISMATCH = 1 << 12
+    DEQUEUE_BUFFER_SPARE_BUFFER_INDEX_ERROR = 1 << 13
+    COLLECTIVE_NOT_IMPLEMENTED = 1 << 14
+    RECEIVE_OFFCHIP_SPARE_BUFF_ID_NOT_VALID = 1 << 15
+    EAGER_THRESHOLD_INVALID = 1 << 16
+    RENDEZVOUS_THRESHOLD_INVALID = 1 << 17
+    DMA_SIZE_ERROR = 1 << 18
+    ARITH_ERROR = 1 << 19
+    PACK_TIMEOUT_STS_ERROR = 1 << 20
+    PACK_SEQ_NUMBER_ERROR = 1 << 21
+    COMPRESSION_ERROR = 1 << 22
+    KRNL_TIMEOUT_STS_ERROR = 1 << 23
+    KRNL_STS_COUNT_ERROR = 1 << 24
+    SEGMENTER_EXPECTED_BTT_ERROR = 1 << 25
+    DMA_TAG_MISMATCH_ERROR = 1 << 26
+
+
+ERROR_CODE_BITS = 27  # bits 0..26 inclusive
+
+
+def error_code_to_string(code: int) -> str:
+    """Decode a sticky error word into a human-readable string."""
+    if code == 0:
+        return "COLLECTIVE_OP_SUCCESS"
+    names = [e.name for e in ErrorCode if e.value and (code & e.value)]
+    return " | ".join(names) if names else f"UNKNOWN_ERROR(0x{code:x})"
+
+
+class ACCLError(RuntimeError):
+    """Raised by the host driver when a call returns a nonzero retcode.
+
+    Mirrors ACCL::check_return_value (reference: driver/xrt/src/accl.cpp:1210-1234).
+    """
+
+    def __init__(self, function_name: str, retcode: int):
+        self.retcode = retcode
+        super().__init__(
+            f"CCLO call {function_name} failed: {error_code_to_string(retcode)} "
+            f"(retcode=0x{retcode:x})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Defaults (reference: driver/xrt/include/accl.hpp:102-104 and
+# kernels/cclo/fw .../ccl_offload_control.h:51-54)
+# ---------------------------------------------------------------------------
+
+TAG_ANY = 0xFFFFFFFF
+
+DEFAULT_NUM_EAGER_RX_BUFS = 16
+DEFAULT_EAGER_RX_BUF_SIZE = 1024  # bytes
+DEFAULT_MAX_EAGER_SIZE = 1024  # bytes; above this (uncompressed, non-stream)
+#   a transfer takes the rendezvous path
+DEFAULT_MAX_RENDEZVOUS_SIZE = 32 * 1024  # bytes
+
+# Max bytes a single data-movement command may carry before being chunked
+# (reference DMA_MAX_BTT, ccl_offload_control.h:54). On TPU this bounds the
+# per-step block a schedule moves between HBM buffers / across ICI.
+DMA_MAX_BTT = 8 * 1024 * 1024 - 64
+
+# Max bytes per wire segment (reference MAX_PACKETSIZE, ccl_offload_control.h:51)
+MAX_SEG_SIZE = 4096
+
+EXCHMEM_SIZE = 8192  # bytes of emulated exchange memory per rank
+
+
+class TuningParams:
+    """Runtime algorithm-tuning registers.
+
+    Mirrors the CCLO_ADDR tuning registers and their default values written
+    by ACCL::configure_tuning_parameters (reference: driver/xrt/src/accl.cpp:1198-1208).
+    """
+
+    def __init__(
+        self,
+        gather_flat_tree_max_fanin: int = 2,
+        gather_flat_tree_max_count: int = 32 * 1024,
+        bcast_flat_tree_max_ranks: int = 3,
+        reduce_flat_tree_max_ranks: int = 4,
+        reduce_flat_tree_max_count: int = 32 * 1024,
+    ):
+        self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
+        self.gather_flat_tree_max_count = gather_flat_tree_max_count
+        self.bcast_flat_tree_max_ranks = bcast_flat_tree_max_ranks
+        self.reduce_flat_tree_max_ranks = reduce_flat_tree_max_ranks
+        self.reduce_flat_tree_max_count = reduce_flat_tree_max_count
+
+    @classmethod
+    def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
+        reduce_flat_ranks = 4
+        return cls(
+            reduce_flat_tree_max_ranks=reduce_flat_ranks,
+            reduce_flat_tree_max_count=min(
+                max_rndzv_msg_size // reduce_flat_ranks, 32 * 1024
+            ),
+        )

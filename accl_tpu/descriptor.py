@@ -1,0 +1,109 @@
+"""Call descriptors: the host <-> sequencer contract.
+
+A collective call is described by a fixed 15-word descriptor, exactly the
+shape the reference streams from the hostctrl kernel into the CCLO's
+CMD_CALL FIFO (reference: driver/hls/accl_hls.h:134-198 start_call;
+firmware unpack at ccl_offload_control.c:2317-2360). The same descriptor is
+consumed by the native emulator runtime and, on the TPU path, used as the
+cache key + static parameter set for the compiled XLA schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .constants import (
+    CompressionFlags,
+    DataType,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    TAG_ANY,
+)
+
+DESCRIPTOR_WORDS = 15
+
+
+@dataclasses.dataclass
+class CallOptions:
+    """Host-side form of a call descriptor (reference CCLO::Options,
+    driver/xrt/include/accl/cclo.hpp:41-83)."""
+
+    scenario: Operation = Operation.nop
+    count: int = 0
+    comm_addr: int = 0
+    root_src_dst: int = 0
+    function: int = 0  # ReduceFunction for reductions, CfgFunc for config
+    tag: int = TAG_ANY
+    arithcfg_addr: int = 0
+    compression_flags: CompressionFlags = CompressionFlags.NO_COMPRESSION
+    stream_flags: StreamFlags = StreamFlags.NO_STREAM
+    host_flags: HostFlags = HostFlags.NO_HOST
+    addr_0: int = 0  # operand 0 (send buffer)
+    addr_1: int = 0  # operand 1 (second reduction operand)
+    addr_2: int = 0  # result buffer
+    # TPU-path extras (not serialized into the 15-word form): static dtype
+    # so compiled schedules can be cached per signature.
+    data_type: DataType = DataType.none
+
+    def to_words(self) -> list[int]:
+        """Serialize into the 15-word call stream layout (accl_hls.h:134-198):
+        scenario, count, comm, root_src_dst, function, tag, arithcfg,
+        compression, stream|host<<8, then three 64-bit addresses as lo/hi
+        word pairs."""
+        words = [
+            int(self.scenario),
+            self.count,
+            self.comm_addr,
+            self.root_src_dst,
+            int(self.function),
+            self.tag,
+            self.arithcfg_addr,
+            int(self.compression_flags),
+            int(self.stream_flags) | (int(self.host_flags) << 8),
+        ]
+        for addr in (self.addr_0, self.addr_1, self.addr_2):
+            words.append(addr & 0xFFFFFFFF)
+            words.append((addr >> 32) & 0xFFFFFFFF)
+        assert len(words) == DESCRIPTOR_WORDS
+        return words
+
+    @classmethod
+    def from_words(cls, words: list[int]) -> "CallOptions":
+        if len(words) != DESCRIPTOR_WORDS:
+            raise ValueError(f"descriptor must be {DESCRIPTOR_WORDS} words")
+        return cls(
+            scenario=Operation(words[0]),
+            count=words[1],
+            comm_addr=words[2],
+            root_src_dst=words[3],
+            function=words[4],
+            tag=words[5],
+            arithcfg_addr=words[6],
+            compression_flags=CompressionFlags(words[7]),
+            stream_flags=StreamFlags(words[8] & 0xFF),
+            host_flags=HostFlags((words[8] >> 8) & 0xFF),
+            addr_0=words[9] | (words[10] << 32),
+            addr_1=words[11] | (words[12] << 32),
+            addr_2=words[13] | (words[14] << 32),
+        )
+
+    @property
+    def reduce_function(self) -> ReduceFunction:
+        return ReduceFunction(self.function)
+
+    def signature(self) -> tuple:
+        """Static compilation signature for the XLA schedule cache: every
+        field that changes the compiled program (count class, dtype, flags)
+        but not the runtime-variable buffer addresses."""
+        return (
+            self.scenario,
+            self.count,
+            self.root_src_dst,
+            self.function,
+            self.data_type,
+            int(self.compression_flags),
+            int(self.stream_flags),
+            int(self.host_flags),
+        )

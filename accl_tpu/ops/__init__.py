@@ -1,0 +1,9 @@
+"""Arithmetic, compression and streaming kernels (the plugin layer).
+
+TPU re-expression of kernels/plugins: reduce_ops (elementwise SUM/MAX
+lanes) and hp_compression (cast-compression lanes) become Pallas/VPU
+kernels; kernel streams become on-device producer/consumer queues.
+"""
+
+from .reduce_ops import combine_op, reduce_lane  # noqa: F401
+from .compression import compress, decompress, wire_dtype  # noqa: F401

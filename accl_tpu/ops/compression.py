@@ -1,0 +1,54 @@
+"""Cast-compression lanes (hp_compression plugin analog).
+
+The reference runs three fp32<->fp16 casting kernel instances on the op0,
+op1 and result lanes so payloads can cross the wire at half width
+(reference: kernels/plugins/hp_compression/hp_compression.cpp:30-60,
+rationale docs/overview.rst:39). On TPU the casts are VPU elementwise
+converts that XLA fuses against the adjacent ICI transfer; bf16 is added
+as the TPU-preferred wire format.
+
+Compressor lane numbering (referenced from ArithConfig rows):
+  0: fp32 -> fp16     1: fp16 -> fp32
+  2: fp32 -> bf16     3: bf16 -> fp32
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..arithconfig import ArithConfig
+
+_COMPRESS_TARGET = {
+    0: jnp.float16,
+    2: jnp.bfloat16,
+}
+_DECOMPRESS_TARGET = {
+    1: jnp.float32,
+    3: jnp.float32,
+}
+
+
+def wire_dtype(cfg: ArithConfig):
+    """The dtype payloads travel in when ETH_COMPRESSED is set: the
+    compressed domain of the active arithmetic configuration."""
+    if cfg.compressed_elem_bytes == cfg.uncompressed_elem_bytes:
+        return None  # dtype already at wire width; compression is a no-op
+    return _COMPRESS_TARGET.get(cfg.compressor_lane, jnp.bfloat16)
+
+
+def compress(x: jnp.ndarray, cfg: ArithConfig) -> jnp.ndarray:
+    """Run the compressor lane of cfg over a payload."""
+    wd = wire_dtype(cfg)
+    return x if wd is None else x.astype(wd)
+
+
+def decompress(x: jnp.ndarray, cfg: ArithConfig, out_dtype) -> jnp.ndarray:
+    """Run the decompressor lane of cfg; the lane's target must agree with
+    the caller's uncompressed dtype."""
+    target = _DECOMPRESS_TARGET.get(cfg.decompressor_lane)
+    if target is not None and jnp.dtype(target) != jnp.dtype(out_dtype):
+        raise ValueError(
+            f"decompressor lane {cfg.decompressor_lane} yields {target}, "
+            f"caller expects {out_dtype}"
+        )
+    return x.astype(out_dtype)

@@ -1,0 +1,53 @@
+"""Elementwise reduction lanes (reduce_ops plugin analog).
+
+The reference implements 512-bit SIMD elementwise SUM/MAX selected by an
+AXIS TDEST in 0-9 (reference: kernels/plugins/reduce_ops/reduce_ops.cpp:31-107).
+Here each lane is an elementwise combine on the VPU; XLA fuses these into
+the surrounding schedule. Pallas kernel variants of the hot lanes live in
+accl_tpu/ops/pallas_kernels.py.
+
+Lane numbering extends the reference TDEST map with bf16 lanes:
+  0-4  SUM  fp32, fp64, i32, i64, fp16
+  5-9  MAX  fp32, fp64, i32, i64, fp16
+  10,11 SUM/MAX bf16 (TPU-native)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import ReduceFunction
+
+_LANE_DTYPES = {
+    0: (jnp.float32, "sum"),
+    1: (jnp.float64, "sum"),
+    2: (jnp.int32, "sum"),
+    3: (jnp.int64, "sum"),
+    4: (jnp.float16, "sum"),
+    5: (jnp.float32, "max"),
+    6: (jnp.float64, "max"),
+    7: (jnp.int32, "max"),
+    8: (jnp.int64, "max"),
+    9: (jnp.float16, "max"),
+    10: (jnp.bfloat16, "sum"),
+    11: (jnp.bfloat16, "max"),
+}
+
+
+def reduce_lane(lane: int, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Apply the elementwise reduction selected by an arithconfig lane id,
+    the way the AXIS switch steers operand pairs into a reduce_ops TDEST."""
+    dtype, op = _LANE_DTYPES[lane]
+    a = a.astype(dtype)
+    b = b.astype(dtype)
+    return jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
+
+
+def combine_op(func: ReduceFunction, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise combine by ReduceFunction in the operands' own dtype
+    (the firmware `combine` primitive, ccl_offload_control.c:551-569)."""
+    if func == ReduceFunction.SUM:
+        return jnp.add(a, b)
+    if func == ReduceFunction.MAX:
+        return jnp.maximum(a, b)
+    raise ValueError(f"unsupported reduce function {func}")

@@ -1,0 +1,21 @@
+"""The collective sequencer: algorithm selection + schedule compilation.
+
+This package is the TPU re-expression of the CCLO firmware
+(reference: kernels/cclo/fw/sw_apps/ccl_offload_control/src/ccl_offload_control.c).
+Where the reference runs a microcoded control loop on a soft CPU emitting
+move instructions at runtime, we split the same logic into:
+
+  - plan.py       algorithm selection (eager/rendezvous protocol switch,
+                  ring vs flat-tree vs binary-tree, segmentation math,
+                  tuning registers) — pure logic shared with the native
+                  C++ runtime;
+  - schedules.py  SPMD implementations of each algorithm as traced JAX
+                  programs over a mesh axis (the "move programs" of the
+                  TPU path — one compiled program executes the entire
+                  collective on-device, preserving ACCL's host-only-
+                  supervises property);
+  - lowering.py   descriptor -> compiled program, with a schedule cache
+                  keyed by the descriptor's static signature.
+"""
+
+from .plan import Algorithm, Plan, Protocol, select_algorithm  # noqa: F401

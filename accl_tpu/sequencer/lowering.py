@@ -1,0 +1,288 @@
+"""Lowering: call descriptor + plan -> compiled device program.
+
+This is the TPU analog of the firmware's dispatch (ccl_offload_control.c:2374-2456)
+combined with the move-instruction emission (.c:413-527): instead of
+streaming move words into a hardware DMP at runtime, the whole collective
+schedule is traced once per static descriptor signature, compiled by XLA
+into a single device program over the mesh, and cached — subsequent calls
+with the same signature are a dispatch-only cost, preserving ACCL's
+"host only issues the call" property.
+
+Operands enter as stacked per-rank buffers: a global array of shape
+(world, n) sharded on the collective axis, so device r's shard is rank r's
+local buffer (ACCL buffer semantics, not slices of one logical tensor).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+from ..arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
+from ..constants import (
+    CompressionFlags,
+    DataType,
+    Operation,
+    ReduceFunction,
+    to_numpy_dtype,
+)
+from ..descriptor import CallOptions
+from ..ops.compression import wire_dtype
+from . import schedules
+from .plan import Algorithm, Plan
+
+
+class ScheduleCompiler:
+    """Compiles and caches collective programs for one mesh axis.
+
+    The cache key is the descriptor's static signature + the plan, mirroring
+    how the reference caches nothing but re-executes firmware per call — on
+    TPU, tracing per call would forfeit all performance, so compilation is
+    amortized exactly like XLA intends.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        axis_name: str = "ccl",
+        arith_table: dict | None = None,
+    ):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.arith_table = arith_table or DEFAULT_ARITH_CONFIG
+        self._cache: dict = {}
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def _wire(
+        self,
+        options: CallOptions,
+        arithcfg: ArithConfig | None,
+        func: ReduceFunction | None,
+        compressed_domain: bool,
+    ) -> schedules.Wire:
+        """Resolve the datapath config: which compression lanes wrap each
+        hop and which arith lane reductions use (prepare_call's dtype logic,
+        reference accl.cpp:1236-1356)."""
+        arith_lane = None
+        if arithcfg is not None and func is not None:
+            arith_lane = arithcfg.arith_lanes[int(func)]
+        eth = (
+            arithcfg is not None
+            and options.compression_flags & CompressionFlags.ETH_COMPRESSED
+            and wire_dtype(arithcfg) is not None
+        )
+        # In compressed-domain execution the operand is cast once up front,
+        # so per-hop lanes are disabled (payload already at wire width).
+        cfg = arithcfg if (eth and not compressed_domain) else None
+        return schedules.Wire(cfg, arith_lane)
+
+    def compile(
+        self,
+        options: CallOptions,
+        plan: Plan,
+        arithcfg: ArithConfig | None = None,
+    ) -> Callable:
+        key = (options.signature(), plan, self.axis_name)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(options, plan, arithcfg)
+            self._cache[key] = fn
+        return fn
+
+    # -- construction -----------------------------------------------------
+
+    def _build(self, options: CallOptions, plan: Plan, arithcfg) -> Callable:
+        axis, world = self.axis_name, self.world
+        op = options.scenario
+        root = options.root_src_dst
+        func = ReduceFunction(options.function) if op in (
+            Operation.combine,
+            Operation.reduce,
+            Operation.allreduce,
+            Operation.reduce_scatter,
+        ) else None
+        # Reductions whose arithconfig reduces in the compressed domain
+        # (arith_is_compressed, arithconfig.hpp:55-57) cast the operand to
+        # the wire dtype once and run the whole schedule there, avoiding a
+        # decompress/recompress pair at every hop.
+        compressed_domain = bool(
+            func is not None
+            and arithcfg is not None
+            and options.compression_flags & CompressionFlags.ETH_COMPRESSED
+            and arithcfg.arith_is_compressed
+            and wire_dtype(arithcfg) is not None
+        )
+        wire = self._wire(options, arithcfg, func, compressed_domain)
+        common = dict(axis=axis, world=world, wire=wire)
+
+        if op == Operation.copy:
+            body, n_in = functools.partial(schedules.copy_schedule, **common), 1
+        elif op == Operation.combine:
+            body = functools.partial(schedules.combine_schedule, func=func, **common)
+            n_in = 2
+        elif op in (Operation.send, Operation.recv):
+            # On the SPMD path send/recv lower to one sendrecv program
+            # executed by the whole axis (src/dst from the descriptor).
+            src = options.root_src_dst & 0xFFFF
+            dst = (options.root_src_dst >> 16) & 0xFFFF
+            body = functools.partial(
+                schedules.sendrecv_schedule, src=src, dst=dst, **common
+            )
+            n_in = 1
+        elif op == Operation.bcast:
+            if plan.algorithm == Algorithm.RNDZV_BIN_TREE:
+                body = functools.partial(
+                    schedules.bcast_bin_tree_schedule, root=root, **common
+                )
+            else:
+                body = functools.partial(
+                    schedules.bcast_flat_schedule, root=root, **common
+                )
+            n_in = 1
+        elif op == Operation.scatter:
+            body = functools.partial(schedules.scatter_schedule, root=root, **common)
+            n_in = 1
+        elif op == Operation.gather:
+            if plan.algorithm == Algorithm.EAGER_RING:
+                body = functools.partial(
+                    schedules.gather_ring_schedule, root=root, **common
+                )
+            else:
+                body = functools.partial(
+                    schedules.gather_flat_schedule,
+                    root=root,
+                    fanin=plan.tree_fanin,
+                    **common,
+                )
+            n_in = 1
+        elif op == Operation.allgather:
+            body = functools.partial(schedules.allgather_ring_schedule, **common)
+            n_in = 1
+        elif op == Operation.reduce:
+            if plan.algorithm == Algorithm.EAGER_RING:
+                body = functools.partial(
+                    schedules.reduce_ring_schedule, root=root, func=func, **common
+                )
+            elif plan.algorithm == Algorithm.RNDZV_BIN_TREE:
+                body = functools.partial(
+                    schedules.reduce_bin_tree_schedule, root=root, func=func, **common
+                )
+            else:
+                body = functools.partial(
+                    schedules.reduce_flat_schedule, root=root, func=func, **common
+                )
+            n_in = 1
+        elif op == Operation.reduce_scatter:
+            if plan.algorithm == Algorithm.RNDZV_REDUCE_SCATTER:
+                # Composition: reduce-to-0 then scatter (.c:1768-1781);
+                # the reduce stage's tree shape comes from plan.stages.
+                reduce_body = self._reduce_body(plan.stages[0], 0, func, common)
+
+                def body(x, *, _c=common, _rb=reduce_body):
+                    return schedules.scatter_schedule(_rb(x), root=0, **_c)
+
+            else:
+                body = functools.partial(
+                    schedules.reduce_scatter_ring_schedule, func=func, **common
+                )
+            n_in = 1
+        elif op == Operation.allreduce:
+            if plan.algorithm == Algorithm.RNDZV_REDUCE_BCAST:
+                # Composition: reduce-to-0 then broadcast (.c:1878-1887);
+                # both stage shapes were re-selected by plan.py with the
+                # live tuning registers.
+                reduce_body = self._reduce_body(plan.stages[0], 0, func, common)
+                bcast_bin = plan.stages[1].algorithm == Algorithm.RNDZV_BIN_TREE
+
+                def body(x, *, _c=common, _rb=reduce_body, _bin=bcast_bin):
+                    red = _rb(x)
+                    if _bin:
+                        return schedules.bcast_bin_tree_schedule(red, root=0, **_c)
+                    return schedules.bcast_flat_schedule(red, root=0, **_c)
+
+            else:
+                body = functools.partial(
+                    schedules.allreduce_ring_schedule,
+                    func=func,
+                    seg_count=plan.seg_count,
+                    **common,
+                )
+            n_in = 1
+        elif op == Operation.alltoall:
+            body = functools.partial(schedules.alltoall_schedule, **common)
+            n_in = 1
+        elif op == Operation.barrier:
+            body = functools.partial(schedules.barrier_schedule, **common)
+            n_in = 1
+        else:
+            raise ValueError(f"cannot lower scenario {op!r}")
+
+        if compressed_domain:
+            inner, wd = body, wire_dtype(arithcfg)
+
+            def body(*args, _inner=inner, _wd=wd):
+                orig = args[0].dtype
+                out = _inner(*(a.astype(_wd) for a in args))
+                return out.astype(orig)
+
+        spec = PartitionSpec(self.axis_name)
+        shmapped = jax.shard_map(
+            _squeeze_wrap(body, n_in),
+            mesh=self.mesh,
+            in_specs=(spec,) * n_in,
+            out_specs=spec,
+        )
+        return jax.jit(shmapped)
+
+    def _reduce_body(self, stage_plan: Plan, root: int, func, common):
+        """The reduce stage of a composed collective, shaped by its
+        re-selected plan (flat vs binomial, .c:1531 vs .c:1603)."""
+        if stage_plan.algorithm == Algorithm.RNDZV_BIN_TREE:
+            return functools.partial(
+                schedules.reduce_bin_tree_schedule, root=root, func=func, **common
+            )
+        if stage_plan.algorithm == Algorithm.EAGER_RING:
+            return functools.partial(
+                schedules.reduce_ring_schedule, root=root, func=func, **common
+            )
+        return functools.partial(
+            schedules.reduce_flat_schedule, root=root, func=func, **common
+        )
+
+    # -- convenience: full pipeline from descriptor ------------------------
+
+    def lower(self, options: CallOptions, plan: Plan) -> Callable:
+        arithcfg = None
+        if options.data_type != DataType.none:
+            arithcfg = _arithcfg_for(self.arith_table, options)
+        return self.compile(options, plan, arithcfg)
+
+
+def _arithcfg_for(table, options: CallOptions):
+    dt = options.data_type
+    # Exact-dtype row first; fall back to the homogeneous pair.
+    for (unc, cmp_), cfg in table.items():
+        if unc == dt and (
+            options.compression_flags & CompressionFlags.ETH_COMPRESSED
+        ) == (CompressionFlags.ETH_COMPRESSED if unc != cmp_ else 0):
+            return cfg
+    return table.get((dt, dt))
+
+
+def _squeeze_wrap(body, n_in):
+    """shard_map hands each rank a (1, n) shard of the stacked (world, n)
+    operand; schedules work on flat (n,) buffers."""
+
+    def wrapped(*args):
+        flat = [a.reshape(a.shape[-1]) for a in args]
+        out = body(*flat)
+        return out.reshape(1, out.shape[-1])
+
+    return wrapped

@@ -1,0 +1,253 @@
+"""Algorithm selection: which schedule runs a given call.
+
+Ports the firmware's per-collective selection logic
+(reference: ccl_offload_control.c — bcast .c:796-988, scatter .c:992-1123,
+gather .c:1128-1294, allgather .c:1297-1503, reduce .c:1507-1744,
+reduce_scatter .c:1748-1852, allreduce .c:1855-2075, alltoall .c:2123-2218,
+barrier .c:2078-2120) as a pure function so the Python lowering, the native
+C++ runtime, and the tests all agree on exactly one set of rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+from ..constants import (
+    CompressionFlags,
+    Operation,
+    StreamFlags,
+    TuningParams,
+)
+
+
+class Protocol(enum.IntEnum):
+    EAGER = 0  # segmented through preallocated RX ring slots
+    RENDEZVOUS = 1  # bulk zero-copy transfer after an address handshake
+
+
+class Algorithm(enum.IntEnum):
+    """Schedule families (SURVEY.md §2.7 table)."""
+
+    NONE = 0  # local-only ops: copy/combine, world==1 corner cases
+    EAGER_SENDRECV = 1  # segmented pipeline through rx slots (.c:611-648)
+    RNDZV_SENDRECV = 2  # zero-copy one-sided write (.c:587-610)
+    EAGER_FLAT = 3  # root fan-out, segmented (eager bcast/scatter)
+    EAGER_RING = 4  # daisy-chain (eager gather/allgather/reduce/rs)
+    EAGER_RING_RS_AG = 5  # ring reduce-scatter + ring allgather (eager allreduce)
+    RNDZV_FLAT_TREE = 6  # out-of-order flat tree (small world/message)
+    RNDZV_BIN_TREE = 7  # distance-doubling binary tree (bcast/reduce)
+    RNDZV_RING = 8  # rendezvous ring (allgather)
+    RNDZV_REDUCE_BCAST = 9  # allreduce = reduce + bcast (.c:1878-1887)
+    RNDZV_REDUCE_SCATTER = 10  # reduce_scatter = reduce + scatter (.c:1768-1781)
+    FLAT_ALLTOALL = 11  # pairwise exchange (.c:2140-2211)
+    BARRIER_GATHER_SCATTER = 12  # zero-count notification tree (.c:2078-2120)
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """The resolved execution plan for one call.
+
+    seg_count is in elements: the eager segment size (rx-buffer capacity in
+    elements, world-aligned where the algorithm strides by world size,
+    .c:1898-1901). tree_fanin/tree_distance parameterize the flat/binary
+    trees. All fields are static so a Plan is part of the XLA cache key.
+    """
+
+    protocol: Protocol
+    algorithm: Algorithm
+    seg_count: int  # elements per eager segment (== count when unsegmented)
+    num_segments: int
+    tree_fanin: int = 0  # flat-tree fan-in cap (gather tuning)
+    use_bin_tree: bool = False
+    # Composed algorithms (rendezvous allreduce/reduce_scatter) re-run the
+    # per-stage selection with the same tuning registers, the way the
+    # firmware re-enters reduce()/broadcast()/scatter() (.c:1878-1887,
+    # .c:1768-1781). The stage plans are resolved here so lowering and the
+    # native runtime never re-derive selection rules.
+    stages: tuple["Plan", ...] = ()
+
+
+def is_rendezvous(
+    bytes_count: int,
+    compression: CompressionFlags,
+    stream: StreamFlags,
+    max_eager_size: int,
+) -> bool:
+    """The protocol switch every collective applies first
+    (e.g. .c:808, .c:1524, .c:1879): large, uncompressed, non-streamed
+    messages go rendezvous; everything else is eager."""
+    return (
+        bytes_count > max_eager_size
+        and compression == CompressionFlags.NO_COMPRESSION
+        and stream == StreamFlags.NO_STREAM
+    )
+
+
+def eager_seg_count(
+    count: int,
+    dtype_nbytes: int,
+    eager_rx_buf_size: int,
+    stream: StreamFlags,
+    world_align: int = 1,
+) -> int:
+    """Eager segment size in elements (.c:925-936, .c:1891-1901): the rx
+    buffer capacity, optionally rounded down to a multiple of world size for
+    algorithms that stride chunks by rank; streamed operands are never
+    segmented because streams can't be re-read."""
+    if stream & StreamFlags.OP0_STREAM:
+        return count
+    seg = max(eager_rx_buf_size // dtype_nbytes, 1)
+    if world_align > 1:
+        seg -= seg % world_align
+        seg = max(seg, world_align)
+    return min(seg, count) if count > 0 else seg
+
+
+def _segments(count: int, seg: int) -> int:
+    return max((count + seg - 1) // seg, 1)
+
+
+def select_algorithm(
+    scenario: Operation,
+    count: int,
+    dtype_nbytes: int,
+    world_size: int,
+    compression: CompressionFlags = CompressionFlags.NO_COMPRESSION,
+    stream: StreamFlags = StreamFlags.NO_STREAM,
+    *,
+    max_eager_size: int,
+    eager_rx_buf_size: int,
+    tuning: TuningParams,
+) -> Plan:
+    """Resolve scenario + message + communicator into a Plan.
+
+    Selection rules are the firmware's, collective by collective; each
+    branch cites the reference decision point.
+    """
+    bytes_count = count * dtype_nbytes
+    rndzv = is_rendezvous(bytes_count, compression, stream, max_eager_size)
+    proto = Protocol.RENDEZVOUS if rndzv else Protocol.EAGER
+
+    def eager_plan(algorithm: Algorithm, world_align: int = 1) -> Plan:
+        seg = eager_seg_count(
+            count, dtype_nbytes, eager_rx_buf_size, stream, world_align
+        )
+        return Plan(Protocol.EAGER, algorithm, seg, _segments(count, seg))
+
+    def rndzv_plan(algorithm: Algorithm, **kw) -> Plan:
+        return Plan(Protocol.RENDEZVOUS, algorithm, count, 1, **kw)
+
+    # Local-only operations and single-rank corner cases (.c:1520-1522,
+    # .c:1765-1767, .c:1875-1877: world==1 reductions degrade to copy).
+    if scenario in (Operation.copy, Operation.combine, Operation.config, Operation.nop):
+        return Plan(proto, Algorithm.NONE, count, 1)
+    if world_size == 1 and scenario != Operation.barrier:
+        return Plan(proto, Algorithm.NONE, count, 1)
+
+    if scenario in (Operation.send, Operation.recv):
+        # send .c:573-649 / recv .c:653-710: rendezvous one-sided write vs
+        # eager segmented pipeline.
+        if rndzv:
+            return rndzv_plan(Algorithm.RNDZV_SENDRECV)
+        return eager_plan(Algorithm.EAGER_SENDRECV)
+
+    if scenario == Operation.bcast:
+        if rndzv:
+            # .c:814-867: binary tree once the world outgrows the flat-tree
+            # tuning register; else out-of-order flat fan-out (.c:868-919).
+            if world_size > tuning.bcast_flat_tree_max_ranks:
+                return rndzv_plan(Algorithm.RNDZV_BIN_TREE, use_bin_tree=True)
+            return rndzv_plan(Algorithm.RNDZV_FLAT_TREE, tree_fanin=world_size - 1)
+        return eager_plan(Algorithm.EAGER_FLAT)  # .c:921-988 root fan-out
+
+    if scenario == Operation.scatter:
+        if rndzv:
+            return rndzv_plan(Algorithm.RNDZV_FLAT_TREE, tree_fanin=world_size - 1)
+        return eager_plan(Algorithm.EAGER_FLAT)  # .c:992-1123 round-robin
+
+    if scenario == Operation.gather:
+        if rndzv:
+            # .c:1142-1204: flat tree, fan-in capped above the tuning count
+            # threshold (gather fan-in 2 above 32 KB, accl.cpp:1200-1201).
+            if bytes_count > tuning.gather_flat_tree_max_count:
+                fanin = max(tuning.gather_flat_tree_max_fanin, 1)
+            else:
+                fanin = world_size - 1
+            return rndzv_plan(Algorithm.RNDZV_FLAT_TREE, tree_fanin=fanin)
+        return eager_plan(Algorithm.EAGER_RING)  # .c:1206-1293 daisy chain
+
+    if scenario == Operation.allgather:
+        if rndzv:
+            return rndzv_plan(Algorithm.RNDZV_RING)  # .c:1314-1401
+        return eager_plan(Algorithm.EAGER_RING)  # .c:1402-1499
+
+    if scenario == Operation.reduce:
+        if rndzv:
+            # .c:1531: flat tree when world or message is small, else
+            # distance-doubling binary tree (.c:1603-1727).
+            if (
+                world_size <= tuning.reduce_flat_tree_max_ranks
+                or bytes_count <= tuning.reduce_flat_tree_max_count
+            ):
+                return rndzv_plan(Algorithm.RNDZV_FLAT_TREE, tree_fanin=world_size - 1)
+            return rndzv_plan(Algorithm.RNDZV_BIN_TREE, use_bin_tree=True)
+        return eager_plan(Algorithm.EAGER_RING)  # .c:1730-1743 ring relay
+
+    if scenario == Operation.reduce_scatter:
+        if rndzv:
+            # .c:1768-1781: reduce(count*world, root=0) then scatter(count).
+            sub = functools.partial(
+                select_algorithm,
+                dtype_nbytes=dtype_nbytes,
+                world_size=world_size,
+                compression=compression,
+                stream=stream,
+                max_eager_size=max_eager_size,
+                eager_rx_buf_size=eager_rx_buf_size,
+                tuning=tuning,
+            )
+            return rndzv_plan(
+                Algorithm.RNDZV_REDUCE_SCATTER,
+                stages=(
+                    sub(Operation.reduce, count * world_size),
+                    sub(Operation.scatter, count),
+                ),
+            )
+        return eager_plan(Algorithm.EAGER_RING, world_align=world_size)  # .c:1782-1850
+
+    if scenario == Operation.allreduce:
+        if rndzv:
+            # .c:1878-1887: reduce(root=0) then broadcast, each re-selected.
+            sub = functools.partial(
+                select_algorithm,
+                dtype_nbytes=dtype_nbytes,
+                world_size=world_size,
+                compression=compression,
+                stream=stream,
+                max_eager_size=max_eager_size,
+                eager_rx_buf_size=eager_rx_buf_size,
+                tuning=tuning,
+            )
+            return rndzv_plan(
+                Algorithm.RNDZV_REDUCE_BCAST,
+                stages=(
+                    sub(Operation.reduce, count),
+                    sub(Operation.bcast, count),
+                ),
+            )
+        # .c:1888-2071: segmented ring reduce-scatter + ring allgather with
+        # world-aligned segments.
+        return eager_plan(Algorithm.EAGER_RING_RS_AG, world_align=world_size)
+
+    if scenario == Operation.alltoall:
+        return rndzv_plan(Algorithm.FLAT_ALLTOALL) if rndzv else eager_plan(
+            Algorithm.FLAT_ALLTOALL
+        )  # .c:2140-2211
+
+    if scenario == Operation.barrier:
+        # .c:2078-2120: count==0 notification gather-to-0 then scatter.
+        return Plan(Protocol.RENDEZVOUS, Algorithm.BARRIER_GATHER_SCATTER, 0, 1)
+
+    raise ValueError(f"no algorithm for scenario {scenario!r}")

@@ -1,0 +1,378 @@
+"""SPMD collective schedules: the move programs of the TPU path.
+
+Each function here is the body of a shard_map over one mesh axis and
+implements one algorithm family from plan.Algorithm, composed from the
+framework's own primitives (neighbor permutes over ICI + reduce/compression
+lanes) rather than XLA's prebuilt collectives — the whole schedule compiles
+into a single device program, preserving the reference's host-only-issues-
+the-call inversion (SURVEY.md §1).
+
+Conventions:
+  - every rank's operand is its full local buffer (ACCL buffer semantics,
+    not a shard of a global array);
+  - `perm`-based sends are lax.ppermute: a rank not addressed by any pair
+    receives zeros, which schedules mask with `where`;
+  - ring neighbor order follows the communicator (next = rank+1, as in
+    ccl_offload_control.c:1311-1312);
+  - wire compression (ETH_COMPRESSED) casts payloads to the arithconfig's
+    compressed dtype around every cross-rank hop, mirroring the
+    compression-lane plumbing of the reference data plane.
+
+Algorithm provenance (reference ccl_offload_control.c):
+  ring gather .c:1206-1293, ring allgather .c:1402-1499, ring reduce relay
+  with fused recv-reduce-send .c:1730-1743 + .c:755-789, ring
+  reduce-scatter .c:1782-1850, segmented ring allreduce .c:1888-2071,
+  binary-tree bcast .c:814-867, flat bcast .c:868-919, flat/binomial
+  gather/reduce trees .c:1142-1204/.c:1531-1727, alltoall .c:2140-2211,
+  barrier .c:2078-2120.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import ReduceFunction
+from ..ops.compression import compress, decompress
+from ..ops.reduce_ops import combine_op, reduce_lane
+
+
+def _ring_perm(world: int, distance: int = 1):
+    return [(i, (i + distance) % world) for i in range(world)]
+
+
+def _fast_log2(x: int) -> int:
+    return x.bit_length() - 1
+
+
+class Wire:
+    """Per-call datapath configuration: the wire transform (compression
+    lanes around each cross-rank hop when ETH_COMPRESSED is active) and the
+    arithmetic lane reductions run through — the schedule-level analog of
+    the AXIS switch steering payloads through the hp_compression and
+    reduce_ops plugin lanes."""
+
+    def __init__(self, cfg=None, arith_lane=None):
+        self.cfg = cfg  # ArithConfig when wire compression is active
+        self.arith_lane = arith_lane
+
+    def send(self, x):
+        return x if self.cfg is None else compress(x, self.cfg)
+
+    def recv(self, x, out_dtype):
+        return x if self.cfg is None else decompress(x, self.cfg, out_dtype)
+
+    def ppermute(self, x, axis, perm):
+        """One cross-rank hop: compress -> permute -> decompress."""
+        y = lax.ppermute(self.send(x), axis, perm)
+        return self.recv(y, x.dtype)
+
+    def combine(self, func, a, b):
+        """Elementwise reduction through the configured arith lane."""
+        if self.arith_lane is not None:
+            return reduce_lane(self.arith_lane, a, b)
+        return combine_op(func, a, b)
+
+
+# ---------------------------------------------------------------------------
+# Primitives (firmware primitives layer, ccl_offload_control.c:531-789)
+# ---------------------------------------------------------------------------
+
+
+def copy_schedule(x, *, axis, world, wire):
+    return x
+
+
+def combine_schedule(x, y, *, func: ReduceFunction, axis, world, wire):
+    return wire.combine(func, x, y)
+
+
+def sendrecv_schedule(x, *, src: int, dst: int, axis, world, wire):
+    """Point-to-point: dst's output is src's buffer, everyone else keeps
+    their input (send .c:573-649 / recv .c:653-710)."""
+    if src == dst:
+        return x
+    recv = wire.ppermute(x, axis, [(src, dst)])
+    me = lax.axis_index(axis)
+    return jnp.where(me == dst, recv, x)
+
+
+def fused_recv_reduce(acc, recv, is_receiver, func, wire):
+    """The fused recv-reduce primitive (.c:716-749): combine an incoming
+    partial into the local accumulator on receiving ranks only, through the
+    configured arith lane."""
+    return jnp.where(is_receiver, wire.combine(func, acc, recv), acc)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast family
+# ---------------------------------------------------------------------------
+
+
+def bcast_flat_schedule(x, *, root: int, axis, world, wire):
+    """Flat fan-out: root sends the full buffer to each rank with one move
+    per destination (eager .c:921-988 / rendezvous flat .c:868-919) — the
+    per-destination hops all leave root's egress links, so the sequential
+    permutes mirror the physical serialization of the flat tree."""
+    me = lax.axis_index(axis)
+    out = x
+    for j in range(world):
+        if j == root:
+            continue
+        recv = wire.ppermute(x, axis, [(root, j)])
+        out = jnp.where(me == j, recv, out)
+    return out
+
+
+def bcast_bin_tree_schedule(x, *, root: int, axis, world, wire):
+    """Distance-doubling binary tree (.c:814-867): the sender set doubles
+    each round; round distances run d = 2^floor(log2(P-1)) .. 1."""
+    me = lax.axis_index(axis)
+    l = (me - root) % world  # normalized rank, root at 0
+    have = (me == root)
+    d = 1 << _fast_log2(world - 1)
+    while d > 0:
+        perm = []
+        receivers = []
+        for ln in range(0, world, 2 * d):  # senders: l % 2d == 0 with l+d < P
+            if ln + d < world:
+                perm.append(((ln + root) % world, (ln + d + root) % world))
+                receivers.append(ln + d)
+        recv = wire.ppermute(x, axis, perm)
+        is_recv = jnp.isin(l, jnp.asarray(receivers))
+        x = jnp.where(is_recv & ~have, recv, x)
+        have = have | is_recv
+        d >>= 1
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Scatter / gather family
+# ---------------------------------------------------------------------------
+
+
+def scatter_schedule(x, *, root: int, axis, world, wire):
+    """Root holds world*count elements; rank j receives chunk j. Flat
+    per-destination sends in round-robin (.c:992-1123)."""
+    count = x.shape[-1] // world
+    me = lax.axis_index(axis)
+    out = lax.dynamic_slice_in_dim(x, root * count, count, axis=-1)
+    for j in range(world):
+        if j == root:
+            continue
+        chunk = lax.dynamic_slice_in_dim(x, j * count, count, axis=-1)
+        recv = wire.ppermute(chunk, axis, [(root, j)])
+        out = jnp.where(me == j, recv, out)
+    return out
+
+
+def gather_ring_schedule(x, *, root: int, axis, world, wire):
+    """Eager daisy-chain gather (.c:1206-1293): every rank relays its
+    upstream neighbours' chunks around the ring; root collects P-1 chunks
+    in arrival order (origin of the step-s arrival is rank root-1-s)."""
+    count = x.shape[-1]
+    me = lax.axis_index(axis)
+    out = jnp.zeros((world * count,), x.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, x, root * count, axis=-1)
+    relay = x
+    for s in range(world - 1):
+        recv = wire.ppermute(relay, axis, _ring_perm(world))
+        origin = (root - 1 - s) % world
+        placed = lax.dynamic_update_slice_in_dim(out, recv, origin * count, axis=-1)
+        out = jnp.where(me == root, placed, out)
+        relay = recv
+    return out
+
+
+def gather_flat_schedule(x, *, root: int, axis, world, wire, fanin: int):
+    """Rendezvous gather. With unbounded fan-in every rank writes straight
+    to root (.c:1142-1204); with the tuning cap (fan-in 2 above the count
+    threshold, accl.cpp:1200-1201) it becomes a binomial combining tree."""
+    count = x.shape[-1]
+    me = lax.axis_index(axis)
+    l = (me - root) % world
+    out = jnp.zeros((world * count,), x.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, x, me * count, axis=-1)
+    if fanin >= world - 1:
+        for j in range(world):
+            if j == root:
+                continue
+            recv = wire.ppermute(x, axis, [(j, root)])
+            placed = lax.dynamic_update_slice_in_dim(out, recv, j * count, axis=-1)
+            out = jnp.where(me == root, placed, out)
+        return out
+    # Binomial tree: at distance d, normalized ranks with l % 2d == d send
+    # their accumulated subtree [l, min(l+d, P)) to parent l-d.
+    positions = jnp.arange(world * count) // count  # owner chunk of each slot
+    norm_pos = (positions - root) % world
+    d = 1
+    while d < world:
+        perm = []
+        senders = []
+        for ln in range(d, world, 2 * d):
+            perm.append(((ln + root) % world, (ln - d + root) % world))
+            senders.append(ln)
+        recv = wire.ppermute(out, axis, perm)
+        sender_norm = l + d  # the child that sent to me this round
+        subtree = (norm_pos >= sender_norm) & (norm_pos < jnp.minimum(sender_norm + d, world))
+        is_parent = jnp.isin(l, jnp.asarray([ln - d for ln in senders]))
+        out = jnp.where(is_parent & subtree, recv, out)
+        d *= 2
+    return out
+
+
+def allgather_ring_schedule(x, *, axis, world, wire):
+    """Ring allgather (eager .c:1402-1499, rendezvous .c:1314-1401): P-1
+    relay steps; the step-s arrival originates from rank me-1-s."""
+    count = x.shape[-1]
+    me = lax.axis_index(axis)
+    out = jnp.zeros((world * count,), x.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, x, me * count, axis=-1)
+    relay = x
+    for s in range(world - 1):
+        recv = wire.ppermute(relay, axis, _ring_perm(world))
+        origin = (me - 1 - s) % world
+        out = lax.dynamic_update_slice_in_dim(out, recv, origin * count, axis=-1)
+        relay = recv
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Reduction family
+# ---------------------------------------------------------------------------
+
+
+def reduce_ring_schedule(x, *, root: int, func, axis, world, wire):
+    """Eager ring reduce (.c:1730-1743): partials relay around the ring,
+    each hop a fused recv-reduce-send (.c:755-789), terminating at root."""
+    me = lax.axis_index(axis)
+    acc = x
+    for s in range(world - 1):
+        sender = (root + 1 + s) % world
+        receiver = (sender + 1) % world
+        recv = wire.ppermute(acc, axis, [(sender, receiver)])
+        acc = fused_recv_reduce(acc, recv, me == receiver, func, wire)
+    return acc
+
+
+def reduce_flat_schedule(x, *, root: int, func, axis, world, wire):
+    """Rendezvous flat-tree reduce (.c:1531-1602): children write straight
+    into root's scratch, root folds arrivals into the accumulator."""
+    me = lax.axis_index(axis)
+    acc = x
+    for j in range(world):
+        if j == root:
+            continue
+        recv = wire.ppermute(x, axis, [(j, root)])
+        acc = fused_recv_reduce(acc, recv, me == root, func, wire)
+    return acc
+
+
+def reduce_bin_tree_schedule(x, *, root: int, func, axis, world, wire):
+    """Rendezvous binomial-tree reduce (.c:1603-1727): at distance d the
+    normalized ranks with l % 2d == d send partials to l-d; log2(P) rounds."""
+    me = lax.axis_index(axis)
+    l = (me - root) % world
+    acc = x
+    d = 1
+    while d < world:
+        perm = []
+        parents = []
+        for ln in range(d, world, 2 * d):
+            perm.append(((ln + root) % world, (ln - d + root) % world))
+            parents.append(ln - d)
+        recv = wire.ppermute(acc, axis, perm)
+        is_parent = jnp.isin(l, jnp.asarray(parents))
+        acc = fused_recv_reduce(acc, recv, is_parent, func, wire)
+        d *= 2
+    return acc
+
+
+def reduce_scatter_ring_schedule(x, *, func, axis, world, wire):
+    """Ring reduce-scatter (.c:1782-1850): P-1 steps; at step s each rank
+    combines the arriving partial with its local copy of chunk me-1-s and
+    forwards; rank r ends holding reduced chunk r."""
+    count = x.shape[-1] // world
+    me = lax.axis_index(axis)
+    # Step-0 send is our local copy of chunk me-1; the step-s arrival is the
+    # running partial of chunk me-2-s, combined with our local copy and
+    # forwarded. After P-1 hops rank r holds fully-reduced chunk r.
+    v = lax.dynamic_slice_in_dim(x, ((me - 1) % world) * count, count, axis=-1)
+    for s in range(world - 1):
+        recv = wire.ppermute(v, axis, _ring_perm(world))
+        idx = (me - 2 - s) % world
+        local = lax.dynamic_slice_in_dim(x, idx * count, count, axis=-1)
+        v = wire.combine(func, recv, local)
+    return v
+
+
+def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):
+    """Segmented ring allreduce (.c:1888-2071): per segment, a ring
+    reduce-scatter over world-size chunks followed by a ring allgather.
+    Segments bound scratch footprint and pipeline across the loop."""
+    count = x.shape[-1]
+
+    def one_segment(seg):
+        padded = _pad_to_multiple(seg, world)
+        chunk = padded.shape[-1] // world
+        red = reduce_scatter_ring_schedule(
+            padded, func=func, axis=axis, world=world, wire=wire
+        )
+        gathered = allgather_ring_schedule(red, axis=axis, world=world, wire=wire)
+        return gathered[: seg.shape[-1]]
+
+    if count <= seg_count:
+        return one_segment(x)
+    num_bulk = count // seg_count
+    tail = count - num_bulk * seg_count
+    bulk = x[: num_bulk * seg_count].reshape(num_bulk, seg_count)
+    bulk_out = lax.map(one_segment, bulk).reshape(num_bulk * seg_count)
+    if tail:
+        tail_out = one_segment(x[num_bulk * seg_count :])
+        return jnp.concatenate([bulk_out, tail_out])
+    return bulk_out
+
+
+def _pad_to_multiple(x, m):
+    n = x.shape[-1]
+    rem = (-n) % m
+    if rem:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, rem)])
+    return x
+
+
+# ---------------------------------------------------------------------------
+# All-to-all and barrier
+# ---------------------------------------------------------------------------
+
+
+def alltoall_schedule(x, *, axis, world, wire):
+    """Pairwise rotation exchange (.c:2140-2211): at step k every rank
+    sends chunk me+k to rank me+k and files the arrival from rank me-k
+    into slot me-k; P-1 steps cover all peers."""
+    count = x.shape[-1] // world
+    me = lax.axis_index(axis)
+    own = lax.dynamic_slice_in_dim(x, me * count, count, axis=-1)
+    out = jnp.zeros_like(x)
+    out = lax.dynamic_update_slice_in_dim(out, own, me * count, axis=-1)
+    for k in range(1, world):
+        peer_chunk = lax.dynamic_slice_in_dim(
+            x, ((me + k) % world) * count, count, axis=-1
+        )
+        recv = wire.ppermute(peer_chunk, axis, _ring_perm(world, k))
+        out = lax.dynamic_update_slice_in_dim(
+            out, recv, ((me - k) % world) * count, axis=-1
+        )
+    return out
+
+
+def barrier_schedule(token, *, axis, world, wire):
+    """Notification-only gather-to-0 + fan-out (.c:2078-2120): zero-payload
+    messages carried here as a 1-element token reduced then rebroadcast."""
+    gathered = reduce_flat_schedule(
+        token, root=0, func=ReduceFunction.SUM, axis=axis, world=world, wire=wire
+    )
+    return bcast_flat_schedule(gathered, root=0, axis=axis, world=world, wire=wire)

@@ -1,0 +1,39 @@
+"""Test bootstrap: run the whole suite on a virtual 8-device CPU mesh.
+
+This is the accl-tpu analog of the reference's emulator-based CI
+(reference: .github/workflows/build-and-test.yml:53-102 runs the gtest
+suite against the software emulator with no FPGA): JAX is forced onto the
+host platform with 8 virtual devices so every SPMD schedule executes
+multi-rank with no TPU in the loop.
+"""
+
+import os
+
+# The container's sitecustomize imports jax and registers the TPU plugin at
+# interpreter startup, so env vars are too late here — use config.update,
+# which wins as long as no backend has been initialized yet.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, axis_names=("ccl",))
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4])
+    return Mesh(devs, axis_names=("ccl",))

@@ -1,0 +1,135 @@
+"""Unit tests for the core type layer (constants, arithconfig,
+communicator, descriptor) — semantics lifted from the reference driver
+(constants.hpp, arithconfig.hpp, communicator.cpp, accl_hls.h)."""
+
+import pytest
+
+from accl_tpu import (
+    ArithConfig,
+    CallOptions,
+    Communicator,
+    CompressionFlags,
+    DEFAULT_ARITH_CONFIG,
+    DataType,
+    ErrorCode,
+    HostFlags,
+    Operation,
+    Rank,
+    ReduceFunction,
+    StreamFlags,
+    error_code_to_string,
+    generate_ranks,
+)
+from accl_tpu.arithconfig import validate_arith_config
+from accl_tpu.constants import dtype_nbytes, from_numpy_dtype, to_numpy_dtype
+
+
+def test_operation_codes_match_reference():
+    # constants.hpp:190-216
+    assert Operation.config == 0
+    assert Operation.copy == 1
+    assert Operation.combine == 2
+    assert Operation.send == 3
+    assert Operation.recv == 4
+    assert Operation.bcast == 5
+    assert Operation.scatter == 6
+    assert Operation.gather == 7
+    assert Operation.reduce == 8
+    assert Operation.allgather == 9
+    assert Operation.allreduce == 10
+    assert Operation.reduce_scatter == 11
+    assert Operation.barrier == 12
+    assert Operation.alltoall == 13
+    assert Operation.nop == 255
+
+
+def test_flag_encoding():
+    f = CompressionFlags.OP0_COMPRESSED | CompressionFlags.ETH_COMPRESSED
+    assert int(f) == 9
+    assert HostFlags.RES_HOST == 4
+    assert StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM == 3
+
+
+def test_error_code_decode():
+    code = int(ErrorCode.DMA_TIMEOUT_ERROR | ErrorCode.ARITH_ERROR)
+    s = error_code_to_string(code)
+    assert "DMA_TIMEOUT_ERROR" in s and "ARITH_ERROR" in s
+    assert error_code_to_string(0) == "COLLECTIVE_OP_SUCCESS"
+
+
+def test_dtype_roundtrip():
+    for dt in (
+        DataType.float16,
+        DataType.float32,
+        DataType.float64,
+        DataType.int32,
+        DataType.int64,
+        DataType.bfloat16,
+    ):
+        assert from_numpy_dtype(to_numpy_dtype(dt)) == dt
+        assert to_numpy_dtype(dt).itemsize == dtype_nbytes(dt)
+
+
+def test_default_arith_config_matches_reference_table():
+    # arithconfig.hpp:102-119
+    row = DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.float16)]
+    assert row.uncompressed_elem_bytes == 4
+    assert row.compressed_elem_bytes == 2
+    assert row.arith_is_compressed is True
+    assert row.arith_lanes == (4, 9)  # fp16 SUM / MAX lanes
+    row = DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.float32)]
+    assert row.arith_lanes == (0, 5)
+    validate_arith_config(DEFAULT_ARITH_CONFIG)
+
+
+def test_arith_config_addr_lifecycle():
+    cfg = ArithConfig(4, 4, 0, 0, 0, False, (0, 5))
+    cfg.set_exchmem(0x100)
+    assert cfg.addr() == 0x100
+
+
+def test_communicator_exchmem_roundtrip():
+    ranks = generate_ranks(4)
+    comm = Communicator(ranks, local_rank=2)
+    words = comm.exchmem_words()
+    back = Communicator.from_exchmem_words(words)
+    assert back.size == 4
+    assert back.local_rank == 2
+    assert back.ranks[1].ip == "127.0.0.1"
+    assert back.ranks[3].port == ranks[3].port
+    assert comm.prev_rank() == 1 and comm.next_rank() == 3
+    assert "rank 0" in comm.dump()
+
+
+def test_communicator_bad_rank():
+    with pytest.raises(ValueError):
+        Communicator([Rank()], local_rank=3)
+
+
+def test_descriptor_word_roundtrip():
+    opts = CallOptions(
+        scenario=Operation.allreduce,
+        count=1024,
+        comm_addr=0x1000,
+        root_src_dst=3,
+        function=int(ReduceFunction.MAX),
+        tag=42,
+        arithcfg_addr=0x2000,
+        compression_flags=CompressionFlags.ETH_COMPRESSED,
+        stream_flags=StreamFlags.NO_STREAM,
+        host_flags=HostFlags.OP0_HOST,
+        addr_0=0x1_0000_0000,
+        addr_1=0x2_0000_1234,
+        addr_2=0xDEADBEEF,
+    )
+    words = opts.to_words()
+    assert len(words) == 15
+    back = CallOptions.from_words(words)
+    assert back.scenario == Operation.allreduce
+    assert back.count == 1024
+    assert back.reduce_function == ReduceFunction.MAX
+    assert back.addr_0 == 0x1_0000_0000
+    assert back.addr_1 == 0x2_0000_1234
+    assert back.addr_2 == 0xDEADBEEF
+    assert back.host_flags == HostFlags.OP0_HOST
+    assert back.compression_flags == CompressionFlags.ETH_COMPRESSED

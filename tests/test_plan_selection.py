@@ -1,0 +1,111 @@
+"""Algorithm-selection tests: the firmware's switching rules
+(SURVEY.md §2.7) must be reproduced exactly by select_algorithm."""
+
+from accl_tpu import (
+    CompressionFlags,
+    Operation,
+    StreamFlags,
+    TuningParams,
+)
+from accl_tpu.sequencer import Algorithm, Protocol, select_algorithm
+
+DEFAULTS = dict(
+    max_eager_size=1024,
+    eager_rx_buf_size=1024,
+    tuning=TuningParams.default(),
+)
+
+
+def sel(op, count, nbytes=4, world=8, comp=CompressionFlags.NO_COMPRESSION,
+        stream=StreamFlags.NO_STREAM, **kw):
+    args = dict(DEFAULTS)
+    args.update(kw)
+    return select_algorithm(op, count, nbytes, world, comp, stream, **args)
+
+
+def test_eager_rendezvous_switch():
+    # ccl_offload_control.c:587: > max_eager & uncompressed & non-stream
+    assert sel(Operation.send, 256).protocol == Protocol.EAGER  # 1024B == max
+    assert sel(Operation.send, 257).protocol == Protocol.RENDEZVOUS
+    # compressed messages never go rendezvous
+    assert (
+        sel(Operation.send, 100000, comp=CompressionFlags.ETH_COMPRESSED).protocol
+        == Protocol.EAGER
+    )
+    # streamed operands never go rendezvous
+    assert (
+        sel(Operation.send, 100000, stream=StreamFlags.OP0_STREAM).protocol
+        == Protocol.EAGER
+    )
+
+
+def test_bcast_tree_selection():
+    # .c:814: binary tree when world > BCAST_FLAT_TREE_MAX_RANKS (3)
+    assert sel(Operation.bcast, 10000, world=8).algorithm == Algorithm.RNDZV_BIN_TREE
+    assert sel(Operation.bcast, 10000, world=3).algorithm == Algorithm.RNDZV_FLAT_TREE
+    assert sel(Operation.bcast, 100, world=8).algorithm == Algorithm.EAGER_FLAT
+
+
+def test_reduce_tree_selection():
+    # .c:1531: flat if world <= 4 or bytes <= 32KB, else binary tree
+    assert sel(Operation.reduce, 10000, world=4).algorithm == Algorithm.RNDZV_FLAT_TREE
+    small = sel(Operation.reduce, 2048, world=16)  # 8KB <= 8KB tuning floor
+    assert small.algorithm == Algorithm.RNDZV_FLAT_TREE
+    big = sel(Operation.reduce, 1 << 20, world=16)
+    assert big.algorithm == Algorithm.RNDZV_BIN_TREE
+    assert sel(Operation.reduce, 100, world=16).algorithm == Algorithm.EAGER_RING
+
+
+def test_gather_fanin_tuning():
+    # accl.cpp:1200-1201: fan-in capped at 2 above 32KB
+    big = sel(Operation.gather, 16 * 1024, world=8)  # 64KB
+    assert big.algorithm == Algorithm.RNDZV_FLAT_TREE and big.tree_fanin == 2
+    small = sel(Operation.gather, 2048, world=8)  # 8KB
+    assert small.tree_fanin == 7
+    assert sel(Operation.gather, 100, world=8).algorithm == Algorithm.EAGER_RING
+
+
+def test_allreduce_paths():
+    ar = sel(Operation.allreduce, 100, world=8)
+    assert ar.algorithm == Algorithm.EAGER_RING_RS_AG
+    # .c:1898-1901: eager segment count world-aligned
+    assert ar.seg_count % 8 == 0 or ar.seg_count == 100
+    assert (
+        sel(Operation.allreduce, 1 << 20, world=8).algorithm
+        == Algorithm.RNDZV_REDUCE_BCAST
+    )
+
+
+def test_reduce_scatter_paths():
+    assert sel(Operation.reduce_scatter, 64, world=8).algorithm == Algorithm.EAGER_RING
+    assert (
+        sel(Operation.reduce_scatter, 1 << 20, world=8).algorithm
+        == Algorithm.RNDZV_REDUCE_SCATTER
+    )
+
+
+def test_allgather_ring_both_protocols():
+    assert sel(Operation.allgather, 100).algorithm == Algorithm.EAGER_RING
+    assert sel(Operation.allgather, 1 << 20).algorithm == Algorithm.RNDZV_RING
+
+
+def test_world_of_one_degrades_to_copy():
+    # .c:1875-1877
+    assert sel(Operation.allreduce, 1 << 20, world=1).algorithm == Algorithm.NONE
+
+
+def test_segmentation_math():
+    # eager segments = ceil(count / (rx_buf_bytes / elem_bytes)); a large
+    # compressed message stays eager (.c:587) and so gets segmented
+    p = sel(Operation.send, 1000, nbytes=4, comp=CompressionFlags.ETH_COMPRESSED)
+    assert p.seg_count == 256 and p.num_segments == 4
+    p = sel(Operation.send, 256, nbytes=4)
+    assert p.num_segments == 1
+    # streamed operands are never segmented (.c:929-931)
+    p = sel(Operation.send, 100000, stream=StreamFlags.OP0_STREAM)
+    assert p.num_segments == 1
+
+
+def test_barrier():
+    p = sel(Operation.barrier, 0)
+    assert p.algorithm == Algorithm.BARRIER_GATHER_SCATTER and p.seg_count == 0

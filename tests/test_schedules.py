@@ -1,0 +1,274 @@
+"""Oracle tests for the SPMD collective schedules on the 8-device CPU mesh.
+
+Modeled on the reference gtest suite (test/host/xrt/src/test.cpp:30-1159):
+every collective is checked against a locally computed expected value,
+parameterized over roots, reduce functions, algorithm variants and
+message sizes including segmentation edge cases (count = k*segment ± 1,
+test.cpp:345-393).
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu import (
+    CallOptions,
+    CompressionFlags,
+    DataType,
+    Operation,
+    ReduceFunction,
+    TuningParams,
+)
+from accl_tpu.sequencer import Algorithm, Plan, Protocol, select_algorithm
+from accl_tpu.sequencer.lowering import ScheduleCompiler
+
+WORLD = 8
+RNG = np.random.default_rng(42)
+
+
+def make_compiler(mesh8):
+    return ScheduleCompiler(mesh8)
+
+
+def run(mesh8, scenario, count, *, root=0, func=ReduceFunction.SUM,
+        comp=CompressionFlags.NO_COMPRESSION, dtype=np.float32,
+        force_algorithm=None, inputs=None,
+        max_eager=1024, rx_buf=1024):
+    """Build per-rank inputs, lower the call, execute, return (inputs, out)."""
+    from accl_tpu.constants import from_numpy_dtype
+
+    dt = from_numpy_dtype(np.dtype(dtype))
+    opts = CallOptions(
+        scenario=scenario, count=count, root_src_dst=root,
+        function=int(func), compression_flags=comp, data_type=dt,
+    )
+    plan = select_algorithm(
+        scenario, count, np.dtype(dtype).itemsize, WORLD, comp,
+        max_eager_size=max_eager, eager_rx_buf_size=rx_buf,
+        tuning=TuningParams.default(),
+    )
+    if force_algorithm is not None:
+        plan = Plan(plan.protocol, force_algorithm, plan.seg_count,
+                    plan.num_segments, tree_fanin=plan.tree_fanin)
+    comp_obj = ScheduleCompiler(mesh8)
+    fn = comp_obj.lower(opts, plan)
+    if inputs is None:
+        per_rank_n = {
+            Operation.scatter: count * WORLD,
+            Operation.reduce_scatter: count * WORLD,
+            Operation.alltoall: count * WORLD,
+        }.get(scenario, count)
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            inputs = RNG.integers(-50, 50, size=(WORLD, per_rank_n)).astype(dtype)
+        else:
+            inputs = RNG.standard_normal((WORLD, per_rank_n)).astype(dtype)
+    out = np.asarray(fn(inputs))
+    return inputs, out, plan
+
+
+def tol(dtype, comp=CompressionFlags.NO_COMPRESSION):
+    if comp & CompressionFlags.ETH_COMPRESSED:
+        return dict(rtol=2e-2, atol=2e-1)
+    if np.dtype(dtype) == np.float16:
+        return dict(rtol=2e-2, atol=1e-1)
+    return dict(rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("count", [1, 7, 64, 256, 257, 1000])
+def test_sendrecv(mesh8, count):
+    src, dst = 2, 5
+    opts_root = src | (dst << 16)
+    x, out, _ = run(mesh8, Operation.send, count, root=opts_root)
+    np.testing.assert_allclose(out[dst], x[src], **tol(np.float32))
+    for r in range(WORLD):
+        if r != dst:
+            np.testing.assert_allclose(out[r], x[r], **tol(np.float32))
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+@pytest.mark.parametrize("count,algo", [
+    (64, None),            # eager flat (.c:921-988)
+    (300, None),           # rendezvous: world 8 > 3 -> binary tree (.c:814)
+    (300, Algorithm.RNDZV_FLAT_TREE),
+    (1000, None),
+])
+def test_bcast(mesh8, root, count, algo):
+    x, out, plan = run(mesh8, Operation.bcast, count, root=root,
+                       force_algorithm=algo)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], x[root], **tol(np.float32))
+
+
+@pytest.mark.parametrize("root", [0, 4])
+@pytest.mark.parametrize("count", [16, 300])
+def test_scatter(mesh8, root, count):
+    x, out, _ = run(mesh8, Operation.scatter, count, root=root)
+    for r in range(WORLD):
+        np.testing.assert_allclose(
+            out[r], x[root, r * count:(r + 1) * count], **tol(np.float32))
+
+
+@pytest.mark.parametrize("root", [0, 5])
+@pytest.mark.parametrize("count,algo", [
+    (16, None),                            # eager ring (.c:1206)
+    (300, None),                           # rndzv flat, full fanin
+    (16 * 1024, None),                     # rndzv binomial (fanin 2 tuning)
+    (300, Algorithm.RNDZV_FLAT_TREE),
+])
+def test_gather(mesh8, root, count, algo):
+    x, out, plan = run(mesh8, Operation.gather, count, root=root,
+                       force_algorithm=algo)
+    expected = x.reshape(-1)
+    np.testing.assert_allclose(out[root], expected, **tol(np.float32))
+
+
+@pytest.mark.parametrize("count", [1, 16, 300, 1000])
+def test_allgather(mesh8, count):
+    x, out, _ = run(mesh8, Operation.allgather, count)
+    expected = x.reshape(-1)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], expected, **tol(np.float32))
+
+
+@pytest.mark.parametrize("root", [0, 6])
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+@pytest.mark.parametrize("count,algo", [
+    (16, None),                         # eager ring relay (.c:1730)
+    (2048, None),                       # rndzv flat (<=32KB tuning)
+    (1 << 15, None),                    # rndzv binary tree
+    (300, Algorithm.RNDZV_BIN_TREE),
+])
+def test_reduce(mesh8, root, func, count, algo):
+    x, out, plan = run(mesh8, Operation.reduce, count, root=root, func=func,
+                       force_algorithm=algo)
+    expected = x.sum(0) if func == ReduceFunction.SUM else x.max(0)
+    np.testing.assert_allclose(out[root], expected, **tol(np.float32))
+
+
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+@pytest.mark.parametrize("count", [4, 64, 300])
+def test_reduce_scatter(mesh8, func, count):
+    x, out, _ = run(mesh8, Operation.reduce_scatter, count, func=func)
+    full = x.sum(0) if func == ReduceFunction.SUM else x.max(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(
+            out[r], full[r * count:(r + 1) * count], **tol(np.float32))
+
+
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+@pytest.mark.parametrize("count", [
+    1, 8, 64,          # single segment
+    255, 256, 257,     # segmentation edges (seg = 256 elems, world-aligned)
+    1000, 4096,
+])
+def test_allreduce(mesh8, func, count):
+    x, out, plan = run(mesh8, Operation.allreduce, count, func=func)
+    expected = x.sum(0) if func == ReduceFunction.SUM else x.max(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], expected, **tol(np.float32))
+
+
+def test_allreduce_rendezvous_path(mesh8):
+    x, out, plan = run(mesh8, Operation.allreduce, 1 << 15)
+    assert plan.algorithm == Algorithm.RNDZV_REDUCE_BCAST
+    expected = x.sum(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], expected, **tol(np.float32))
+
+
+@pytest.mark.parametrize("count", [4, 50])
+def test_alltoall(mesh8, count):
+    x, out, _ = run(mesh8, Operation.alltoall, count)
+    for r in range(WORLD):
+        for src in range(WORLD):
+            np.testing.assert_allclose(
+                out[r, src * count:(src + 1) * count],
+                x[src, r * count:(r + 1) * count], **tol(np.float32))
+
+
+def test_barrier(mesh8):
+    token = np.ones((WORLD, 1), np.float32)
+    _, out, _ = run(mesh8, Operation.barrier, 0, inputs=token)
+    assert out.shape == (WORLD, 1)
+
+
+def test_copy_and_combine(mesh8):
+    x, out, _ = run(mesh8, Operation.copy, 64)
+    np.testing.assert_allclose(out, x)
+    from accl_tpu.sequencer.lowering import ScheduleCompiler
+    opts = CallOptions(scenario=Operation.combine, count=64,
+                       function=int(ReduceFunction.MAX),
+                       data_type=DataType.float32)
+    plan = select_algorithm(Operation.combine, 64, 4, WORLD,
+                            max_eager_size=1024, eager_rx_buf_size=1024,
+                            tuning=TuningParams.default())
+    fn = ScheduleCompiler(mesh8).lower(opts, plan)
+    a = RNG.standard_normal((WORLD, 64)).astype(np.float32)
+    b = RNG.standard_normal((WORLD, 64)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fn(a, b)), np.maximum(a, b))
+
+
+# -- compression variants (test.cpp compressed suites) ----------------------
+
+
+@pytest.mark.parametrize("scenario", [
+    Operation.allreduce, Operation.bcast, Operation.allgather,
+    Operation.reduce,
+])
+def test_eth_compressed(mesh8, scenario):
+    count = 3000  # large enough that uncompressed would go rendezvous
+    x, out, plan = run(mesh8, scenario, count,
+                       comp=CompressionFlags.ETH_COMPRESSED)
+    assert plan.protocol == Protocol.EAGER  # compressed never rendezvous
+    c = CompressionFlags.ETH_COMPRESSED
+    if scenario == Operation.allreduce:
+        exp = x.astype(np.float16).astype(np.float32).sum(0)
+        np.testing.assert_allclose(out[0], exp, **tol(np.float32, c))
+    elif scenario == Operation.bcast:
+        np.testing.assert_allclose(out[5], x[0], **tol(np.float32, c))
+    elif scenario == Operation.allgather:
+        np.testing.assert_allclose(
+            out[2], x.reshape(-1), **tol(np.float32, c))
+    elif scenario == Operation.reduce:
+        np.testing.assert_allclose(out[0], x.sum(0), **tol(np.float32, c))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.int32, np.float16])
+def test_allreduce_dtypes(mesh8, dtype):
+    x, out, _ = run(mesh8, Operation.allreduce, 100, dtype=dtype)
+    expected = x.sum(0)
+    np.testing.assert_allclose(out[3].astype(np.float64),
+                               expected.astype(np.float64), **tol(dtype))
+
+
+def test_compressed_domain_reduction(mesh8):
+    """arith_is_compressed (fp32/fp16 row): the reduction must run in the
+    compressed domain — one cast in, P-1 fp16 adds, one cast out."""
+    count = 3000
+    x, out, plan = run(mesh8, Operation.allreduce, count,
+                       comp=CompressionFlags.ETH_COMPRESSED)
+    x16 = x.astype(np.float16)
+    exp = x16[0]
+    for r in range(1, WORLD):  # fp16 accumulation order-independent enough
+        exp = (exp + x16[r]).astype(np.float16)
+    np.testing.assert_allclose(out[0], exp.astype(np.float32),
+                               rtol=5e-2, atol=5e-1)
+
+
+def test_composed_stage_selection_respects_tuning(mesh8):
+    """Rendezvous allreduce stages re-select with live tuning registers
+    (.c:1878-1887): raising bcast_flat_tree_max_ranks must flip the bcast
+    stage from binary tree to flat."""
+    from accl_tpu.sequencer import Protocol
+    t = TuningParams.default()
+    p = select_algorithm(Operation.allreduce, 1 << 15, 4, WORLD,
+                         max_eager_size=1024, eager_rx_buf_size=1024, tuning=t)
+    assert p.stages[1].algorithm == Algorithm.RNDZV_BIN_TREE
+    t2 = TuningParams(bcast_flat_tree_max_ranks=8)
+    p2 = select_algorithm(Operation.allreduce, 1 << 15, 4, WORLD,
+                          max_eager_size=1024, eager_rx_buf_size=1024, tuning=t2)
+    assert p2.stages[1].algorithm == Algorithm.RNDZV_FLAT_TREE
+    # reduce stage honors reduce_flat_tree registers likewise
+    assert p.stages[0].algorithm == Algorithm.RNDZV_BIN_TREE
