@@ -1,0 +1,371 @@
+"""The ACCL driver facade: the user-facing API of the framework.
+
+Reference: driver/xrt/include/accl.hpp:45-1131 / src/accl.cpp — the
+facade owns initialization (buffer rings, communicator, arithmetic
+configs, tuning registers), exposes every collective in sync and async
+forms with host/device sync control and optional wire compression, and
+routes calls to an interchangeable device backend.
+
+TPU shape of the API: one controller drives a communicator whose ranks
+are devices on a mesh axis. Buffers are stacked (world, n) arrays
+sharded across the axis. `from_device`/`to_device` mirror the
+reference's from_fpga/to_fpga: they skip the host<->HBM syncs so chained
+collectives stay on-device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .arithconfig import DEFAULT_ARITH_CONFIG, validate_arith_config
+from .buffers import BaseBuffer, DummyBuffer, TPUBuffer
+from .communicator import Communicator, Rank
+from .constants import (
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    DEFAULT_NUM_EAGER_RX_BUFS,
+    CfgFunc,
+    CompressionFlags,
+    DataType,
+    HostFlags,
+    Operation,
+    ReduceFunction,
+    StreamFlags,
+    TAG_ANY,
+    TuningParams,
+    to_numpy_dtype,
+)
+from .descriptor import CallOptions
+from .device.base import CCLOAddr
+from .device.tpu_device import TPUDevice
+from .request import BaseRequest
+
+
+class ACCL:
+    """Driver facade over a device backend (reference ACCL class)."""
+
+    def __init__(
+        self,
+        mesh: Mesh | None = None,
+        axis_name: str = "ccl",
+        device=None,
+        n_egr_rx_bufs: int = DEFAULT_NUM_EAGER_RX_BUFS,
+        egr_rx_buf_size: int = DEFAULT_EAGER_RX_BUF_SIZE,
+        max_eager_size: int = DEFAULT_MAX_EAGER_SIZE,
+        max_rendezvous_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE,
+        arith_config: dict | None = None,
+    ):
+        if device is None:
+            if mesh is None:
+                raise ValueError("provide a mesh or an explicit device backend")
+            device = TPUDevice(mesh, axis_name)
+        self.cclo = device
+        self.mesh = getattr(device, "mesh", mesh)
+        self.axis_name = getattr(device, "axis_name", axis_name)
+        self.arith_config = validate_arith_config(arith_config or DEFAULT_ARITH_CONFIG)
+        self._config = dict(
+            n_egr_rx_bufs=n_egr_rx_bufs,
+            egr_rx_buf_size=egr_rx_buf_size,
+            max_eager_size=max_eager_size,
+            max_rendezvous_size=max_rendezvous_size,
+        )
+        self.communicators: list[Communicator] = []
+        self._initialized = False
+        self._last_request: BaseRequest | None = None
+        self.initialize()
+
+    # ------------------------------------------------------------------ #
+    # bring-up (reference ACCL::initialize, accl.cpp:1066-1114)
+    # ------------------------------------------------------------------ #
+
+    def initialize(self):
+        if self._initialized:
+            raise RuntimeError("ACCL already initialized (CFGRDY set)")
+        cfg = self._config
+        dev = self.cclo
+        # rx-ring + threshold config words (setup_eager_rx_buffers analog,
+        # accl.cpp:1131-1172: descriptor table first, count written last).
+        dev.write(CCLOAddr.EGR_RX_BUF_SIZE, cfg["egr_rx_buf_size"])
+        dev.write(CCLOAddr.NUM_EGR_RX_BUFS, cfg["n_egr_rx_bufs"])
+        dev.eager_rx_buf_size = cfg["egr_rx_buf_size"]
+        # default communicator over the whole axis
+        world = dev.world
+        ranks = [Rank(device_index=i, session_id=i) for i in range(world)]
+        self.communicators.append(Communicator(ranks, 0, CCLOAddr.DYNAMIC_BASE))
+        self._write_communicator(self.communicators[0])
+        # arithmetic configs -> exchange memory (configure_arithmetic,
+        # accl.cpp:1116-1125)
+        addr = CCLOAddr.DYNAMIC_BASE + 4 * (2 + world * Communicator.WORDS_PER_RANK)
+        for key, ac in self.arith_config.items():
+            ac.set_exchmem(addr)
+            addr += 4 * 8  # eight words per config row (arithconfig.hpp layout)
+        # tuning registers (configure_tuning_parameters, accl.cpp:1198-1208)
+        tuning = TuningParams.default(cfg["max_rendezvous_size"])
+        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN, tuning.gather_flat_tree_max_fanin)
+        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, tuning.gather_flat_tree_max_count)
+        dev.write(CCLOAddr.BCAST_FLAT_TREE_MAX_RANKS, tuning.bcast_flat_tree_max_ranks)
+        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_RANKS, tuning.reduce_flat_tree_max_ranks)
+        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT, tuning.reduce_flat_tree_max_count)
+        # thresholds via config calls (accl.cpp:1096-1109)
+        self._config_call(CfgFunc.set_max_eager_msg_size, cfg["max_eager_size"])
+        self._config_call(CfgFunc.set_max_rendezvous_msg_size, cfg["max_rendezvous_size"])
+        self._config_call(CfgFunc.enable_pkt, 0)
+        dev.write(CCLOAddr.CFGRDY, 1)
+        self._initialized = True
+
+    def _config_call(self, fn: CfgFunc, value: int):
+        req = self.cclo.call(
+            CallOptions(scenario=Operation.config, function=int(fn), count=value)
+        )
+        req.check()
+
+    def deinit(self):
+        self._config_call(CfgFunc.reset_periph, 0)
+        self.cclo.write(CCLOAddr.CFGRDY, 0)
+        self._initialized = False
+
+    def _write_communicator(self, comm: Communicator):
+        for i, w in enumerate(comm.exchmem_words()):
+            self.cclo.write(comm.exchmem_addr + 4 * i, w)
+
+    # ------------------------------------------------------------------ #
+    # buffers
+    # ------------------------------------------------------------------ #
+
+    @property
+    def world(self) -> int:
+        return self.cclo.world
+
+    def _sharding(self):
+        return NamedSharding(self.mesh, PartitionSpec(self.axis_name))
+
+    def create_buffer(
+        self, count: int, dtype=np.float32, data: np.ndarray | None = None
+    ) -> TPUBuffer:
+        """Allocate a stacked (world, count) rank buffer in HBM (the
+        reference's create_buffer factories, accl.hpp:760-987)."""
+        if isinstance(dtype, DataType):
+            dtype = to_numpy_dtype(dtype)
+        if data is None:
+            data = np.zeros((self.world, count), dtype)
+        else:
+            data = np.asarray(data, dtype).reshape(self.world, count)
+        buf = TPUBuffer(data, self._sharding())
+        self.cclo.register_buffer(buf)
+        return buf
+
+    def free_buffer(self, buf: BaseBuffer):
+        self.cclo.unregister_buffer(buf)
+
+    # ------------------------------------------------------------------ #
+    # prepare_call: dtype/compression resolution (accl.cpp:1236-1356)
+    # ------------------------------------------------------------------ #
+
+    def _prepare(
+        self,
+        scenario: Operation,
+        op0: BaseBuffer | None,
+        op1: BaseBuffer | None,
+        res: BaseBuffer | None,
+        count: int,
+        root_src_dst: int = 0,
+        function: int = 0,
+        tag: int = TAG_ANY,
+        compress_dtype: DataType | None = None,
+    ) -> CallOptions:
+        dtype = None
+        for b in (op0, op1, res):
+            if b is not None and not isinstance(b, DummyBuffer):
+                if dtype is None:
+                    dtype = b.data_type
+                elif b.data_type != dtype:
+                    raise NotImplementedError(
+                        "mixed-dtype operands: use compress_dtype for wire "
+                        "compression instead"
+                    )
+        comp = CompressionFlags.NO_COMPRESSION
+        arithcfg_addr = 0
+        if dtype is not None:
+            pair = (dtype, compress_dtype or dtype)
+            if pair not in self.arith_config:
+                raise ValueError(f"no arithmetic configuration for {pair}")
+            if compress_dtype is not None and compress_dtype != dtype:
+                comp |= CompressionFlags.ETH_COMPRESSED
+            arithcfg_addr = self.arith_config[pair].addr()
+        return CallOptions(
+            scenario=scenario,
+            count=count,
+            comm_addr=self.communicators[0].exchmem_addr,
+            root_src_dst=root_src_dst,
+            function=function,
+            tag=tag,
+            arithcfg_addr=arithcfg_addr,
+            compression_flags=comp,
+            stream_flags=StreamFlags.NO_STREAM,
+            host_flags=HostFlags.NO_HOST,
+            addr_0=0 if op0 is None else op0.address,
+            addr_1=0 if op1 is None else op1.address,
+            addr_2=0 if res is None else res.address,
+            data_type=dtype or DataType.none,
+            compress_dtype=compress_dtype or DataType.none,
+        )
+
+    def _execute(
+        self,
+        opts: CallOptions,
+        sync_in: list[BaseBuffer],
+        sync_out: list[BaseBuffer],
+        from_device: bool,
+        to_device: bool,
+        run_async: bool,
+    ):
+        if not from_device:
+            for b in sync_in:
+                b.sync_to_device()
+        req = self.cclo.start(opts)
+        self._last_request = req
+        if run_async:
+            req._accl_sync_out = [] if to_device else sync_out
+            return req
+        req.wait()
+        req.check()
+        if not to_device:
+            for b in sync_out:
+                b.sync_from_device()
+        return req
+
+    def wait(self, req: BaseRequest):
+        """Complete an async request (sync-out deferred at start time)."""
+        req.wait()
+        req.check()
+        for b in getattr(req, "_accl_sync_out", []):
+            b.sync_from_device()
+        return req
+
+    def get_duration_ns(self, req: BaseRequest | None = None) -> int:
+        req = req or self._last_request
+        return 0 if req is None else req.get_duration_ns()
+
+    # ------------------------------------------------------------------ #
+    # primitives & collectives (reference accl.cpp:122-944)
+    # ------------------------------------------------------------------ #
+
+    def nop(self):
+        return self.cclo.call(CallOptions(scenario=Operation.nop))
+
+    def copy(self, srcbuf, dstbuf, count, *, from_device=False, to_device=False,
+             run_async=False):
+        opts = self._prepare(Operation.copy, srcbuf, None, dstbuf, count)
+        return self._execute(opts, [srcbuf], [dstbuf], from_device, to_device,
+                             run_async)
+
+    def combine(self, count, function, op0, op1, res, *, from_device=False,
+                to_device=False, run_async=False):
+        opts = self._prepare(Operation.combine, op0, op1, res, count,
+                             function=int(function))
+        return self._execute(opts, [op0, op1], [res], from_device, to_device,
+                             run_async)
+
+    def send(self, srcbuf, count, src, dst, tag=TAG_ANY, *, from_device=False,
+             run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.send, srcbuf, None, None, count,
+                             root_src_dst=src | (dst << 16), tag=tag,
+                             compress_dtype=compress_dtype)
+        return self._execute(opts, [srcbuf], [], from_device, True, run_async)
+
+    def recv(self, dstbuf, count, src, dst, tag=TAG_ANY, *, to_device=False,
+             run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.recv, None, None, dstbuf, count,
+                             root_src_dst=src | (dst << 16), tag=tag,
+                             compress_dtype=compress_dtype)
+        return self._execute(opts, [], [dstbuf], True, to_device, run_async)
+
+    def bcast(self, buf, count, root, *, from_device=False, to_device=False,
+              run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.bcast, buf, None, buf, count,
+                             root_src_dst=root, compress_dtype=compress_dtype)
+        return self._execute(opts, [buf], [buf], from_device, to_device,
+                             run_async)
+
+    def scatter(self, sendbuf, recvbuf, count, root, *, from_device=False,
+                to_device=False, run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.scatter, sendbuf, None, recvbuf, count,
+                             root_src_dst=root, compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def gather(self, sendbuf, recvbuf, count, root, *, from_device=False,
+               to_device=False, run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.gather, sendbuf, None, recvbuf, count,
+                             root_src_dst=root, compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def allgather(self, sendbuf, recvbuf, count, *, from_device=False,
+                  to_device=False, run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.allgather, sendbuf, None, recvbuf,
+                             count, compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def reduce(self, sendbuf, recvbuf, count, root, function, *,
+               from_device=False, to_device=False, run_async=False,
+               compress_dtype=None):
+        opts = self._prepare(Operation.reduce, sendbuf, None, recvbuf, count,
+                             root_src_dst=root, function=int(function),
+                             compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def allreduce(self, sendbuf, recvbuf, count, function, *,
+                  from_device=False, to_device=False, run_async=False,
+                  compress_dtype=None):
+        opts = self._prepare(Operation.allreduce, sendbuf, None, recvbuf,
+                             count, function=int(function),
+                             compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def reduce_scatter(self, sendbuf, recvbuf, count, function, *,
+                       from_device=False, to_device=False, run_async=False,
+                       compress_dtype=None):
+        opts = self._prepare(Operation.reduce_scatter, sendbuf, None, recvbuf,
+                             count, function=int(function),
+                             compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def alltoall(self, sendbuf, recvbuf, count, *, from_device=False,
+                 to_device=False, run_async=False, compress_dtype=None):
+        opts = self._prepare(Operation.alltoall, sendbuf, None, recvbuf,
+                             count, compress_dtype=compress_dtype)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def barrier(self):
+        opts = self._prepare(Operation.barrier, None, None, None, 0)
+        req = self.cclo.start(opts)
+        req.wait()
+        req.check()
+        return req
+
+    # ------------------------------------------------------------------ #
+    # housekeeping / observability
+    # ------------------------------------------------------------------ #
+
+    def set_timeout(self, value: int):
+        self._config_call(CfgFunc.set_timeout, value)
+
+    def set_max_eager_size(self, value: int):
+        self._config_call(CfgFunc.set_max_eager_msg_size, value)
+
+    def set_max_rendezvous_size(self, value: int):
+        self._config_call(CfgFunc.set_max_rendezvous_msg_size, value)
+
+    def dump_exchange_memory(self) -> str:
+        return self.cclo.dump_exchange_memory()
+
+    def dump_communicator(self) -> str:
+        return self.communicators[0].dump()

@@ -1,0 +1,123 @@
+"""Buffer hierarchy: host-mirrored device buffers.
+
+Reference semantics: driver/xrt/include/accl/buffer.hpp:32-204 — a
+BaseBuffer pairs a host pointer with a device allocation, with explicit
+sync_to_device/sync_from_device, slicing, a device address for call
+descriptors, and backend-specific subclasses (XRTBuffer/SimBuffer/
+CoyoteBuffer/DummyBuffer).
+
+TPU mapping: the device allocation is a jax.Array laid out as a stacked
+(world, n) array sharded over the collective mesh axis, so device r's
+shard is rank r's buffer — the HBM analog of per-FPGA DDR buffers. Host
+mirrors are numpy. Addresses are allocated from a per-context virtual
+arena so descriptors, exchange-memory dumps and the native emulator agree
+on buffer identity.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import numpy as np
+
+from .constants import DataType, from_numpy_dtype
+
+_addr_arena = itertools.count(0x1000_0000, 0x100_0000)
+
+
+class BaseBuffer:
+    """Common buffer interface (reference buffer.hpp:32-95)."""
+
+    def __init__(self, shape, dtype, address=None):
+        self.shape = tuple(shape)
+        self.np_dtype = np.dtype(dtype)
+        self.address = next(_addr_arena) if address is None else address
+
+    @property
+    def count(self) -> int:
+        """Elements per rank (the descriptor's count field)."""
+        return int(np.prod(self.shape[1:])) if len(self.shape) > 1 else self.shape[0]
+
+    @property
+    def data_type(self) -> DataType:
+        return from_numpy_dtype(self.np_dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * self.np_dtype.itemsize
+
+    def sync_to_device(self):
+        raise NotImplementedError
+
+    def sync_from_device(self):
+        raise NotImplementedError
+
+
+class TPUBuffer(BaseBuffer):
+    """A (world, n) stacked rank buffer sharded over the mesh axis.
+
+    The host mirror (`host`) is numpy; `device` is the sharded jax.Array.
+    sync_to_device/sync_from_device move whole images, like the reference's
+    explicit DMA syncs (buffer.hpp:60-72) — collectives can then run
+    `from_fpga/to_fpga`-style without host round-trips.
+    """
+
+    def __init__(self, host: np.ndarray, sharding, host_only: bool = False):
+        super().__init__(host.shape, host.dtype)
+        self.host = host
+        self.sharding = sharding
+        self.host_only = host_only
+        self.device: jax.Array | None = None
+        if not host_only:
+            self.sync_to_device()
+
+    def sync_to_device(self):
+        self.device = jax.device_put(self.host, self.sharding)
+        return self
+
+    def sync_from_device(self):
+        if self.device is not None:
+            self.host = np.asarray(jax.device_get(self.device))
+        return self
+
+    def write(self, data: np.ndarray):
+        data = np.asarray(data, self.np_dtype).reshape(self.shape)
+        self.host = data
+        return self
+
+    def rank_view(self, rank: int) -> np.ndarray:
+        """Host view of one rank's buffer."""
+        return self.host[rank]
+
+
+class EmuBuffer(BaseBuffer):
+    """A per-rank host buffer registered with the native emulator runtime
+    (reference SimBuffer, driver/xrt/include/accl/simbuffer.hpp): memory
+    lives in this process, the runtime addresses it by `address`."""
+
+    def __init__(self, host: np.ndarray, address=None):
+        super().__init__((1,) + tuple(host.shape), host.dtype, address)
+        self.host = host
+
+    def sync_to_device(self):
+        return self
+
+    def sync_from_device(self):
+        return self
+
+
+class DummyBuffer(BaseBuffer):
+    """Placeholder for unused operands (reference dummybuffer.hpp; used by
+    prepare_call for absent operands, accl.cpp:1243-1268)."""
+
+    def __init__(self):
+        super().__init__((0,), np.float32, address=0)
+        self.host = np.zeros((0,), np.float32)
+        self.device = None
+
+    def sync_to_device(self):
+        return self
+
+    def sync_from_device(self):
+        return self

@@ -43,9 +43,12 @@ class CallOptions:
     addr_0: int = 0  # operand 0 (send buffer)
     addr_1: int = 0  # operand 1 (second reduction operand)
     addr_2: int = 0  # result buffer
-    # TPU-path extras (not serialized into the 15-word form): static dtype
-    # so compiled schedules can be cached per signature.
+    # TPU-path extras (not serialized into the 15-word form): static dtypes
+    # so compiled schedules can be cached per signature. compress_dtype is
+    # the wire dtype requested by the caller (prepare_call's compressed
+    # operand resolution, reference accl.cpp:1236-1356).
     data_type: DataType = DataType.none
+    compress_dtype: DataType = DataType.none
 
     def to_words(self) -> list[int]:
         """Serialize into the 15-word call stream layout (accl_hls.h:134-198):
@@ -103,6 +106,7 @@ class CallOptions:
             self.root_src_dst,
             self.function,
             self.data_type,
+            self.compress_dtype,
             int(self.compression_flags),
             int(self.stream_flags),
             int(self.host_flags),

@@ -1,0 +1,14 @@
+"""Device backends: where call descriptors are executed.
+
+Reference structure: driver/xrt/include/accl/cclo.hpp:85-89 enumerates
+three interchangeable backends (XRTDevice for hardware, SimDevice for the
+emulator, CoyoteDevice for the Coyote shell). Here:
+
+  TPUDevice  - compiled-schedule execution over a jax mesh (the hardware
+               backend; ICI transport)
+  EmuDevice  - the native C++ multi-rank emulator over sockets (the
+               SimDevice analog; see native/)
+"""
+
+from .base import CCLODevice, CCLOAddr  # noqa: F401
+from .tpu_device import TPUDevice  # noqa: F401

@@ -1,0 +1,88 @@
+"""Abstract CCLO device + exchange-memory model.
+
+Reference: driver/xrt/include/accl/cclo.hpp:41-203 — a device executes
+call descriptors (call/start), exposes MMIO read/write into exchange
+memory, and reports retcode/duration per request. The exchange-memory
+register map mirrors constants.hpp:139-154 so dumps and config writes are
+recognizable to anyone who knows the reference.
+"""
+
+from __future__ import annotations
+
+from ..constants import EXCHMEM_SIZE
+from ..descriptor import CallOptions
+from ..request import BaseRequest
+
+
+class CCLOAddr:
+    """Exchange-memory register offsets (reference CCLO_ADDR namespace,
+    constants.hpp:139-154)."""
+
+    RETCODE = 0x1FFC
+    IDCODE = 0x1FF8
+    CFGRDY = 0x1FF4
+    PERFCNT = 0x1FF0
+    SPARE3 = 0x1FE8
+    SPARE2 = 0x1FE0
+    SPARE1 = 0x1FD8
+    REDUCE_FLAT_TREE_MAX_COUNT = 0x1FD4
+    REDUCE_FLAT_TREE_MAX_RANKS = 0x1FD0
+    BCAST_FLAT_TREE_MAX_RANKS = 0x1FCC
+    GATHER_FLAT_TREE_MAX_COUNT = 0x1FC8
+    GATHER_FLAT_TREE_MAX_FANIN = 0x1FC4
+    EGR_RX_BUF_SIZE = 0x4
+    NUM_EGR_RX_BUFS = 0x0
+    # Start of the dynamically-laid-out region (communicators, arith
+    # configs), after the rx-ring descriptor table.
+    DYNAMIC_BASE = 0x200
+
+
+# The hardware id this framework reports, with capability bits analogous
+# to the reference HWID decode (accl.cpp:1050-1064).
+ACCL_TPU_IDCODE = 0xACC1_7B00
+
+
+class CCLODevice:
+    """Backend interface: execute descriptors, expose exchange memory."""
+
+    def __init__(self):
+        # Word-addressed exchange-memory model, 8 KB like the BRAM
+        # (ccl_offload_control.h:85-98).
+        self._exchmem: dict[int, int] = {CCLOAddr.IDCODE: ACCL_TPU_IDCODE}
+
+    # -- MMIO -------------------------------------------------------------
+
+    def read(self, addr: int) -> int:
+        self._check_addr(addr)
+        return self._exchmem.get(addr, 0)
+
+    def write(self, addr: int, value: int) -> None:
+        self._check_addr(addr)
+        self._exchmem[addr] = value & 0xFFFFFFFF
+
+    def _check_addr(self, addr: int):
+        if not 0 <= addr < EXCHMEM_SIZE:
+            raise ValueError(f"exchange-memory address {addr:#x} out of range")
+
+    def dump_exchange_memory(self) -> str:
+        """Reference ACCL::dump_exchange_memory (accl.cpp:964-1048)."""
+        lines = ["exchange memory:"]
+        for addr in sorted(self._exchmem):
+            lines.append(f"  [{addr:#06x}] = {self._exchmem[addr]:#010x}")
+        return "\n".join(lines)
+
+    # -- calls ------------------------------------------------------------
+
+    def call(self, options: CallOptions) -> BaseRequest:
+        """Synchronous call: start + wait + store retcode."""
+        req = self.start(options)
+        req.wait()
+        self.write(CCLOAddr.RETCODE, req.retcode)
+        self.write(CCLOAddr.PERFCNT, req.duration_ns & 0xFFFFFFFF)
+        return req
+
+    def start(self, options: CallOptions) -> BaseRequest:
+        raise NotImplementedError
+
+    def deinit(self):
+        pass

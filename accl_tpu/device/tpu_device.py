@@ -1,0 +1,233 @@
+"""TPUDevice: descriptor execution as compiled mesh programs.
+
+The hardware backend (reference XRTDevice, driver/xrt/src/xrtdevice.cpp):
+where XRTDevice latches descriptor words into the hostctrl kernel and an
+on-FPGA firmware loop interprets them, TPUDevice resolves the descriptor's
+buffer addresses against its buffer registry, asks the sequencer for a
+plan, and launches the cached compiled schedule — one device program per
+collective, with XLA's async dispatch standing in for the hardware call
+FIFO. Single-controller SPMD replaces per-rank MPI processes: one call
+executes the collective for every rank in the communicator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..constants import (
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    CfgFunc,
+    ErrorCode,
+    Operation,
+    TAG_ANY,
+    TuningParams,
+    dtype_nbytes,
+)
+from ..descriptor import CallOptions
+from ..request import BaseRequest, TPURequest
+from ..sequencer.lowering import ScheduleCompiler
+from ..sequencer.plan import select_algorithm
+from .base import CCLOAddr, CCLODevice
+
+
+class TPUDevice(CCLODevice):
+    def __init__(self, mesh, axis_name: str = "ccl"):
+        super().__init__()
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.compiler = ScheduleCompiler(mesh, axis_name)
+        self.buffers: dict[int, object] = {}  # address -> TPUBuffer
+        self.timeout = 1_000_000
+        self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
+        self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
+        self.eager_rx_buf_size = DEFAULT_EAGER_RX_BUF_SIZE
+        self.pkt_enabled = False
+        # Pending sends awaiting their recv partner (single-controller
+        # pairing of the MPI-style send/recv API).
+        self._pending_sends: dict[tuple, CallOptions] = {}
+
+    # -- registry ---------------------------------------------------------
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def register_buffer(self, buf) -> None:
+        self.buffers[buf.address] = buf
+
+    def unregister_buffer(self, buf) -> None:
+        self.buffers.pop(buf.address, None)
+
+    def _buf(self, addr: int):
+        if addr == 0:
+            return None
+        try:
+            return self.buffers[addr]
+        except KeyError:
+            raise KeyError(f"no buffer registered at address {addr:#x}") from None
+
+    # -- tuning registers (exchange-memory backed) ------------------------
+
+    def tuning(self) -> TuningParams:
+        rd = self.read
+        defaults = TuningParams.default(self.max_rendezvous_size)
+        return TuningParams(
+            gather_flat_tree_max_fanin=rd(CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN)
+            or defaults.gather_flat_tree_max_fanin,
+            gather_flat_tree_max_count=rd(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT)
+            or defaults.gather_flat_tree_max_count,
+            bcast_flat_tree_max_ranks=rd(CCLOAddr.BCAST_FLAT_TREE_MAX_RANKS)
+            or defaults.bcast_flat_tree_max_ranks,
+            reduce_flat_tree_max_ranks=rd(CCLOAddr.REDUCE_FLAT_TREE_MAX_RANKS)
+            or defaults.reduce_flat_tree_max_ranks,
+            reduce_flat_tree_max_count=rd(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT)
+            or defaults.reduce_flat_tree_max_count,
+        )
+
+    # -- execution --------------------------------------------------------
+
+    def start(self, options: CallOptions) -> BaseRequest:
+        if options.scenario == Operation.config:
+            return self._config(options)
+        if options.scenario == Operation.nop:
+            req = BaseRequest("nop")
+            req.running()
+            req.complete(0)
+            return req
+        if options.scenario == Operation.send:
+            return self._enqueue_send(options)
+        if options.scenario == Operation.recv:
+            return self._match_recv(options)
+        return self._launch(options)
+
+    def _launch(self, options: CallOptions) -> BaseRequest:
+        plan = select_algorithm(
+            options.scenario,
+            options.count,
+            dtype_nbytes(options.data_type),
+            self.world,
+            options.compression_flags,
+            options.stream_flags,
+            max_eager_size=self.max_eager_size,
+            eager_rx_buf_size=self.eager_rx_buf_size,
+            tuning=self.tuning(),
+        )
+        fn = self.compiler.lower(options, plan)
+
+        op0 = self._buf(options.addr_0)
+        op1 = self._buf(options.addr_1)
+        res = self._buf(options.addr_2)
+        args = []
+        n = options.count
+        scen = options.scenario
+        in_n = n * self.world if scen in (
+            Operation.scatter,
+            Operation.reduce_scatter,
+            Operation.alltoall,
+        ) else n
+        if scen == Operation.barrier:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            token_sharding = NamedSharding(self.mesh, PartitionSpec(self.axis_name))
+            args.append(
+                jax.device_put(np.ones((self.world, 1), np.float32), token_sharding)
+            )
+        else:
+            args.append(_slice_to(op0.device, in_n))
+            if scen == Operation.combine:
+                args.append(_slice_to(op1.device, in_n))
+
+        out = fn(*args)
+
+        def place(req):
+            if res is not None and scen != Operation.barrier:
+                res.device = _place_into(res.device, out)
+
+        req = TPURequest(options.scenario.name, [out], on_complete=place)
+        req.plan = plan
+        return req
+
+    # -- send/recv pairing ------------------------------------------------
+
+    def _enqueue_send(self, options: CallOptions) -> BaseRequest:
+        """Single-controller pairing: a send parks its descriptor until the
+        matching recv arrives, the role the eager rx-ring notification
+        queue plays per-rank in the reference (rxbuf_seek.cpp:20-79)."""
+        src = options.root_src_dst & 0xFFFF
+        dst = (options.root_src_dst >> 16) & 0xFFFF
+        self._pending_sends[(src, dst, options.tag)] = options
+        req = BaseRequest("send")
+        req.running()
+        req.complete(0)
+        return req
+
+    def _match_recv(self, options: CallOptions) -> BaseRequest:
+        src = options.root_src_dst & 0xFFFF
+        dst = (options.root_src_dst >> 16) & 0xFFFF
+        match = None
+        for (s, d, tag) in self._pending_sends:
+            if s == src and d == dst and (
+                tag == options.tag or TAG_ANY in (tag, options.tag)
+            ):
+                match = (s, d, tag)
+                break
+        if match is None:
+            req = BaseRequest("recv")
+            req.running()
+            req.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+            return req
+        send_opts = self._pending_sends.pop(match)
+        pair = CallOptions(
+            scenario=Operation.send,
+            count=options.count,
+            root_src_dst=src | (dst << 16),
+            tag=match[2],
+            compression_flags=options.compression_flags,
+            stream_flags=options.stream_flags,
+            data_type=options.data_type,
+            addr_0=send_opts.addr_0,
+            addr_2=options.addr_2,
+        )
+        return self._launch(pair)
+
+    # -- config calls (ACCL_CONFIG switch, .c:2416-2452) -------------------
+
+    def _config(self, options: CallOptions) -> BaseRequest:
+        req = BaseRequest(f"config/{CfgFunc(options.function).name}")
+        req.running()
+        fn = CfgFunc(options.function)
+        if fn == CfgFunc.reset_periph:
+            self._pending_sends.clear()
+            self.compiler._cache.clear()
+        elif fn == CfgFunc.enable_pkt:
+            self.pkt_enabled = True
+        elif fn == CfgFunc.set_timeout:
+            self.timeout = options.count
+        elif fn == CfgFunc.set_max_eager_msg_size:
+            # value arrives in the count field (.c:2432-2439)
+            if options.count > self.eager_rx_buf_size:
+                req.complete(int(ErrorCode.EAGER_THRESHOLD_INVALID))
+                return req
+            self.max_eager_size = options.count
+        elif fn == CfgFunc.set_max_rendezvous_msg_size:
+            self.max_rendezvous_size = options.count
+        req.complete(0)
+        return req
+
+
+def _slice_to(arr, n: int):
+    return arr if arr.shape[-1] == n else arr[..., :n]
+
+
+def _place_into(dst, out):
+    """Write a program result into a (possibly wider) result buffer."""
+    if dst.shape == out.shape:
+        return out
+    return jax.jit(
+        lambda d, o: jax.lax.dynamic_update_slice_in_dim(
+            d, o.astype(d.dtype), 0, axis=-1
+        )
+    )(dst, out)

@@ -1,0 +1,167 @@
+"""Pallas TPU kernels: the hardware form of the plugin layer.
+
+The reference's plugin kernels are synthesizable HLS operating on 512-bit
+AXI streams at 64 B/cycle: reduce_ops (elementwise SUM/MAX per TDEST,
+kernels/plugins/reduce_ops/reduce_ops.cpp:31-107) and hp_compression
+(fp32<->fp16 casts, kernels/plugins/hp_compression/hp_compression.cpp:30-60).
+Here the same roles are VPU kernels written in Pallas, tiled to VMEM with a
+1D grid over row blocks; they exist both as standalone entry points (so the
+plugin layer is measurable in isolation, like the reference's kernel
+testbenches) and fused inside the ring-allreduce kernel in ring_allreduce.py.
+
+On CPU these run under interpret mode (the emulator posture of the test
+suite); on TPU they compile to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _MEMSPACE = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _MEMSPACE = None
+
+# Row-block each kernel instance processes; 512 lanes x 8 sublanes of fp32
+# comfortably under VMEM limits with double buffering.
+_BLOCK_ROWS = 512
+_LANES = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _pad_rows(x, rows):
+    rem = (-x.shape[0]) % rows
+    if rem:
+        x = jnp.pad(x, ((0, rem), (0, 0)))
+    return x
+
+
+def _as_tiles(x):
+    """Reshape a flat buffer to (rows, 128) lanes, padding the tail."""
+    n = x.shape[-1]
+    rows = -(-n // _LANES)
+    flat = jnp.pad(x, (0, rows * _LANES - n))
+    return flat.reshape(rows, _LANES), n
+
+
+def _from_tiles(t, n):
+    return t.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# reduce_ops: elementwise combine kernel
+# ---------------------------------------------------------------------------
+
+
+def _combine_kernel(op, a_ref, b_ref, o_ref):
+    a = a_ref[...]
+    b = b_ref[...]
+    o_ref[...] = jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def combine_pallas(a, b, op: str = "sum", interpret: bool | None = None):
+    """Elementwise SUM/MAX over two flat buffers via Pallas (reduce_ops
+    stream_add/stream_max analog, reduce_ops.cpp:31-73)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    at, n = _as_tiles(a)
+    bt, _ = _as_tiles(b)
+    at = _pad_rows(at, _BLOCK_ROWS)
+    bt = _pad_rows(bt, _BLOCK_ROWS)
+    grid = (at.shape[0] // _BLOCK_ROWS,)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_combine_kernel, op),
+        out_shape=jax.ShapeDtypeStruct(at.shape, at.dtype),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(at, bt)
+    return _from_tiles(out, n)
+
+
+# ---------------------------------------------------------------------------
+# hp_compression: cast-compression kernel
+# ---------------------------------------------------------------------------
+
+
+def _cast_kernel(dtype, x_ref, o_ref):
+    o_ref[...] = x_ref[...].astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("to_dtype", "interpret"))
+def cast_pallas(x, to_dtype, interpret: bool | None = None):
+    """Streaming dtype cast (hp_compression fp2hp/hp2fp analog) — one VMEM
+    pass, grid over row blocks."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    xt, n = _as_tiles(x)
+    xt = _pad_rows(xt, _BLOCK_ROWS)
+    grid = (xt.shape[0] // _BLOCK_ROWS,)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_cast_kernel, to_dtype),
+        out_shape=jax.ShapeDtypeStruct(xt.shape, to_dtype),
+        grid=grid,
+        in_specs=[spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(xt)
+    return _from_tiles(out, n)
+
+
+# ---------------------------------------------------------------------------
+# fused combine+cast: the compressed-reduction inner op (arith lane in the
+# compressed domain with decompress-in / compress-out, the role of the
+# clane segmenter + arith plugin chain in the reference datapath)
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel(op, acc_dtype, a_ref, b_ref, o_ref):
+    a = a_ref[...].astype(acc_dtype)
+    b = b_ref[...].astype(acc_dtype)
+    r = jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
+    o_ref[...] = r.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("op", "acc_dtype", "out_dtype", "interpret")
+)
+def fused_combine_cast_pallas(
+    a, b, op="sum", acc_dtype=jnp.float32, out_dtype=None, interpret=None
+):
+    """Combine in acc_dtype, emit in out_dtype — one VMEM pass instead of
+    decompress + reduce + compress round-trips through HBM."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    out_dtype = out_dtype or a.dtype
+    at, n = _as_tiles(a)
+    bt, _ = _as_tiles(b)
+    at = _pad_rows(at, _BLOCK_ROWS)
+    bt = _pad_rows(bt, _BLOCK_ROWS)
+    grid = (at.shape[0] // _BLOCK_ROWS,)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, op, acc_dtype),
+        out_shape=jax.ShapeDtypeStruct(at.shape, jnp.dtype(out_dtype)),
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(at, bt)
+    return _from_tiles(out, n)

@@ -1,0 +1,151 @@
+"""Fused ring allreduce as a single Pallas TPU kernel.
+
+The performance form of the eager segmented ring allreduce
+(ccl_offload_control.c:1888-2071): where the lax schedule in
+sequencer/schedules.py emits one XLA collective-permute per hop, this
+kernel drives the ICI links directly with async remote DMAs
+(pltpu.make_async_remote_copy) and fuses the recv-reduce step
+(.c:755-789's fused recv-reduce-send) into the same VMEM pass — no HBM
+round-trip between hops.
+
+Structure per device: P-1 reduce-scatter hops (accumulator travels the
+ring, each hop adds the local copy of the arriving chunk) then P-1
+allgather hops (reduced chunks relay around). Double-slotted comm buffers
++ DMA semaphores provide the rx-ring discipline the reference implements
+in rxbuf_offload.
+
+Runs under shard_map; on CPU meshes it executes in Pallas TPU interpret
+mode, which also gives schedule race detection (InterpretParams
+detect_races) — see tests/test_pallas_kernels.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..constants import ReduceFunction
+
+
+def _kernel(axis_name, world, chunk, func, x_ref, o_ref, v_ref, comm_ref,
+            send_sem, recv_sem, credit_sem):
+    me = lax.axis_index(axis_name)
+    w = jnp.int32(world)
+    nxt = lax.rem(me + 1, w)
+    prv = lax.rem(me + w - 1, w)
+    total_hops = 2 * (world - 1)
+
+    def combine(a, b):
+        return a + b if func == ReduceFunction.SUM else jnp.maximum(a, b)
+
+    def local_chunk(idx):
+        return x_ref[pl.ds(idx * chunk, chunk)]
+
+    # Neighbor barrier: nobody issues a remote write until its peers are in
+    # the kernel (remote comm buffers alive) — the role CFGRDY + rx-ring
+    # priming plays at the reference's bring-up.
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def hop(t):
+        """One ring hop of the accumulator into the next rank's slot t%2.
+        Before reusing a slot, wait for the downstream consumer's release
+        credit — the rx-buffer release-on-ack protocol of the reference
+        (rxbuf_seek/dma_mover.cpp:724-737), without which a fast sender
+        overwrites a slot its neighbor hasn't drained."""
+        slot = t % 2
+        if t >= 2:
+            pltpu.semaphore_wait(credit_sem.at[slot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=v_ref,
+            dst_ref=comm_ref.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=nxt,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        return slot
+
+    def release(t, slot):
+        # Tell the upstream writer its slot is drained (skipped on the
+        # final uses so semaphores end the call balanced).
+        if t + 2 < total_hops:
+            pltpu.semaphore_signal(credit_sem.at[slot], inc=1, device_id=prv)
+
+    # ---- reduce-scatter phase: accumulator starts as our copy of chunk
+    # me-1; the hop-s arrival is the partial of chunk me-2-s (see
+    # schedules.reduce_scatter_ring_schedule for the index derivation).
+    v_ref[...] = local_chunk(lax.rem(me + w - 1, w))
+    for s in range(world - 1):
+        slot = hop(s)
+        idx = lax.rem(me + 2 * w - 2 - s, w)
+        v_ref[...] = combine(comm_ref[slot], local_chunk(idx))
+        release(s, slot)
+
+    # ---- allgather phase: our reduced chunk is chunk `me`; relay P-1
+    # times, filing the hop-s arrival at chunk me-1-s.
+    o_ref[pl.ds(me * chunk, chunk)] = v_ref[...]
+    for s in range(world - 1):
+        t = world - 1 + s
+        slot = hop(t)
+        origin = lax.rem(me + 2 * w - 1 - s, w)
+        v_ref[...] = comm_ref[slot]
+        o_ref[pl.ds(origin * chunk, chunk)] = comm_ref[slot]
+        release(t, slot)
+
+
+def ring_allreduce_pallas(
+    x,
+    *,
+    axis_name: str,
+    world: int,
+    func: ReduceFunction = ReduceFunction.SUM,
+    interpret=None,
+    detect_races: bool = False,
+):
+    """Per-device body (call inside shard_map): fused ring allreduce of a
+    flat (n,) buffer. Pads n up to a world-aligned, lane-aligned chunk."""
+    n = x.shape[-1]
+    chunk = -(-n // world)
+    chunk = -(-chunk // 128) * 128  # lane alignment
+    padded = world * chunk
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))
+    x2 = x.reshape(padded // 128, 128)
+    chunk_rows = chunk // 128
+
+    if interpret is None:
+        from .pallas_kernels import _on_tpu
+
+        interpret = (
+            False if _on_tpu() else pltpu.InterpretParams(detect_races=detect_races)
+        )
+
+    kernel = functools.partial(_kernel, axis_name, world, chunk_rows, func)
+    out = pl.pallas_call(
+        kernel,
+        # vma: the output varies across the collective axis (per-device
+        # shards differ mid-schedule), required by shard_map's vma checking.
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype, vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((chunk_rows, 128), x2.dtype),       # accumulator
+            pltpu.VMEM((2, chunk_rows, 128), x2.dtype),    # comm slots
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),  # slot release credits
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+        interpret=interpret,
+    )(x2)
+    return out.reshape(padded)[:n]

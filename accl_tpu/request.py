@@ -1,0 +1,110 @@
+"""Asynchronous request handles.
+
+Reference semantics: driver/xrt/include/accl/acclrequest.hpp:40-120 — a
+request owns an atomic operationStatus, a wait/timeout, the call's return
+code and its device-measured duration; per-device queues serialize starts.
+
+TPU mapping: XLA dispatch is already asynchronous — launching a compiled
+schedule returns immediately with futures for its outputs — so a request
+wraps the in-flight output array; wait() is block_until_ready. Durations
+come from wall-clocking the device completion, the emulator analog of the
+hardware cycle counter (ccl_offload_control.c:2279-2303).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .constants import ACCLError, OperationStatus
+
+
+class BaseRequest:
+    """One in-flight collective call."""
+
+    _next_id = iter(range(1, 1 << 62))
+
+    def __init__(self, function_name: str = "call"):
+        self.request_id = next(self._next_id)
+        self.function_name = function_name
+        self.status = OperationStatus.QUEUED
+        self.retcode = 0
+        self.duration_ns = 0
+        self._done = threading.Event()
+
+    def running(self):
+        self.status = OperationStatus.EXECUTING
+        self._start_time = time.perf_counter_ns()
+
+    def complete(self, retcode: int = 0):
+        self.retcode = retcode
+        self.duration_ns = time.perf_counter_ns() - getattr(
+            self, "_start_time", time.perf_counter_ns()
+        )
+        self.status = OperationStatus.COMPLETED
+        self._done.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until completion; returns False on timeout (reference
+        acclrequest.hpp wait variants)."""
+        return self._done.wait(timeout)
+
+    def test(self) -> bool:
+        """Non-blocking completion probe (reference CCLO::test)."""
+        return self.status == OperationStatus.COMPLETED
+
+    def check(self):
+        """Raise if the call returned a sticky error word (reference
+        ACCL::check_return_value, accl.cpp:1210-1234)."""
+        if self.retcode:
+            raise ACCLError(self.function_name, self.retcode)
+
+    def get_duration_ns(self) -> int:
+        """Device-time duration of the call (reference get_duration,
+        xrtdevice.cpp:242-249)."""
+        return self.duration_ns
+
+
+class TPURequest(BaseRequest):
+    """Request whose completion is the readiness of jax output arrays."""
+
+    def __init__(self, function_name: str, outputs, on_complete=None):
+        super().__init__(function_name)
+        self.outputs = outputs
+        self._on_complete = on_complete
+        self.running()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if self.status == OperationStatus.COMPLETED:
+            return True
+        if timeout is not None:
+            deadline = time.monotonic() + timeout
+            while not all(_is_ready(o) for o in self.outputs):
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.001)
+        try:
+            for o in self.outputs:
+                o.block_until_ready()
+            self.complete(0)
+        except Exception:
+            self.complete(-1)
+            raise
+        if self._on_complete is not None:
+            self._on_complete(self)
+        return True
+
+    def test(self) -> bool:
+        if self.status == OperationStatus.COMPLETED:
+            return True
+        if all(_is_ready(o) for o in self.outputs):
+            self.wait()
+            return True
+        return False
+
+
+def _is_ready(x) -> bool:
+    try:
+        return x.is_ready()
+    except AttributeError:
+        return True

@@ -50,11 +50,23 @@ class ScheduleCompiler:
         mesh: Mesh,
         axis_name: str = "ccl",
         arith_table: dict | None = None,
+        use_pallas_ring: bool | None = None,
     ):
         self.mesh = mesh
         self.axis_name = axis_name
         self.arith_table = arith_table or DEFAULT_ARITH_CONFIG
+        if use_pallas_ring is None:
+            # Auto: the fused ICI kernel on real TPU, lax schedules on the
+            # CPU emulation mesh (where interpret-mode kernels are slower).
+            from ..ops.pallas_kernels import _on_tpu
+
+            use_pallas_ring = _on_tpu()
+        self.use_pallas_ring = use_pallas_ring
         self._cache: dict = {}
+
+    # Per-device payload ceiling for the VMEM-resident fused ring kernel;
+    # larger transfers fall back to the segmented lax schedule.
+    PALLAS_RING_MAX_BYTES = 4 * 1024 * 1024
 
     @property
     def world(self) -> int:
@@ -89,7 +101,7 @@ class ScheduleCompiler:
         plan: Plan,
         arithcfg: ArithConfig | None = None,
     ) -> Callable:
-        key = (options.signature(), plan, self.axis_name)
+        key = (options.signature(), plan, self.axis_name, self.use_pallas_ring)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._build(options, plan, arithcfg)
@@ -208,12 +220,39 @@ class ScheduleCompiler:
                     return schedules.bcast_flat_schedule(red, root=0, **_c)
 
             else:
-                body = functools.partial(
-                    schedules.allreduce_ring_schedule,
-                    func=func,
-                    seg_count=plan.seg_count,
-                    **common,
+                elem_bytes = 1
+                if options.data_type != DataType.none:
+                    from ..constants import dtype_nbytes
+
+                    elem_bytes = dtype_nbytes(options.data_type)
+                eth_active = bool(
+                    arithcfg is not None
+                    and options.compression_flags & CompressionFlags.ETH_COMPRESSED
+                    and wire_dtype(arithcfg) is not None
                 )
+                if (
+                    self.use_pallas_ring
+                    and options.count * elem_bytes <= self.PALLAS_RING_MAX_BYTES
+                    # per-hop compression with uncompressed-domain arithmetic
+                    # cannot be fused into the single-dtype ring kernel
+                    and (not eth_active or compressed_domain)
+                ):
+                    from ..ops.ring_allreduce import ring_allreduce_pallas
+
+                    def body(x, *, _c=common, _f=func):
+                        y = _c["wire"].send(x)  # wire compression outside
+                        out = ring_allreduce_pallas(
+                            y, axis_name=_c["axis"], world=_c["world"], func=_f
+                        )
+                        return _c["wire"].recv(out, x.dtype)
+
+                else:
+                    body = functools.partial(
+                        schedules.allreduce_ring_schedule,
+                        func=func,
+                        seg_count=plan.seg_count,
+                        **common,
+                    )
             n_in = 1
         elif op == Operation.alltoall:
             body = functools.partial(schedules.alltoall_schedule, **common)
@@ -233,11 +272,14 @@ class ScheduleCompiler:
                 return out.astype(orig)
 
         spec = PartitionSpec(self.axis_name)
+        # vma checking is disabled because the pallas-lowered bodies carry
+        # explicit vma annotations the checker cannot yet propagate through.
         shmapped = jax.shard_map(
             _squeeze_wrap(body, n_in),
             mesh=self.mesh,
             in_specs=(spec,) * n_in,
             out_specs=spec,
+            check_vma=False,
         )
         return jax.jit(shmapped)
 
@@ -267,12 +309,14 @@ class ScheduleCompiler:
 
 def _arithcfg_for(table, options: CallOptions):
     dt = options.data_type
-    # Exact-dtype row first; fall back to the homogeneous pair.
-    for (unc, cmp_), cfg in table.items():
-        if unc == dt and (
-            options.compression_flags & CompressionFlags.ETH_COMPRESSED
-        ) == (CompressionFlags.ETH_COMPRESSED if unc != cmp_ else 0):
-            return cfg
+    if options.compress_dtype != DataType.none:
+        # The caller named a wire dtype (prepare_call's compressed-operand
+        # resolution): the row must match exactly.
+        return table.get((dt, options.compress_dtype))
+    if options.compression_flags & CompressionFlags.ETH_COMPRESSED:
+        for (unc, cmp_), cfg in table.items():
+            if unc == dt and unc != cmp_:
+                return cfg
     return table.get((dt, dt))
 
 

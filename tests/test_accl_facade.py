@@ -1,0 +1,135 @@
+"""End-to-end tests of the ACCL driver facade on the CPU mesh —
+the analog of the reference gtest fixture path (test/host/xrt/src/test.cpp
+through the full ACCL class + device backend, not raw schedules)."""
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, DataType, ReduceFunction
+from accl_tpu.accl import ACCL
+
+WORLD = 8
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def accl(mesh8):
+    return ACCL(mesh8)
+
+
+def test_initialize_writes_exchange_memory(accl):
+    dump = accl.dump_exchange_memory()
+    assert "0x1ff4" in dump  # CFGRDY
+    assert accl.cclo.read(0x1FF4) == 1
+    assert "rank 0" in accl.dump_communicator()
+    with pytest.raises(RuntimeError):
+        accl.initialize()  # double-config guard (accl.cpp:1074)
+
+
+def test_allreduce_end_to_end(accl):
+    x = RNG.standard_normal((WORLD, 500)).astype(np.float32)
+    sb = accl.create_buffer(500, data=x)
+    rb = accl.create_buffer(500)
+    accl.allreduce(sb, rb, 500, ReduceFunction.SUM)
+    np.testing.assert_allclose(rb.host, np.tile(x.sum(0), (WORLD, 1)),
+                               rtol=1e-4, atol=1e-4)
+    assert accl.get_duration_ns() > 0
+
+
+def test_async_request(accl):
+    x = RNG.standard_normal((WORLD, 256)).astype(np.float32)
+    sb = accl.create_buffer(256, data=x)
+    rb = accl.create_buffer(256)
+    req = accl.allreduce(sb, rb, 256, ReduceFunction.MAX, run_async=True)
+    accl.wait(req)
+    np.testing.assert_allclose(rb.host, np.tile(x.max(0), (WORLD, 1)),
+                               rtol=1e-5, atol=1e-5)
+    assert req.test()
+
+
+def test_send_recv_pairing(accl):
+    x = RNG.standard_normal((WORLD, 64)).astype(np.float32)
+    sb = accl.create_buffer(64, data=x)
+    rb = accl.create_buffer(64)
+    accl.send(sb, 64, src=1, dst=6, tag=5)
+    accl.recv(rb, 64, src=1, dst=6, tag=5)
+    np.testing.assert_allclose(rb.host[6], x[1], rtol=1e-6)
+
+
+def test_recv_without_send_raises(accl):
+    rb = accl.create_buffer(16)
+    with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+        accl.recv(rb, 16, src=0, dst=3, tag=77)
+
+
+def test_bcast_scatter_gather(accl):
+    x = RNG.standard_normal((WORLD, 128)).astype(np.float32)
+    b = accl.create_buffer(128, data=x)
+    accl.bcast(b, 128, root=2)
+    np.testing.assert_allclose(b.host, np.tile(x[2], (WORLD, 1)), rtol=1e-6)
+
+    xs = RNG.standard_normal((WORLD, 32 * WORLD)).astype(np.float32)
+    sb = accl.create_buffer(32 * WORLD, data=xs)
+    rb = accl.create_buffer(32)
+    accl.scatter(sb, rb, 32, root=0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(rb.host[r], xs[0, r * 32:(r + 1) * 32])
+
+    gb = accl.create_buffer(32 * WORLD)
+    accl.gather(rb, gb, 32, root=3, from_device=True)
+    gb.sync_from_device()
+    np.testing.assert_allclose(gb.host[3], xs[0], rtol=1e-6)
+
+
+def test_combine_and_copy(accl):
+    a = RNG.standard_normal((WORLD, 40)).astype(np.float32)
+    b = RNG.standard_normal((WORLD, 40)).astype(np.float32)
+    ba, bb, bc = (accl.create_buffer(40, data=a), accl.create_buffer(40, data=b),
+                  accl.create_buffer(40))
+    accl.combine(40, ReduceFunction.SUM, ba, bb, bc)
+    np.testing.assert_allclose(bc.host, a + b, rtol=1e-6)
+    bd = accl.create_buffer(40)
+    accl.copy(bc, bd, 40)
+    np.testing.assert_allclose(bd.host, a + b, rtol=1e-6)
+
+
+def test_wire_compression_via_compress_dtype(accl):
+    x = RNG.standard_normal((WORLD, 2000)).astype(np.float32)
+    sb = accl.create_buffer(2000, data=x)
+    rb = accl.create_buffer(2000)
+    accl.allreduce(sb, rb, 2000, ReduceFunction.SUM,
+                   compress_dtype=DataType.float16)
+    np.testing.assert_allclose(rb.host[0], x.sum(0), rtol=5e-2, atol=5e-1)
+
+
+def test_chained_on_device(accl):
+    """from_device/to_device chaining: no host syncs between calls
+    (the from_fpga/to_fpga contract, accl.hpp collective docs)."""
+    x = RNG.standard_normal((WORLD, 100)).astype(np.float32)
+    sb = accl.create_buffer(100, data=x)
+    mid = accl.create_buffer(100)
+    out = accl.create_buffer(100)
+    accl.allreduce(sb, mid, 100, ReduceFunction.SUM, to_device=True)
+    accl.bcast(mid, 100, root=0, from_device=True, to_device=True)
+    accl.copy(mid, out, 100, from_device=True)
+    np.testing.assert_allclose(out.host[5], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_barrier_and_housekeeping(accl):
+    accl.barrier()
+    accl.set_timeout(500000)
+    accl.set_max_eager_size(512)
+    assert accl.cclo.max_eager_size == 512
+    with pytest.raises(ACCLError, match="EAGER_THRESHOLD_INVALID"):
+        accl.set_max_eager_size(1 << 20)  # above rx buf size (.c:2434-2438)
+    accl.set_max_eager_size(1024)
+
+
+def test_smaller_count_than_buffer(accl):
+    x = RNG.standard_normal((WORLD, 256)).astype(np.float32)
+    sb = accl.create_buffer(256, data=x)
+    rb = accl.create_buffer(256)
+    accl.allreduce(sb, rb, 100, ReduceFunction.SUM)
+    np.testing.assert_allclose(rb.host[:, :100],
+                               np.tile(x[:, :100].sum(0), (WORLD, 1)),
+                               rtol=1e-4, atol=1e-4)
