@@ -1,0 +1,303 @@
+"""EmuDevice: ctypes binding to the native multi-rank emulator runtime.
+
+The SimDevice analog (reference driver/xrt/src/simdevice.cpp talking ZMQ
+to test/model/emulator): each EmuRank owns one native runtime instance —
+a rank with its own sequencer thread, TCP links, eager rx ring and
+rendezvous queues (native/src/runtime.cpp). Unlike the single-controller
+TPUDevice, this backend is genuinely per-rank: N EmuRanks (threads or
+processes) execute collectives against each other over sockets, which is
+how the reference's emulator-based CI runs the gtest suite with no
+hardware in the loop (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import socket
+import subprocess
+import threading
+
+import numpy as np
+
+from ..constants import (
+    ACCLError,
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    DEFAULT_NUM_EAGER_RX_BUFS,
+    DataType,
+    Operation,
+    ReduceFunction,
+    TAG_ANY,
+    from_numpy_dtype,
+)
+from ..descriptor import CallOptions
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libacclrt.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_native():
+    """Load (building if needed) the native runtime library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        # always invoke make: a fresh build is a no-op, and a stale .so
+        # silently shadowing source edits is worse than the fork cost
+        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                       capture_output=True)
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.accl_rt_create.restype = ctypes.c_void_p
+        lib.accl_rt_create.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint64,
+        ]
+        lib.accl_rt_destroy.argtypes = [ctypes.c_void_p]
+        lib.accl_rt_start.restype = ctypes.c_int64
+        lib.accl_rt_start.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.accl_rt_test.restype = ctypes.c_int
+        lib.accl_rt_test.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.accl_rt_wait.restype = ctypes.c_int
+        lib.accl_rt_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_uint64]
+        lib.accl_rt_retcode.restype = ctypes.c_uint32
+        lib.accl_rt_retcode.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.accl_rt_duration_ns.restype = ctypes.c_uint64
+        lib.accl_rt_duration_ns.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.accl_rt_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.accl_rt_read.restype = ctypes.c_uint32
+        lib.accl_rt_read.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.accl_rt_write.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      ctypes.c_uint32]
+        _lib = lib
+        return lib
+
+
+def free_ports(n: int) -> list[int]:
+    """Reserve n free localhost ports (emulator launch helper, the role of
+    test/model/emulator/run.py's port allocation)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class EmuRank:
+    """One rank of the native emulator (per-rank driver endpoint)."""
+
+    def __init__(
+        self,
+        world: int,
+        rank: int,
+        ports: list[int],
+        n_rx_bufs: int = DEFAULT_NUM_EAGER_RX_BUFS,
+        rx_buf_bytes: int = DEFAULT_EAGER_RX_BUF_SIZE,
+        max_eager: int = DEFAULT_MAX_EAGER_SIZE,
+        # The driver default ceiling (32 KB, accl.hpp:104) is what apps
+        # immediately raise at bring-up; the emulator defaults to a roomy
+        # ceiling so rendezvous tests exercise real sizes. The limit stays
+        # enforced (DMA_SIZE_ERROR past it).
+        max_rndzv: int = 64 * 1024 * 1024,
+    ):
+        lib = load_native()
+        self.world = world
+        self.rank = rank
+        arr = (ctypes.c_uint16 * world)(*ports)
+        self._rt = lib.accl_rt_create(
+            world, rank, arr, n_rx_bufs, rx_buf_bytes, max_eager, max_rndzv
+        )
+        if not self._rt:
+            raise RuntimeError(f"native runtime bring-up failed (rank {rank})")
+        self._lib = lib
+        self._keepalive: dict[int, tuple] = {}
+        self._durations: dict[int, int] = {}
+
+    def close(self):
+        if self._rt:
+            self._lib.accl_rt_destroy(self._rt)
+            self._rt = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- MMIO --------------------------------------------------------------
+
+    def read(self, addr: int) -> int:
+        return self._lib.accl_rt_read(self._rt, addr)
+
+    def write(self, addr: int, value: int):
+        self._lib.accl_rt_write(self._rt, addr, value)
+
+    # -- calls -------------------------------------------------------------
+
+    @staticmethod
+    def _ptr(arr):
+        if arr is None:
+            return None
+        assert arr.flags["C_CONTIGUOUS"]
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    def start(self, opts: CallOptions, op0=None, op1=None, res=None) -> int:
+        words = (ctypes.c_uint32 * 15)(*[w & 0xFFFFFFFF for w in opts.to_words()])
+        dt = int(opts.data_type)
+        h = self._lib.accl_rt_start(
+            self._rt, words, dt, self._ptr(op0), self._ptr(op1), self._ptr(res)
+        )
+        # operands must outlive the call (reference: buffers owned by caller
+        # until request completion, acclrequest.hpp)
+        self._keepalive[h] = (op0, op1, res)
+        return h
+
+    def wait(self, handle: int, timeout_ms: int = 0) -> None:
+        ok = self._lib.accl_rt_wait(self._rt, handle, timeout_ms)
+        if not ok:
+            raise TimeoutError(f"rank {self.rank}: call {handle} timed out")
+        rc = self._lib.accl_rt_retcode(self._rt, handle)
+        # cache duration, then release the native completion record
+        self._durations[handle] = self._lib.accl_rt_duration_ns(self._rt, handle)
+        self._lib.accl_rt_release(self._rt, handle)
+        self._keepalive.pop(handle, None)
+        if rc:
+            raise ACCLError(f"emu rank {self.rank}", rc)
+
+    def test(self, handle: int) -> bool:
+        return bool(self._lib.accl_rt_test(self._rt, handle))
+
+    def duration_ns(self, handle: int) -> int:
+        if handle in self._durations:
+            return self._durations[handle]
+        return self._lib.accl_rt_duration_ns(self._rt, handle)
+
+    def call(self, opts: CallOptions, op0=None, op1=None, res=None) -> int:
+        h = self.start(opts, op0, op1, res)
+        self.wait(h)
+        return h
+
+    # -- convenience collective wrappers (per-rank ACCL-style API) --------
+
+    def _opts(self, scenario, count, dtype, root=0, func=0, tag=TAG_ANY):
+        return CallOptions(
+            scenario=scenario, count=count, root_src_dst=root,
+            function=int(func), tag=tag,
+            data_type=from_numpy_dtype(dtype),
+        )
+
+    def send(self, buf, count, dst, tag=TAG_ANY):
+        return self.call(self._opts(Operation.send, count, buf.dtype, dst, tag=tag), op0=buf)
+
+    def recv(self, buf, count, src, tag=TAG_ANY):
+        return self.call(self._opts(Operation.recv, count, buf.dtype, src, tag=tag), res=buf)
+
+    def copy(self, src, dst, count):
+        return self.call(self._opts(Operation.copy, count, src.dtype), op0=src, res=dst)
+
+    def combine(self, count, func, op0, op1, res):
+        return self.call(self._opts(Operation.combine, count, op0.dtype, func=func),
+                         op0=op0, op1=op1, res=res)
+
+    def bcast(self, buf, count, root):
+        return self.call(self._opts(Operation.bcast, count, buf.dtype, root), op0=buf)
+
+    def scatter(self, sendbuf, recvbuf, count, root):
+        return self.call(self._opts(Operation.scatter, count, recvbuf.dtype, root),
+                         op0=sendbuf, res=recvbuf)
+
+    def gather(self, sendbuf, recvbuf, count, root):
+        return self.call(self._opts(Operation.gather, count, sendbuf.dtype, root),
+                         op0=sendbuf, res=recvbuf)
+
+    def allgather(self, sendbuf, recvbuf, count):
+        return self.call(self._opts(Operation.allgather, count, sendbuf.dtype),
+                         op0=sendbuf, res=recvbuf)
+
+    def reduce(self, sendbuf, recvbuf, count, root, func):
+        return self.call(self._opts(Operation.reduce, count, sendbuf.dtype, root, func),
+                         op0=sendbuf, res=recvbuf)
+
+    def allreduce(self, sendbuf, recvbuf, count, func):
+        return self.call(self._opts(Operation.allreduce, count, sendbuf.dtype, func=func),
+                         op0=sendbuf, res=recvbuf)
+
+    def reduce_scatter(self, sendbuf, recvbuf, count, func):
+        return self.call(self._opts(Operation.reduce_scatter, count, sendbuf.dtype, func=func),
+                         op0=sendbuf, res=recvbuf)
+
+    def alltoall(self, sendbuf, recvbuf, count):
+        return self.call(self._opts(Operation.alltoall, count, sendbuf.dtype),
+                         op0=sendbuf, res=recvbuf)
+
+    def barrier(self):
+        return self.call(self._opts(Operation.barrier, 0, np.float32))
+
+
+class EmuWorld:
+    """Bring up N emulator ranks in one process (the in-process analog of
+    run.py launching N emulator processes; rank bring-up is concurrent
+    because link establishment blocks on peers)."""
+
+    def __init__(self, world: int, **kw):
+        ports = free_ports(world)
+        self.ranks: list[EmuRank | None] = [None] * world
+        errs: list[Exception] = []
+
+        def mk(r):
+            try:
+                self.ranks[r] = EmuRank(world, r, ports, **kw)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def close(self):
+        for r in self.ranks:
+            if r is not None:
+                r.close()
+
+    def run(self, fn):
+        """Execute fn(rank_obj, rank_idx) on every rank concurrently and
+        return the list of results (MPI-program analog of the gtest
+        fixture, test/host/xrt/include/fixture.hpp)."""
+        results = [None] * len(self.ranks)
+        errs = []
+
+        def body(i):
+            try:
+                results[i] = fn(self.ranks[i], i)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=body, args=(i,))
+            for i in range(len(self.ranks))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return results

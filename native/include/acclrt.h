@@ -1,0 +1,82 @@
+/* accl-tpu native runtime: C API.
+ *
+ * The CPU-resident realization of the collective sequencer + transport —
+ * the role the CCLO emulator plays in the reference (test/model/emulator/
+ * cclo_emu.cpp: the full block design as free-running software), rebuilt
+ * idiomatically: one runtime instance per rank, a sequencer thread
+ * consuming a call queue + retry queue (ccl_offload_control.c:2308-2483's
+ * run() loop), a TCP full-mesh transport carrying 64-byte ACCL message
+ * headers (eth_intf.h:94-151), an eager rx-buffer ring with
+ * (src, tag, seqn) seek matching (rxbuf_offload/rxbuf_seek.cpp:20-79),
+ * and a rendezvous address/completion handshake with one-sided writes
+ * (ccl_offload_control.c:142-408, rdma_sq_handler.cpp).
+ *
+ * The Python driver binds this via ctypes (accl_tpu/device/emu_device.py).
+ */
+
+#ifndef ACCLRT_H
+#define ACCLRT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct accl_rt accl_rt_t;
+
+/* Create a rank runtime. ports[world] lists each rank's TCP port on
+ * 127.0.0.1. Establishes the full mesh (blocking) before returning. */
+accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
+                          const uint16_t *ports, uint32_t n_rx_bufs,
+                          uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
+                          uint64_t max_rndzv_bytes);
+
+void accl_rt_destroy(accl_rt_t *rt);
+
+/* Queue a 15-word call descriptor (driver/hls/accl_hls.h:134-198 layout;
+ * word 8 carries stream|host<<8, and dtype is passed out-of-band since the
+ * hardware encodes it via the arithcfg pointer). op0/op1/res are host
+ * buffers owned by the caller, valid until the call completes.
+ * Returns a handle. */
+int64_t accl_rt_start(accl_rt_t *rt, const uint32_t desc[15],
+                      uint32_t data_type, void *op0, void *op1, void *res);
+
+/* 1 when the handle's call has finished, 0 otherwise. */
+int accl_rt_test(accl_rt_t *rt, int64_t handle);
+
+/* Block until the call finishes or timeout_ms elapses (0 = forever).
+ * Returns 1 on completion, 0 on timeout. */
+int accl_rt_wait(accl_rt_t *rt, int64_t handle, uint64_t timeout_ms);
+
+/* Sticky error word of a completed call (errorCode bits). */
+uint32_t accl_rt_retcode(accl_rt_t *rt, int64_t handle);
+
+/* Wall-clock duration of a completed call, ns (perf-counter analog). */
+uint64_t accl_rt_duration_ns(accl_rt_t *rt, int64_t handle);
+
+/* Drop a completed call's bookkeeping (after reading retcode/duration). */
+void accl_rt_release(accl_rt_t *rt, int64_t handle);
+
+/* Exchange-memory MMIO (byte-addressed words, 8 KB). */
+uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr);
+void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value);
+
+/* Data types, matching accl_tpu.constants.DataType. */
+enum accl_rt_dtype {
+  ACCL_DT_NONE = 0,
+  ACCL_DT_INT8 = 1,
+  ACCL_DT_FLOAT16 = 2,
+  ACCL_DT_FLOAT32 = 3,
+  ACCL_DT_FLOAT64 = 4,
+  ACCL_DT_INT32 = 5,
+  ACCL_DT_INT64 = 6,
+  ACCL_DT_BFLOAT16 = 7,
+};
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* ACCLRT_H */

@@ -1,0 +1,1311 @@
+// accl-tpu native runtime implementation.
+//
+// One instance per rank: a TCP full-mesh transport (the POE layer,
+// reference kernels/cclo/hls/eth_intf + dummy stacks), an eager rx-buffer
+// ring with (src, tag, seqn) seek matching (reference rxbuf_offload/*),
+// rendezvous address/completion matching with pending queues (reference
+// ccl_offload_control.c:142-408), and a single sequencer thread running
+// the call + retry queues round-robin with current_step resumption
+// (reference run(), ccl_offload_control.c:2308-2483).
+//
+// Collective algorithms mirror the firmware's selections exactly —
+// eager/rendezvous split, ring vs flat vs binary tree by tuning register —
+// the same rules accl_tpu/sequencer/plan.py encodes for the XLA path.
+
+#include "../include/acclrt.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Error codes (mirror accl_tpu.constants.ErrorCode / constants.hpp:341-376)
+// ---------------------------------------------------------------------------
+enum Err : uint32_t {
+  NO_ERROR = 0,
+  RECEIVE_TIMEOUT_ERROR = 1u << 11,
+  COLLECTIVE_NOT_IMPLEMENTED = 1u << 14,
+  DMA_SIZE_ERROR = 1u << 18,
+  ARITH_ERROR = 1u << 19,
+  NOT_READY = 0x80000000u,  // internal: requeue with current_step saved
+};
+
+// Exchange-memory register offsets (constants.hpp:139-154).
+enum Addr : uint32_t {
+  RETCODE = 0x1FFC,
+  IDCODE = 0x1FF8,
+  CFGRDY = 0x1FF4,
+  PERFCNT = 0x1FF0,
+  REDUCE_FLAT_TREE_MAX_COUNT = 0x1FD4,
+  REDUCE_FLAT_TREE_MAX_RANKS = 0x1FD0,
+  BCAST_FLAT_TREE_MAX_RANKS = 0x1FCC,
+  GATHER_FLAT_TREE_MAX_COUNT = 0x1FC8,
+  GATHER_FLAT_TREE_MAX_FANIN = 0x1FC4,
+};
+
+constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;
+constexpr uint32_t EXCHMEM_BYTES = 8192;
+
+// Scenario ids (constants.hpp:190-216).
+enum Scenario : uint32_t {
+  SC_CONFIG = 0, SC_COPY = 1, SC_COMBINE = 2, SC_SEND = 3, SC_RECV = 4,
+  SC_BCAST = 5, SC_SCATTER = 6, SC_GATHER = 7, SC_REDUCE = 8,
+  SC_ALLGATHER = 9, SC_ALLREDUCE = 10, SC_REDUCE_SCATTER = 11,
+  SC_BARRIER = 12, SC_ALLTOALL = 13, SC_NOP = 255,
+};
+
+// ---------------------------------------------------------------------------
+// Wire format: 64-byte header (eth_intf.h:94-151 analog) + payload
+// ---------------------------------------------------------------------------
+enum MsgType : uint32_t {
+  MSG_EGR_DATA = 0,    // eager segment into an rx slot
+  MSG_RNDZV_ADDR = 1,  // receiver -> sender address notification
+  MSG_RNDZV_WRITE = 2, // sender -> receiver one-sided write payload
+};
+
+struct MsgHeader {
+  uint32_t magic;
+  uint32_t msg_type;
+  uint32_t src;
+  uint32_t dst;
+  uint32_t tag;
+  uint32_t seqn;
+  uint32_t strm;
+  uint32_t host;
+  uint64_t bytes;  // payload length / rendezvous size
+  uint64_t vaddr;  // rendezvous target address
+  uint8_t pad[16];
+};
+static_assert(sizeof(MsgHeader) == 64, "ACCL header is 64 bytes");
+constexpr uint32_t MSG_MAGIC = 0xACC17B01u;
+
+// ---------------------------------------------------------------------------
+// dtype helpers: elementwise SUM/MAX incl. fp16/bf16 via uint16 conversion
+// (reduce_ops plugin analog, here over host memory)
+// ---------------------------------------------------------------------------
+
+static inline float half_to_float(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t man = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal
+      int e = -1;
+      do { man <<= 1; e++; } while (!(man & 0x400));
+      bits = sign | ((127 - 15 - e) << 23) | ((man & 0x3FF) << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (man << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static inline uint16_t float_to_half(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  uint32_t exp8 = (bits >> 23) & 0xFF;
+  uint32_t man = bits & 0x7FFFFF;
+  if (exp8 == 0xFF)  // inf / NaN propagate
+    return (uint16_t)(sign | 0x7C00 | (man ? 0x200 : 0));
+  int32_t exp = (int32_t)exp8 - 127 + 15;
+  if (exp <= 0) return (uint16_t)sign;             // flush to zero
+  if (exp >= 31) return (uint16_t)(sign | 0x7C00); // overflow to inf
+  // round to nearest even: add 0xFFF + the lsb of the kept mantissa
+  uint32_t rounded = man + 0xFFF + ((man >> 13) & 1);
+  if (rounded & 0x800000) {
+    rounded = 0;
+    exp++;
+    if (exp >= 31) return (uint16_t)(sign | 0x7C00);
+  }
+  return (uint16_t)(sign | (exp << 10) | (rounded >> 13));
+}
+
+static inline float bf16_to_float(uint16_t h) {
+  uint32_t bits = ((uint32_t)h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static inline uint16_t float_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7FFF + lsb;  // round to nearest even
+  return (uint16_t)(bits >> 16);
+}
+
+static uint32_t dtype_bytes(uint32_t dt) {
+  switch (dt) {
+    case ACCL_DT_INT8: return 1;
+    case ACCL_DT_FLOAT16: case ACCL_DT_BFLOAT16: return 2;
+    case ACCL_DT_FLOAT32: case ACCL_DT_INT32: return 4;
+    case ACCL_DT_FLOAT64: case ACCL_DT_INT64: return 8;
+    default: return 0;
+  }
+}
+
+template <typename T, typename Op>
+static void combine_typed(void *a, const void *b, size_t n, Op op) {
+  T *pa = (T *)a;
+  const T *pb = (const T *)b;
+  for (size_t i = 0; i < n; i++) pa[i] = op(pa[i], pb[i]);
+}
+
+// a := op(a, b), elementwise over n elements. func: 0=SUM, 1=MAX.
+static uint32_t combine_buffers(uint32_t dt, uint32_t func, void *a,
+                                const void *b, size_t n) {
+  auto do16 = [&](auto to_f, auto from_f) {
+    uint16_t *pa = (uint16_t *)a;
+    const uint16_t *pb = (const uint16_t *)b;
+    for (size_t i = 0; i < n; i++) {
+      float x = to_f(pa[i]), y = to_f(pb[i]);
+      pa[i] = from_f(func == 0 ? x + y : (x > y ? x : y));
+    }
+  };
+  switch (dt) {
+    case ACCL_DT_FLOAT32:
+      func == 0 ? combine_typed<float>(a, b, n, [](float x, float y) { return x + y; })
+                : combine_typed<float>(a, b, n, [](float x, float y) { return x > y ? x : y; });
+      return NO_ERROR;
+    case ACCL_DT_FLOAT64:
+      func == 0 ? combine_typed<double>(a, b, n, [](double x, double y) { return x + y; })
+                : combine_typed<double>(a, b, n, [](double x, double y) { return x > y ? x : y; });
+      return NO_ERROR;
+    case ACCL_DT_INT32:
+      func == 0 ? combine_typed<int32_t>(a, b, n, [](int32_t x, int32_t y) { return x + y; })
+                : combine_typed<int32_t>(a, b, n, [](int32_t x, int32_t y) { return x > y ? x : y; });
+      return NO_ERROR;
+    case ACCL_DT_INT64:
+      func == 0 ? combine_typed<int64_t>(a, b, n, [](int64_t x, int64_t y) { return x + y; })
+                : combine_typed<int64_t>(a, b, n, [](int64_t x, int64_t y) { return x > y ? x : y; });
+      return NO_ERROR;
+    case ACCL_DT_FLOAT16: do16(half_to_float, float_to_half); return NO_ERROR;
+    case ACCL_DT_BFLOAT16: do16(bf16_to_float, float_to_bf16); return NO_ERROR;
+    default: return ARITH_ERROR;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// socket helpers
+// ---------------------------------------------------------------------------
+
+static bool send_all(int fd, const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool recv_all(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// runtime
+// ---------------------------------------------------------------------------
+
+struct RxSlot {
+  enum { IDLE, VALID } status = IDLE;
+  uint32_t src = 0, tag = 0, seqn = 0;
+  std::vector<uint8_t> data;
+};
+
+struct RndzvAddr {
+  uint32_t src;
+  uint64_t vaddr;
+  uint64_t bytes;
+  uint32_t tag;
+  uint32_t host;
+};
+
+struct RndzvDone {
+  uint32_t src;
+  uint64_t vaddr;
+  uint64_t bytes;
+  uint32_t tag;
+};
+
+struct Call {
+  int64_t handle;
+  uint32_t desc[15];
+  uint32_t dtype;
+  void *op0, *op1, *res;
+  uint32_t current_step = 0;  // resumption point across NOT_READY requeues
+  bool deadline_set = false;
+  std::chrono::steady_clock::time_point deadline;
+  std::chrono::steady_clock::time_point t_start;
+};
+
+struct Completion {
+  std::atomic<int> done{0};
+  uint32_t retcode = 0;
+  uint64_t duration_ns = 0;
+};
+
+}  // namespace
+
+struct accl_rt {
+  uint32_t world, rank;
+  uint32_t rx_buf_bytes, max_eager;
+  uint64_t max_rndzv;
+  std::vector<uint8_t> exchmem = std::vector<uint8_t>(EXCHMEM_BYTES, 0);
+  std::mutex exch_mu;
+
+  // transport
+  std::vector<int> peer_fd;          // per-rank socket (self = -1)
+  std::vector<std::mutex> tx_mu;     // serialize frames per link
+  std::vector<std::thread> rx_threads;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+
+  // eager rx ring + notifications (rxbuf_offload analog)
+  std::vector<RxSlot> rx_slots;
+  std::mutex rx_mu;
+  std::condition_variable rx_cv;
+
+  // rendezvous pending queues (CMD/STS_RNDZV(_PENDING) analog)
+  std::deque<RndzvAddr> addr_q;
+  std::deque<RndzvDone> done_q;
+  std::mutex rndzv_mu;
+  std::condition_variable rndzv_cv;
+
+  // per-peer sequence numbers (ccl_offload_control.h:297-310)
+  std::vector<uint32_t> inbound_seq, outbound_seq;
+
+  // call + retry queues and sequencer thread (run() analog)
+  std::deque<Call> call_q, retry_q;
+  std::mutex call_mu;
+  std::condition_variable call_cv;
+  std::thread seq_thread;
+  std::map<int64_t, std::shared_ptr<Completion>> completions;
+  std::mutex comp_mu;
+  std::condition_variable comp_cv;
+  int64_t next_handle = 1;
+
+  uint64_t timeout_ms = 5000;
+
+  // ----- exchmem -----
+  uint32_t rd(uint32_t addr) {
+    std::lock_guard<std::mutex> g(exch_mu);
+    uint32_t v;
+    std::memcpy(&v, exchmem.data() + addr, 4);
+    return v;
+  }
+  void wr(uint32_t addr, uint32_t v) {
+    std::lock_guard<std::mutex> g(exch_mu);
+    std::memcpy(exchmem.data() + addr, &v, 4);
+  }
+  uint32_t tuning(uint32_t addr, uint32_t dflt) {
+    uint32_t v = rd(addr);
+    return v ? v : dflt;
+  }
+
+  // ----- transport -----
+  bool frame_out(uint32_t dst, MsgType mt, uint32_t tag, uint32_t seqn,
+                 uint64_t bytes, uint64_t vaddr, const void *payload,
+                 size_t payload_len, uint32_t host = 0) {
+    MsgHeader h{};
+    h.magic = MSG_MAGIC;
+    h.msg_type = mt;
+    h.src = rank;
+    h.dst = dst;
+    h.tag = tag;
+    h.seqn = seqn;
+    h.host = host;
+    h.bytes = bytes;
+    h.vaddr = vaddr;
+    std::lock_guard<std::mutex> g(tx_mu[dst]);
+    if (getenv("ACCL_RT_DEBUG"))
+      fprintf(stderr, "[r%u] tx mt=%u dst=%u fd=%d bytes=%llu\n", rank,
+              (unsigned)mt, dst, peer_fd[dst], (unsigned long long)bytes);
+    if (!send_all(peer_fd[dst], &h, sizeof h)) {
+      if (getenv("ACCL_RT_DEBUG"))
+        fprintf(stderr, "[r%u] TX FAIL hdr dst=%u\n", rank, dst);
+      return false;
+    }
+    if (payload_len && !send_all(peer_fd[dst], payload, payload_len))
+      return false;
+    return true;
+  }
+
+  void rx_loop(uint32_t peer) {
+    std::vector<uint8_t> payload;
+    while (!stop.load()) {
+      MsgHeader h;
+      if (!recv_all(peer_fd[peer], &h, sizeof h)) {
+        if (getenv("ACCL_RT_DEBUG") && !stop.load())
+          fprintf(stderr, "[r%u] RX LINK DOWN peer=%u\n", rank, peer);
+        return;
+      }
+      if (h.magic != MSG_MAGIC) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] RX BAD MAGIC peer=%u\n", rank, peer);
+        return;
+      }
+      if (getenv("ACCL_RT_DEBUG"))
+        fprintf(stderr, "[r%u] rx mt=%u from=%u\n", rank, h.msg_type, h.src);
+      size_t plen = 0;
+      if (h.msg_type == MSG_EGR_DATA || h.msg_type == MSG_RNDZV_WRITE)
+        plen = (size_t)h.bytes;
+      payload.resize(plen);
+      if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
+      switch (h.msg_type) {
+        case MSG_EGR_DATA: {
+          // depacketizer -> rxbuf enqueue/dequeue: land the segment in an
+          // IDLE slot and publish the notification.
+          std::unique_lock<std::mutex> lk(rx_mu);
+          rx_cv.wait(lk, [&] {
+            if (stop.load()) return true;
+            for (auto &s : rx_slots)
+              if (s.status == RxSlot::IDLE) return true;
+            return false;
+          });
+          if (stop.load()) return;
+          for (auto &s : rx_slots) {
+            if (s.status == RxSlot::IDLE) {
+              s.status = RxSlot::VALID;
+              s.src = h.src;
+              s.tag = h.tag;
+              s.seqn = h.seqn;
+              s.data = payload;
+              break;
+            }
+          }
+          rx_cv.notify_all();
+          break;
+        }
+        case MSG_RNDZV_ADDR: {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
+          rndzv_cv.notify_all();
+          break;
+        }
+        case MSG_RNDZV_WRITE: {
+          // one-sided write: land payload at the receiver-registered vaddr,
+          // then surface the local completion (RNDZVS_WR_DONE analog).
+          std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+          rndzv_cv.notify_all();
+          break;
+        }
+      }
+    }
+  }
+
+  // ----- eager protocol (send .c:611-648 / recv .c:687-704) -----
+
+  uint32_t egr_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
+                    uint32_t tag) {
+    uint64_t off = 0;
+    while (off < bytes || bytes == 0) {
+      uint64_t seg = std::min<uint64_t>(rx_buf_bytes, bytes - off);
+      uint32_t seqn = outbound_seq[dst]++;
+      if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg))
+        return RECEIVE_TIMEOUT_ERROR;
+      off += seg;
+      if (bytes == 0) break;  // zero-length notification (barrier)
+    }
+    return NO_ERROR;
+  }
+
+  // Seek one segment matching (src, tag, expected seqn) with rx_mu HELD;
+  // copy out (clamped to `cap`) + release (rxbuf_seek semantics). Returns
+  // NOT_READY when absent, DMA_SIZE_ERROR on an oversized segment.
+  uint32_t seek_locked(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
+                       uint64_t *got) {
+    uint32_t want = inbound_seq[src];
+    for (auto &s : rx_slots) {
+      if (s.status == RxSlot::VALID && s.src == src && s.seqn == want &&
+          (tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY)) {
+        if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
+        *got = s.data.size();
+        if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
+        s.status = RxSlot::IDLE;
+        s.data.clear();
+        inbound_seq[src] = want + 1;
+        rx_cv.notify_all();
+        return NO_ERROR;
+      }
+    }
+    return NOT_READY;
+  }
+
+  // Non-blocking single-segment receive (retry-queue path).
+  uint32_t egr_recv_seg(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
+                        uint64_t *got) {
+    std::lock_guard<std::mutex> lk(rx_mu);
+    return seek_locked(src, tag, ptr, cap, got);
+  }
+
+  // Blocking variant with the housekeeping timeout; seek and wait happen
+  // under one held lock so a segment landing between them cannot be missed.
+  uint32_t egr_recv(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t bytes) {
+    uint64_t off = 0;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    std::unique_lock<std::mutex> lk(rx_mu);
+    while (off < bytes || bytes == 0) {
+      uint64_t got = 0;
+      uint32_t rc =
+          seek_locked(src, tag, ptr ? ptr + off : nullptr, bytes - off, &got);
+      if (rc == NOT_READY) {
+        if (rx_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+          // final re-check before declaring a timeout
+          rc = seek_locked(src, tag, ptr ? ptr + off : nullptr, bytes - off,
+                           &got);
+          if (rc == NO_ERROR) {
+            off += got;
+            if (bytes == 0) break;
+            continue;
+          }
+          if (getenv("ACCL_RT_DEBUG"))
+            fprintf(stderr, "[r%u] egr_recv timeout src=%u tag=%u off=%llu/%llu\n",
+                    rank, src, tag, (unsigned long long)off, (unsigned long long)bytes);
+          return RECEIVE_TIMEOUT_ERROR;
+        }
+        continue;
+      }
+      if (rc != NO_ERROR) return rc;
+      off += got;
+      if (bytes == 0) break;
+    }
+    return NO_ERROR;
+  }
+
+  // ----- rendezvous protocol (.c:142-408) -----
+
+  void rendezvous_send_addr(uint32_t dst, uint64_t vaddr, uint64_t bytes,
+                            uint32_t tag, uint32_t host = 0) {
+    frame_out(dst, MSG_RNDZV_ADDR, tag, 0, bytes, vaddr, nullptr, 0, host);
+  }
+
+  uint32_t rendezvous_get_addr(uint32_t src, uint64_t bytes, uint32_t tag,
+                               uint64_t *vaddr, bool block = true) {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    std::unique_lock<std::mutex> lk(rndzv_mu);
+    for (;;) {
+      for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
+        if (it->src == src && it->bytes == bytes &&
+            (tag == TAG_ANY || it->tag == tag)) {
+          *vaddr = it->vaddr;
+          addr_q.erase(it);
+          return NO_ERROR;
+        }
+      }
+      if (!block) return NOT_READY;
+      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] get_addr timeout src=%u bytes=%llu addr_q=%zu\n",
+                  rank, src, (unsigned long long)bytes, addr_q.size());
+        return RECEIVE_TIMEOUT_ERROR;
+      }
+    }
+  }
+
+  uint32_t rendezvous_get_any_addr(uint64_t bytes, uint32_t tag,
+                                   uint32_t *src, uint64_t *vaddr) {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    std::unique_lock<std::mutex> lk(rndzv_mu);
+    for (;;) {
+      for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
+        if (it->bytes == bytes && (tag == TAG_ANY || it->tag == tag)) {
+          *src = it->src;
+          *vaddr = it->vaddr;
+          addr_q.erase(it);
+          return NO_ERROR;
+        }
+      }
+      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout)
+        return RECEIVE_TIMEOUT_ERROR;
+    }
+  }
+
+  uint32_t rendezvous_write(uint32_t dst, uint64_t remote_vaddr,
+                            const uint8_t *ptr, uint64_t bytes, uint32_t tag) {
+    return frame_out(dst, MSG_RNDZV_WRITE, tag, 0, bytes, remote_vaddr, ptr,
+                     bytes)
+               ? NO_ERROR
+               : RECEIVE_TIMEOUT_ERROR;
+  }
+
+  uint32_t rendezvous_get_completion(uint32_t src, uint64_t vaddr,
+                                     uint64_t bytes, uint32_t tag) {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    std::unique_lock<std::mutex> lk(rndzv_mu);
+    for (;;) {
+      for (auto it = done_q.begin(); it != done_q.end(); ++it) {
+        if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
+            (tag == TAG_ANY || it->tag == tag)) {
+          done_q.erase(it);
+          return NO_ERROR;
+        }
+      }
+      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] get_completion timeout src=%u bytes=%llu done_q=%zu\n",
+                  rank, src, (unsigned long long)bytes, done_q.size());
+        return RECEIVE_TIMEOUT_ERROR;
+      }
+    }
+  }
+
+  uint32_t rendezvous_get_any_completion(uint64_t bytes, uint32_t tag,
+                                         uint32_t *src, uint64_t *vaddr) {
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    std::unique_lock<std::mutex> lk(rndzv_mu);
+    for (;;) {
+      for (auto it = done_q.begin(); it != done_q.end(); ++it) {
+        if (it->bytes == bytes && (tag == TAG_ANY || it->tag == tag)) {
+          *src = it->src;
+          *vaddr = it->vaddr;
+          done_q.erase(it);
+          return NO_ERROR;
+        }
+      }
+      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] get_any_completion timeout bytes=%llu\n", rank,
+                  (unsigned long long)bytes);
+        return RECEIVE_TIMEOUT_ERROR;
+      }
+    }
+  }
+
+  // ----- point-to-point over both protocols (send .c:573-649) -----
+
+  bool is_rndzv(uint64_t bytes) const { return bytes > max_eager; }
+
+  uint32_t p2p_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
+                    uint32_t tag) {
+    if (is_rndzv(bytes)) {
+      if (bytes > max_rndzv) return DMA_SIZE_ERROR;  // configured ceiling
+      uint64_t vaddr;
+      uint32_t rc = rendezvous_get_addr(dst, bytes, tag, &vaddr);
+      if (rc != NO_ERROR) return rc;
+      return rendezvous_write(dst, vaddr, ptr, bytes, tag);
+    }
+    return egr_send(dst, ptr, bytes, tag);
+  }
+
+  uint32_t p2p_recv(uint32_t src, uint8_t *ptr, uint64_t bytes, uint32_t tag) {
+    if (is_rndzv(bytes)) {
+      if (bytes > max_rndzv) return DMA_SIZE_ERROR;
+      rendezvous_send_addr(src, (uint64_t)(uintptr_t)ptr, bytes, tag);
+      return rendezvous_get_completion(src, (uint64_t)(uintptr_t)ptr, bytes,
+                                       tag);
+    }
+    return egr_recv(src, tag, ptr, bytes);
+  }
+
+  // ----- collective algorithms (firmware ports; cites in each) -----
+
+  uint32_t do_bcast(uint8_t *buf, uint64_t bytes, uint32_t root, uint32_t tag) {
+    if (world == 1) return NO_ERROR;
+    if (is_rndzv(bytes) &&
+        world > tuning(BCAST_FLAT_TREE_MAX_RANKS, 3)) {
+      // binary distance-doubling tree (.c:814-867)
+      uint32_t l = (rank + world - root) % world;
+      bool sender = (rank == root);
+      uint32_t d = 1;
+      while ((d << 1) <= world - 1) d <<= 1;
+      uint32_t err = NO_ERROR;
+      while (d > 0) {
+        if (sender && l % (2 * d) == 0 && l + d < world) {
+          uint32_t peer = (l + d + root) % world;
+          err |= p2p_send(peer, buf, bytes, tag);
+        } else if (!sender && l % d == 0 && l >= d && (l - d) % (2 * d) == 0) {
+          uint32_t peer = (l - d + root) % world;
+          err |= p2p_recv(peer, buf, bytes, tag);
+          sender = true;
+        }
+        d >>= 1;
+      }
+      return err;
+    }
+    // flat fan-out, eager or rendezvous (.c:868-988)
+    uint32_t err = NO_ERROR;
+    if (rank == root) {
+      for (uint32_t i = 0; i < world; i++)
+        if (i != root) err |= p2p_send(i, buf, bytes, tag);
+    } else {
+      err |= p2p_recv(root, buf, bytes, tag);
+    }
+    return err;
+  }
+
+  uint32_t do_scatter(const uint8_t *src, uint8_t *dst, uint64_t bytes,
+                      uint32_t root, uint32_t tag) {
+    uint32_t err = NO_ERROR;
+    if (rank == root) {
+      for (uint32_t i = 0; i < world; i++) {
+        if (i == root) continue;
+        err |= p2p_send(i, src + (uint64_t)i * bytes, bytes, tag);
+      }
+      std::memcpy(dst, src + (uint64_t)root * bytes, bytes);
+    } else {
+      err |= p2p_recv(root, dst, bytes, tag);
+    }
+    return err;
+  }
+
+  uint32_t do_gather(const uint8_t *src, uint8_t *dst, uint64_t bytes,
+                     uint32_t root, uint32_t tag) {
+    // eager: ring daisy-chain (.c:1206-1293); rendezvous: flat to root
+    // (.c:1142-1204). The ring keeps per-link traffic constant.
+    uint32_t err = NO_ERROR;
+    if (!is_rndzv(bytes)) {
+      uint32_t nxt = (rank + 1) % world;
+      uint32_t prv = (rank + world - 1) % world;
+      if (rank == root) {
+        std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
+        std::vector<uint8_t> tmp(bytes);
+        for (uint32_t s = 0; s < world - 1; s++) {
+          err |= egr_recv(prv, tag, tmp.data(), bytes);
+          uint32_t origin = (root + world - 1 - s) % world;
+          std::memcpy(dst + (uint64_t)origin * bytes, tmp.data(), bytes);
+        }
+      } else {
+        // relay: own data first, then forward everything originating
+        // farther from root than us — world-1-dist(rank) messages, where
+        // dist is the +1-direction hop count to root.
+        err |= egr_send(nxt, src, bytes, tag);
+        uint32_t dist = (root + world - rank) % world;
+        std::vector<uint8_t> tmp(bytes);
+        for (uint32_t s = 0; s + 1 + dist < world; s++) {
+          err |= egr_recv(prv, tag, tmp.data(), bytes);
+          err |= egr_send(nxt, tmp.data(), bytes, tag);
+        }
+      }
+      return err;
+    }
+    if (rank == root) {
+      std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
+      for (uint32_t i = 0; i < world; i++) {
+        if (i == root) continue;
+        rendezvous_send_addr(i, (uint64_t)(uintptr_t)(dst + (uint64_t)i * bytes),
+                             bytes, tag);
+      }
+      for (uint32_t i = 0; i + 1 < world; i++) {
+        uint32_t s;
+        uint64_t va;
+        err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
+      }
+    } else {
+      uint64_t vaddr;
+      err |= rendezvous_get_addr(root, bytes, tag, &vaddr);
+      if (err == NO_ERROR) err |= rendezvous_write(root, vaddr, src, bytes, tag);
+    }
+    return err;
+  }
+
+  uint32_t do_allgather(const uint8_t *src, uint8_t *dst, uint64_t bytes,
+                        uint32_t tag) {
+    // ring allgather in both protocols (.c:1297-1499)
+    uint32_t nxt = (rank + 1) % world;
+    uint32_t prv = (rank + world - 1) % world;
+    uint32_t err = NO_ERROR;
+    std::memcpy(dst + (uint64_t)rank * bytes, src, bytes);
+    const uint8_t *send_ptr = src;
+    for (uint32_t s = 0; s < world - 1; s++) {
+      uint32_t origin = (rank + world - 1 - s) % world;
+      uint8_t *recv_ptr = dst + (uint64_t)origin * bytes;
+      // send current, then receive from prev (socket buffering absorbs the
+      // send so the ring cannot deadlock at these sizes; rendezvous path
+      // posts the recv address first by construction of p2p_recv)
+      if (is_rndzv(bytes)) {
+        rendezvous_send_addr(prv, (uint64_t)(uintptr_t)recv_ptr, bytes, tag);
+        uint64_t vaddr;
+        err |= rendezvous_get_addr(nxt, bytes, tag, &vaddr);
+        if (err) return err;
+        err |= rendezvous_write(nxt, vaddr, send_ptr, bytes, tag);
+        err |= rendezvous_get_completion(prv, (uint64_t)(uintptr_t)recv_ptr,
+                                         bytes, tag);
+      } else {
+        err |= egr_send(nxt, send_ptr, bytes, tag);
+        err |= egr_recv(prv, tag, recv_ptr, bytes);
+      }
+      if (err) return err;
+      send_ptr = recv_ptr;
+    }
+    return err;
+  }
+
+  uint32_t do_reduce(uint32_t dt, uint32_t func, const uint8_t *src,
+                     uint8_t *dst, uint64_t count, uint32_t root,
+                     uint32_t tag) {
+    uint64_t bytes = count * dtype_bytes(dt);
+    uint32_t err = NO_ERROR;
+    if (world == 1) {
+      std::memcpy(dst, src, bytes);
+      return NO_ERROR;
+    }
+    if (!is_rndzv(bytes)) {
+      // eager ring relay with fused recv-reduce-send (.c:1730-1743)
+      uint32_t prv = (rank + world - 1) % world;
+      uint32_t nxt = (rank + 1) % world;
+      uint32_t l = (rank + world - root) % world;  // root at 0
+      std::vector<uint8_t> acc(src, src + bytes);
+      if (l != 1) {  // everyone except the chain head receives a partial
+        err |= egr_recv(prv, tag, acc.data(), bytes);
+        if (err) return err;
+        err |= combine_buffers(dt, func, acc.data(), src, count);
+      }
+      if (rank != root) {
+        err |= egr_send(nxt, acc.data(), bytes, tag);
+      } else {
+        std::memcpy(dst, acc.data(), bytes);
+      }
+      return err;
+    }
+    // rendezvous: flat tree when small world/message, else binomial
+    // (.c:1531-1727)
+    bool flat = world <= tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4) ||
+                bytes <= tuning(REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024);
+    uint32_t l = (rank + world - root) % world;
+    if (flat) {
+      if (rank == root) {
+        std::vector<uint8_t> scratch((uint64_t)(world - 1) * bytes);
+        for (uint32_t i = 0, j = 0; i < world; i++) {
+          if (i == root) continue;
+          rendezvous_send_addr(
+              i, (uint64_t)(uintptr_t)(scratch.data() + (uint64_t)j * bytes),
+              bytes, tag);
+          j++;
+        }
+        std::memcpy(dst, src, bytes);
+        for (uint32_t i = 0; i + 1 < world; i++) {
+          uint32_t s;
+          uint64_t va;
+          err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
+          if (err) return err;
+          err |= combine_buffers(dt, func, dst, (void *)(uintptr_t)va, count);
+        }
+      } else {
+        uint64_t vaddr;
+        err |= rendezvous_get_addr(root, bytes, tag, &vaddr);
+        if (err) return err;
+        err |= rendezvous_write(root, vaddr, src, bytes, tag);
+      }
+      return err;
+    }
+    // binomial combining tree: children l%2d==d send to parent l-d
+    std::vector<uint8_t> acc(src, src + bytes);
+    std::vector<uint8_t> tmp(bytes);
+    for (uint32_t d = 1; d < world; d <<= 1) {
+      if (l % (2 * d) == d) {
+        uint32_t peer = (l - d + root) % world;
+        err |= p2p_send(peer, acc.data(), bytes, tag);
+        return err;  // sent our subtree: done
+      }
+      if (l % (2 * d) == 0 && l + d < world) {
+        uint32_t peer = (l + d + root) % world;
+        err |= p2p_recv(peer, tmp.data(), bytes, tag);
+        if (err) return err;
+        err |= combine_buffers(dt, func, acc.data(), tmp.data(), count);
+      }
+    }
+    if (rank == root) std::memcpy(dst, acc.data(), bytes);
+    return err;
+  }
+
+  uint32_t do_allreduce(uint32_t dt, uint32_t func, const uint8_t *src,
+                        uint8_t *dst, uint64_t count, uint32_t tag) {
+    uint64_t eb = dtype_bytes(dt);
+    uint64_t bytes = count * eb;
+    if (world == 1) {
+      std::memcpy(dst, src, bytes);
+      return NO_ERROR;
+    }
+    if (is_rndzv(bytes)) {
+      // reduce + bcast composition (.c:1878-1887)
+      uint32_t err = do_reduce(dt, func, src, dst, count, 0, tag);
+      if (err) return err;
+      return do_bcast(dst, bytes, 0, tag);
+    }
+    // segmented ring reduce-scatter + allgather (.c:1888-2071)
+    uint64_t max_seg = rx_buf_bytes / eb;
+    max_seg -= max_seg % world;
+    if (max_seg == 0) max_seg = world;
+    std::vector<uint8_t> chunk_buf, tmp;
+    std::memcpy(dst, src, bytes);
+    uint32_t nxt = (rank + 1) % world;
+    uint32_t prv = (rank + world - 1) % world;
+    uint32_t err = NO_ERROR;
+    for (uint64_t off = 0; off < count; off += max_seg) {
+      uint64_t elems = std::min<uint64_t>(max_seg, count - off);
+      uint64_t bulk = (elems + world - 1) / world;
+      auto seg_chunk = [&](uint32_t idx) -> std::pair<uint64_t, uint64_t> {
+        uint64_t lo = std::min<uint64_t>(idx * bulk, elems);
+        uint64_t hi = std::min<uint64_t>(lo + bulk, elems);
+        return {lo, hi - lo};
+      };
+      uint8_t *seg = dst + off * eb;
+      // reduce-scatter: send chunk rank-1 first; hop-s arrival is chunk
+      // rank-2-s (same derivation as schedules.reduce_scatter_ring)
+      uint32_t cidx = (rank + world - 1) % world;
+      auto [clo, cn] = seg_chunk(cidx);
+      chunk_buf.assign(seg + clo * eb, seg + (clo + cn) * eb);
+      err |= egr_send(nxt, chunk_buf.data(), cn * eb, tag);
+      for (uint32_t s = 0; s < world - 1; s++) {
+        uint32_t idx = (rank + 2 * world - 2 - s) % world;
+        auto [lo, n] = seg_chunk(idx);
+        tmp.resize(n * eb);
+        err |= egr_recv(prv, tag, tmp.data(), n * eb);
+        if (err) return err;
+        err |= combine_buffers(dt, func, seg + lo * eb, tmp.data(), n);
+        if (s + 1 < world - 1)
+          err |= egr_send(nxt, seg + lo * eb, n * eb, tag);
+      }
+      // ring allgather of reduced chunks (chunk `rank` now final)
+      uint32_t gidx = rank;
+      for (uint32_t s = 0; s < world - 1; s++) {
+        auto [glo, gn] = seg_chunk(gidx);
+        err |= egr_send(nxt, seg + glo * eb, gn * eb, tag);
+        uint32_t origin = (rank + world - 1 - s) % world;
+        auto [olo, on] = seg_chunk(origin);
+        err |= egr_recv(prv, tag, seg + olo * eb, on * eb);
+        if (err) return err;
+        gidx = origin;
+      }
+    }
+    return err;
+  }
+
+  uint32_t do_reduce_scatter(uint32_t dt, uint32_t func, const uint8_t *src,
+                             uint8_t *dst, uint64_t count, uint32_t tag) {
+    // count = per-rank output elements; input holds world*count.
+    uint64_t eb = dtype_bytes(dt);
+    uint64_t bytes = count * eb;
+    if (world == 1) {
+      std::memcpy(dst, src, bytes);
+      return NO_ERROR;
+    }
+    if (is_rndzv(bytes)) {
+      // reduce(count*world) to 0 then scatter (.c:1768-1781)
+      std::vector<uint8_t> full((uint64_t)world * bytes);
+      uint32_t err =
+          do_reduce(dt, func, src, full.data(), (uint64_t)count * world, 0, tag);
+      if (err) return err;
+      return do_scatter(full.data(), dst, bytes, 0, tag);
+    }
+    // eager ring (.c:1782-1850)
+    uint32_t nxt = (rank + 1) % world;
+    uint32_t prv = (rank + world - 1) % world;
+    uint32_t err = NO_ERROR;
+    std::vector<uint8_t> acc(bytes), tmp(bytes);
+    uint32_t cidx = (rank + world - 1) % world;
+    std::memcpy(acc.data(), src + (uint64_t)cidx * bytes, bytes);
+    err |= egr_send(nxt, acc.data(), bytes, tag);
+    for (uint32_t s = 0; s < world - 1; s++) {
+      uint32_t idx = (rank + 2 * world - 2 - s) % world;
+      err |= egr_recv(prv, tag, tmp.data(), bytes);
+      if (err) return err;
+      err |= combine_buffers(dt, func, tmp.data(),
+                             src + (uint64_t)idx * bytes, count);
+      if (s + 1 < world - 1) err |= egr_send(nxt, tmp.data(), bytes, tag);
+    }
+    std::memcpy(dst, tmp.data(), bytes);
+    return err;
+  }
+
+  uint32_t do_alltoall(const uint8_t *src, uint8_t *dst, uint64_t bytes,
+                       uint32_t tag) {
+    // pairwise rotation exchange (.c:2140-2211)
+    uint32_t err = NO_ERROR;
+    std::memcpy(dst + (uint64_t)rank * bytes, src + (uint64_t)rank * bytes,
+                bytes);
+    bool rv = is_rndzv(bytes);
+    for (uint32_t k = 1; k < world; k++) {
+      uint32_t to = (rank + k) % world;
+      uint32_t from = (rank + world - k) % world;
+      uint8_t *rptr = dst + (uint64_t)from * bytes;
+      if (rv) {
+        // post our landing address before sending: every rank's step-k
+        // target posted its own at step k, so no addr-wait cycle forms
+        rendezvous_send_addr(from, (uint64_t)(uintptr_t)rptr, bytes, tag);
+        err |= p2p_send(to, src + (uint64_t)to * bytes, bytes, tag);
+        err |= rendezvous_get_completion(from, (uint64_t)(uintptr_t)rptr,
+                                         bytes, tag);
+      } else {
+        err |= p2p_send(to, src + (uint64_t)to * bytes, bytes, tag);
+        err |= p2p_recv(from, rptr, bytes, tag);
+      }
+      if (err) return err;
+    }
+    return err;
+  }
+
+  uint32_t do_barrier(uint32_t tag) {
+    // zero-payload notification gather to 0 + fan-out (.c:2078-2120)
+    uint32_t err = NO_ERROR;
+    if (rank == 0) {
+      for (uint32_t i = 1; i < world; i++) err |= egr_recv(i, tag, nullptr, 0);
+      for (uint32_t i = 1; i < world; i++) err |= egr_send(i, nullptr, 0, tag);
+    } else {
+      err |= egr_send(0, nullptr, 0, tag);
+      err |= egr_recv(0, tag, nullptr, 0);
+    }
+    return err;
+  }
+
+  // ----- sequencer main loop (run(), .c:2308-2483) -----
+
+  uint32_t execute(Call &c) {
+    uint32_t scenario = c.desc[0];
+    uint64_t count = c.desc[1];
+    uint32_t root = c.desc[3];
+    uint32_t func = c.desc[4];
+    uint32_t tag = c.desc[5];
+    uint64_t eb = dtype_bytes(c.dtype);
+    uint64_t bytes = count * eb;
+    auto *op0 = (const uint8_t *)c.op0;
+    auto *op1 = (const uint8_t *)c.op1;
+    auto *res = (uint8_t *)c.res;
+    switch (scenario) {
+      case SC_NOP:
+        return NO_ERROR;
+      case SC_CONFIG:
+        switch (func) {
+          case 2: timeout_ms = count; return NO_ERROR;      // set_timeout
+          case 3: max_eager = (uint32_t)count; return NO_ERROR;
+          case 4: max_rndzv = count; return NO_ERROR;
+          default: return NO_ERROR;  // reset/enable_pkt are no-ops here
+        }
+      case SC_COPY:
+        std::memcpy(res, op0, bytes);
+        return NO_ERROR;
+      case SC_COMBINE: {
+        std::memcpy(res, op0, bytes);
+        return combine_buffers(c.dtype, func, res, op1, count);
+      }
+      case SC_SEND:
+        // root_src_dst is the destination rank (reference send semantics)
+        return p2p_send(root, op0, bytes, tag);
+      case SC_RECV: {
+        // root_src_dst is the source rank. The eager path is resumable:
+        // current_step counts segments already landed, and a missing
+        // segment parks the call on the retry queue instead of blocking
+        // the sequencer (the firmware retry contract, .c:2336-2477).
+        if (is_rndzv(bytes)) return p2p_recv(root, res, bytes, tag);
+        if (!c.deadline_set) {
+          c.deadline = std::chrono::steady_clock::now() +
+                       std::chrono::milliseconds(timeout_ms);
+          c.deadline_set = true;
+        }
+        for (;;) {
+          uint64_t off = (uint64_t)c.current_step * rx_buf_bytes;
+          if (off >= bytes && !(bytes == 0 && c.current_step == 0)) break;
+          uint64_t got = 0;
+          uint32_t rc = egr_recv_seg(root, tag, res ? res + off : nullptr,
+                                     bytes - off, &got);
+          if (rc == NOT_READY) {
+            if (std::chrono::steady_clock::now() > c.deadline)
+              return RECEIVE_TIMEOUT_ERROR;
+            return NOT_READY;
+          }
+          if (rc != NO_ERROR) return rc;
+          c.current_step++;
+          if (bytes == 0) break;
+        }
+        return NO_ERROR;
+      }
+      case SC_BCAST:
+        return do_bcast((uint8_t *)op0, bytes, root, tag);
+      case SC_SCATTER:
+        return do_scatter(op0, res, bytes, root, tag);
+      case SC_GATHER:
+        return do_gather(op0, res, bytes, root, tag);
+      case SC_ALLGATHER:
+        return do_allgather(op0, res, bytes, tag);
+      case SC_REDUCE:
+        return do_reduce(c.dtype, func, op0, res, count, root, tag);
+      case SC_ALLREDUCE:
+        return do_allreduce(c.dtype, func, op0, res, count, tag);
+      case SC_REDUCE_SCATTER:
+        return do_reduce_scatter(c.dtype, func, op0, res, count, tag);
+      case SC_ALLTOALL:
+        return do_alltoall(op0, res, bytes, tag);
+      case SC_BARRIER:
+        return do_barrier(tag);
+      default:
+        return COLLECTIVE_NOT_IMPLEMENTED;
+    }
+  }
+
+  void sequencer() {
+    while (!stop.load()) {
+      Call c;
+      {
+        std::unique_lock<std::mutex> lk(call_mu);
+        call_cv.wait(lk, [&] {
+          return stop.load() || !call_q.empty() || !retry_q.empty();
+        });
+        if (stop.load()) return;
+        // round-robin: prefer the call queue, then retries (run() order)
+        if (!call_q.empty()) {
+          c = std::move(call_q.front());
+          call_q.pop_front();
+        } else {
+          c = std::move(retry_q.front());
+          retry_q.pop_front();
+        }
+      }
+      if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
+        fprintf(stderr, "[r%u] exec scenario=%u count=%u\n", rank, c.desc[0], c.desc[1]);
+      uint32_t rc = execute(c);
+      if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
+        fprintf(stderr, "[r%u] done scenario=%u rc=%u\n", rank, c.desc[0], rc);
+      if (rc == NOT_READY) {
+        {
+          std::lock_guard<std::mutex> lk(call_mu);
+          retry_q.push_back(std::move(c));
+        }
+        // park briefly: progress needs a new rx segment, not a re-poll
+        std::unique_lock<std::mutex> lk(rx_mu);
+        rx_cv.wait_for(lk, std::chrono::microseconds(200));
+        continue;
+      }
+      auto dur = std::chrono::steady_clock::now() - c.t_start;
+      {
+        std::lock_guard<std::mutex> lk(comp_mu);
+        auto &comp = completions[c.handle];
+        comp->retcode = rc;
+        comp->duration_ns =
+            std::chrono::duration_cast<std::chrono::nanoseconds>(dur).count();
+        comp->done.store(1);
+      }
+      comp_cv.notify_all();
+      wr(RETCODE, rc);
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
+                          const uint16_t *ports, uint32_t n_rx_bufs,
+                          uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
+                          uint64_t max_rndzv_bytes) {
+  auto *rt = new accl_rt();
+  rt->world = world;
+  rt->rank = rank;
+  rt->rx_buf_bytes = rx_buf_bytes;
+  rt->max_eager = max_eager_bytes;
+  rt->max_rndzv = max_rndzv_bytes;
+  rt->rx_slots.resize(n_rx_bufs);
+  rt->inbound_seq.assign(world, 0);
+  rt->outbound_seq.assign(world, 0);
+  rt->peer_fd.assign(world, -1);
+  rt->tx_mu = std::vector<std::mutex>(world);
+  rt->wr(IDCODE, 0xACC17B00u);
+
+  // listen
+  rt->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(rt->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(ports[rank]);
+  if (bind(rt->listen_fd, (sockaddr *)&sa, sizeof sa) != 0 ||
+      listen(rt->listen_fd, (int)world) != 0) {
+    delete rt;
+    return nullptr;
+  }
+  // accept from lower ranks in a helper thread while connecting to higher;
+  // a periodic accept timeout + overall deadline prevents a missing peer
+  // from wedging bring-up forever.
+  std::atomic<bool> accept_ok{true};
+  struct timeval tv{0, 200 * 1000};
+  setsockopt(rt->listen_fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  std::thread acceptor([&] {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
+    uint32_t accepted = 0;
+    while (accepted < rank) {
+      int fd = accept(rt->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (std::chrono::steady_clock::now() > deadline) {
+          accept_ok.store(false);
+          return;
+        }
+        continue;  // EAGAIN from the periodic timeout
+      }
+      // accepted fds inherit the listener's SO_RCVTIMEO on Linux — clear
+      // it, or idle links die with EAGAIN after the accept-poll interval
+      struct timeval never{0, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &never, sizeof never);
+      uint32_t peer;
+      if (!recv_all(fd, &peer, 4) || peer >= world) {
+        close(fd);
+        continue;
+      }
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      rt->peer_fd[peer] = fd;
+      accepted++;
+    }
+  });
+  bool ok = true;
+  for (uint32_t i = rank + 1; i < world && ok; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in pa{};
+    pa.sin_family = AF_INET;
+    pa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    pa.sin_port = htons(ports[i]);
+    // retry: peers come up in any order
+    int tries = 0;
+    while (connect(fd, (sockaddr *)&pa, sizeof pa) != 0) {
+      if (++tries > 2000) { ok = false; break; }
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+    }
+    if (!ok) { close(fd); break; }
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    uint32_t me = rank;
+    send_all(fd, &me, 4);
+    rt->peer_fd[i] = fd;
+  }
+  acceptor.join();
+  if (!ok || !accept_ok.load()) {
+    accl_rt_destroy(rt);
+    return nullptr;
+  }
+  // links are up: drop the accept timeout side effects (fd no longer used)
+  for (uint32_t i = 0; i < world; i++) {
+    if (i == rank) continue;
+    rt->rx_threads.emplace_back([rt, i] { rt->rx_loop(i); });
+  }
+  rt->seq_thread = std::thread([rt] { rt->sequencer(); });
+  return rt;
+}
+
+void accl_rt_destroy(accl_rt_t *rt) {
+  rt->stop.store(true);
+  rt->call_cv.notify_all();
+  rt->rx_cv.notify_all();
+  rt->rndzv_cv.notify_all();
+  for (int fd : rt->peer_fd)
+    if (fd >= 0) { shutdown(fd, SHUT_RDWR); close(fd); }
+  if (rt->listen_fd >= 0) close(rt->listen_fd);
+  for (auto &t : rt->rx_threads)
+    if (t.joinable()) t.join();
+  if (rt->seq_thread.joinable()) rt->seq_thread.join();
+  delete rt;
+}
+
+int64_t accl_rt_start(accl_rt_t *rt, const uint32_t desc[15],
+                      uint32_t data_type, void *op0, void *op1, void *res) {
+  Call c;
+  std::memcpy(c.desc, desc, 15 * 4);
+  c.dtype = data_type;
+  c.op0 = op0;
+  c.op1 = op1;
+  c.res = res;
+  c.t_start = std::chrono::steady_clock::now();
+  int64_t h;
+  {
+    std::lock_guard<std::mutex> lk(rt->comp_mu);
+    h = rt->next_handle++;
+    rt->completions[h] = std::make_shared<Completion>();
+  }
+  c.handle = h;
+  {
+    std::lock_guard<std::mutex> lk(rt->call_mu);
+    rt->call_q.push_back(std::move(c));
+  }
+  rt->call_cv.notify_all();
+  return h;
+}
+
+int accl_rt_test(accl_rt_t *rt, int64_t handle) {
+  std::lock_guard<std::mutex> lk(rt->comp_mu);
+  auto it = rt->completions.find(handle);
+  return it != rt->completions.end() && it->second->done.load();
+}
+
+int accl_rt_wait(accl_rt_t *rt, int64_t handle, uint64_t timeout_ms) {
+  std::unique_lock<std::mutex> lk(rt->comp_mu);
+  auto it = rt->completions.find(handle);
+  if (it == rt->completions.end()) return 0;
+  auto comp = it->second;
+  auto pred = [&] { return comp->done.load() != 0; };
+  if (timeout_ms == 0) {
+    rt->comp_cv.wait(lk, pred);
+    return 1;
+  }
+  return rt->comp_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)
+             ? 1
+             : 0;
+}
+
+uint32_t accl_rt_retcode(accl_rt_t *rt, int64_t handle) {
+  std::lock_guard<std::mutex> lk(rt->comp_mu);
+  auto it = rt->completions.find(handle);
+  return it == rt->completions.end() ? 0 : it->second->retcode;
+}
+
+uint64_t accl_rt_duration_ns(accl_rt_t *rt, int64_t handle) {
+  std::lock_guard<std::mutex> lk(rt->comp_mu);
+  auto it = rt->completions.find(handle);
+  return it == rt->completions.end() ? 0 : it->second->duration_ns;
+}
+
+/* Drop a completed call's bookkeeping (call after reading retcode and
+ * duration) so long-lived ranks do not accumulate completion records. */
+void accl_rt_release(accl_rt_t *rt, int64_t handle) {
+  std::lock_guard<std::mutex> lk(rt->comp_mu);
+  rt->completions.erase(handle);
+}
+
+uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr) { return rt->rd(addr); }
+
+void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value) {
+  rt->wr(addr, value);
+}
+
+}  // extern "C"

@@ -1,0 +1,319 @@
+"""Native emulator tests: the multi-rank CPU runtime over real sockets.
+
+The role of the reference's emulator CI (gtest suite under mpirun against
+test/model/emulator — SURVEY.md §4): every collective executes across N
+rank runtimes, eager and rendezvous, checked against numpy oracles.
+BASELINE.md target config 1 (2-rank fp32 ping-pong) lives here.
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ReduceFunction
+from accl_tpu.device.emu_device import EmuWorld
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture(scope="module")
+def world4():
+    w = EmuWorld(4)
+    yield w
+    w.close()
+
+
+def test_two_rank_pingpong():
+    """BASELINE config 1: 2-rank fp32 send/recv ping-pong."""
+    w = EmuWorld(2)
+    try:
+        x = RNG.standard_normal(256).astype(np.float32)
+
+        def body(rank, i):
+            if i == 0:
+                buf = x.copy()
+                rank.send(buf, 256, dst=1, tag=7)
+                back = np.zeros(256, np.float32)
+                rank.recv(back, 256, src=1, tag=8)
+                return back
+            else:
+                buf = np.zeros(256, np.float32)
+                rank.recv(buf, 256, src=0, tag=7)
+                buf *= 2.0
+                rank.send(buf, 256, dst=0, tag=8)
+                return None
+
+        res = w.run(body)
+        np.testing.assert_allclose(res[0], x * 2.0, rtol=1e-6)
+    finally:
+        w.close()
+
+
+def test_pingpong_rendezvous():
+    """Large message: exercises addr handshake + one-sided write."""
+    w = EmuWorld(2)
+    try:
+        n = 100_000  # 400 KB >> max_eager -> rendezvous
+        x = RNG.standard_normal(n).astype(np.float32)
+
+        def body(rank, i):
+            if i == 0:
+                rank.send(x.copy(), n, dst=1)
+            else:
+                buf = np.zeros(n, np.float32)
+                rank.recv(buf, n, src=0)
+                return buf
+
+        res = w.run(body)
+        np.testing.assert_allclose(res[1], x, rtol=0)
+    finally:
+        w.close()
+
+
+@pytest.mark.parametrize("count", [64, 5000])  # eager / rendezvous
+def test_emu_bcast(world4, count):
+    x = RNG.standard_normal(count).astype(np.float32)
+
+    def body(rank, i):
+        buf = x.copy() if i == 2 else np.zeros(count, np.float32)
+        rank.bcast(buf, count, root=2)
+        return buf
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out, x, rtol=0)
+
+
+@pytest.mark.parametrize("count", [32, 4096])
+def test_emu_scatter_gather(world4, count):
+    x = RNG.standard_normal(4 * count).astype(np.float32)
+
+    def body(rank, i):
+        rb = np.zeros(count, np.float32)
+        rank.scatter(x.copy() if i == 0 else np.zeros(4 * count, np.float32),
+                     rb, count, root=0)
+        gb = np.zeros(4 * count, np.float32)
+        rank.gather(rb, gb, count, root=3)
+        return rb, gb
+
+    res = world4.run(body)
+    for i, (rb, _) in enumerate(res):
+        np.testing.assert_allclose(rb, x[i * count:(i + 1) * count], rtol=0)
+    np.testing.assert_allclose(res[3][1], x, rtol=0)
+
+
+@pytest.mark.parametrize("count", [16, 3000])
+def test_emu_allgather(world4, count):
+    xs = RNG.standard_normal((4, count)).astype(np.float32)
+
+    def body(rank, i):
+        out = np.zeros(4 * count, np.float32)
+        rank.allgather(xs[i].copy(), out, count)
+        return out
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out, xs.reshape(-1), rtol=0)
+
+
+@pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
+@pytest.mark.parametrize("count", [64, 20000])  # eager ring / rndzv bin-tree
+def test_emu_reduce(world4, func, count):
+    xs = RNG.standard_normal((4, count)).astype(np.float32)
+    exp = xs.sum(0) if func == ReduceFunction.SUM else xs.max(0)
+
+    def body(rank, i):
+        out = np.zeros(count, np.float32)
+        rank.reduce(xs[i].copy(), out, count, root=1, func=func)
+        return out
+
+    res = world4.run(body)
+    np.testing.assert_allclose(res[1], exp, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("count", [8, 250, 2048, 9000])
+def test_emu_allreduce(world4, count):
+    xs = RNG.standard_normal((4, count)).astype(np.float32)
+
+    def body(rank, i):
+        out = np.zeros(count, np.float32)
+        rank.allreduce(xs[i].copy(), out, count, ReduceFunction.SUM)
+        return out
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("count", [16, 3000])
+def test_emu_reduce_scatter(world4, count):
+    xs = RNG.standard_normal((4, 4 * count)).astype(np.float32)
+    full = xs.sum(0)
+
+    def body(rank, i):
+        out = np.zeros(count, np.float32)
+        rank.reduce_scatter(xs[i].copy(), out, count, ReduceFunction.SUM)
+        return out
+
+    res = world4.run(body)
+    for i, out in enumerate(res):
+        np.testing.assert_allclose(out, full[i * count:(i + 1) * count],
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("count", [8, 2000])
+def test_emu_alltoall(world4, count):
+    xs = RNG.standard_normal((4, 4 * count)).astype(np.float32)
+
+    def body(rank, i):
+        out = np.zeros(4 * count, np.float32)
+        rank.alltoall(xs[i].copy(), out, count)
+        return out
+
+    res = world4.run(body)
+    for r in range(4):
+        for s in range(4):
+            np.testing.assert_allclose(
+                res[r][s * count:(s + 1) * count],
+                xs[s, r * count:(r + 1) * count], rtol=0)
+
+
+def test_emu_barrier_and_locals(world4):
+    world4.run(lambda rank, i: rank.barrier())
+    a = RNG.standard_normal(100).astype(np.float32)
+    b = RNG.standard_normal(100).astype(np.float32)
+
+    def body(rank, i):
+        out = np.zeros(100, np.float32)
+        rank.combine(100, ReduceFunction.MAX, a.copy(), b.copy(), out)
+        dst = np.zeros(100, np.float32)
+        rank.copy(out, dst, 100)
+        return dst
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out, np.maximum(a, b), rtol=0)
+
+
+def test_emu_fp16_bf16_combine(world4):
+    import ml_dtypes
+    a16 = RNG.standard_normal(64).astype(np.float16)
+    b16 = RNG.standard_normal(64).astype(np.float16)
+
+    def body(rank, i):
+        out = np.zeros(64, np.float16)
+        rank.combine(64, ReduceFunction.SUM, a16.copy(), b16.copy(), out)
+        return out
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out.astype(np.float32),
+                                   (a16 + b16).astype(np.float32),
+                                   rtol=1e-2, atol=1e-2)
+    abf = (RNG.standard_normal(64)).astype(ml_dtypes.bfloat16)
+    bbf = (RNG.standard_normal(64)).astype(ml_dtypes.bfloat16)
+
+    def body_bf(rank, i):
+        out = np.zeros(64, ml_dtypes.bfloat16)
+        rank.combine(64, ReduceFunction.SUM, abf.copy(), bbf.copy(), out)
+        return out
+
+    for out in world4.run(body_bf):
+        np.testing.assert_allclose(out.astype(np.float32),
+                                   (abf + bbf).astype(np.float32),
+                                   rtol=5e-2, atol=5e-2)
+
+
+def test_emu_recv_timeout(world4):
+    """No matching send: the housekeeping timeout fires
+    (HOUSEKEEP_TIMEOUT analog, .c:2429-2431)."""
+    def body(rank, i):
+        if i == 0:
+            rank.write(0x0, 0)  # touch exchmem to prove MMIO works
+            import accl_tpu.descriptor as d
+            from accl_tpu import CallOptions, Operation, DataType
+            opts = CallOptions(scenario=Operation.config, function=2, count=200)
+            rank.call(opts)  # set_timeout 200ms
+            buf = np.zeros(16, np.float32)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.recv(buf, 16, src=1, tag=999)
+            opts = CallOptions(scenario=Operation.config, function=2, count=5000)
+            rank.call(opts)
+        return None
+
+    world4.run(body)
+
+
+def test_emu_async_and_duration(world4):
+    xs = RNG.standard_normal((4, 512)).astype(np.float32)
+
+    def body(rank, i):
+        from accl_tpu import CallOptions, Operation
+        from accl_tpu.constants import from_numpy_dtype
+        out = np.zeros(512, np.float32)
+        opts = rank._opts(Operation.allreduce, 512, np.float32,
+                          func=ReduceFunction.SUM)
+        h = rank.start(opts, op0=xs[i].copy(), res=out)
+        rank.wait(h)
+        assert rank.duration_ns(h) > 0
+        return out
+
+    for out in world4.run(body):
+        np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_emu_eight_ranks_binomial_and_rings():
+    """world=8: exercises the binomial reduce tree (world > flat-tree max
+    of 4 at rendezvous sizes) and deeper rings."""
+    w = EmuWorld(8)
+    try:
+        n = 20000  # 80 KB -> rendezvous, > 32KB tuning -> binomial tree
+        xs = RNG.standard_normal((8, n)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(n, np.float32)
+            rank.reduce(xs[i].copy(), out, n, root=5, func=ReduceFunction.SUM)
+            ag = np.zeros(8 * 64, np.float32)
+            rank.allgather(xs[i, :64].copy(), ag, 64)
+            ar = np.zeros(777, np.float32)
+            rank.allreduce(xs[i, :777].copy(), ar, 777, ReduceFunction.MAX)
+            return out, ag, ar
+
+        res = w.run(body)
+        np.testing.assert_allclose(res[5][0], xs.sum(0), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(res[2][1], xs[:, :64].reshape(-1), rtol=0)
+        np.testing.assert_allclose(res[7][2], xs[:, :777].max(0), rtol=0)
+    finally:
+        w.close()
+
+
+def test_emu_max_rndzv_enforced():
+    """Rendezvous transfers past the configured ceiling fail with
+    DMA_SIZE_ERROR instead of silently proceeding."""
+    w = EmuWorld(2, max_rndzv=16 * 1024)
+    try:
+        def body(rank, i):
+            n = 10_000  # 40 KB > 16 KB ceiling
+            if i == 0:
+                with pytest.raises(ACCLError, match="DMA_SIZE_ERROR"):
+                    rank.send(np.zeros(n, np.float32), n, dst=1)
+            else:
+                with pytest.raises(ACCLError, match="DMA_SIZE_ERROR"):
+                    rank.recv(np.zeros(n, np.float32), n, src=0)
+        w.run(body)
+    finally:
+        w.close()
+
+
+def test_emu_links_survive_idle():
+    """Regression: accepted sockets must not inherit the listener's
+    accept-poll timeout — links idle past it used to die silently."""
+    import time
+    w = EmuWorld(3)
+    try:
+        time.sleep(0.6)  # > the 200ms accept poll interval
+        xs = RNG.standard_normal((3, 2000)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(2000, np.float32)
+            rank.allreduce(xs[i].copy(), out, 2000, ReduceFunction.SUM)
+            return out
+
+        for out in w.run(body):
+            np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+    finally:
+        w.close()
