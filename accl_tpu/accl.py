@@ -41,6 +41,7 @@ from .descriptor import CallOptions
 from .device.base import CCLOAddr
 from .device.tpu_device import TPUDevice
 from .request import BaseRequest
+from .utils.logging import Log
 
 
 class ACCL:
@@ -224,6 +225,9 @@ class ACCL:
         if not from_device:
             for b in sync_in:
                 b.sync_to_device()
+        Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
+                  opts.count, int(opts.compression_flags),
+                  int(opts.stream_flags))
         req = self.cclo.start(opts)
         self._last_request = req
         if run_async:
@@ -343,6 +347,39 @@ class ACCL:
                              count, compress_dtype=compress_dtype)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
+
+    def register_stream_producer(self, stream_id: int, fn):
+        """Attach a device-side producer to a kernel stream (the PL
+        kernel's data_to_cclo port, accl_hls.h ACCLData)."""
+        self.cclo.streams.register_producer(stream_id, fn)
+
+    def register_stream_consumer(self, stream_id: int, fn):
+        self.cclo.streams.register_consumer(stream_id, fn)
+
+    def stream_put(self, count, stream_id, src, dst, recvbuf, *,
+                   dtype=DataType.float32, run_async=False):
+        """Device-autonomous send: the payload is produced on-device by
+        the registered stream producer and lands in recvbuf at dst after
+        dst's consumer kernel — no host data path (reference stream_put
+        flow, SURVEY.md §3.4 / vadd_put.cpp:55-72)."""
+        opts = CallOptions(
+            scenario=Operation.send,
+            count=count,
+            root_src_dst=src | (dst << 16),
+            tag=stream_id,
+            stream_flags=StreamFlags.OP0_STREAM,
+            data_type=dtype,
+            addr_2=recvbuf.address,
+        )
+        req = self.cclo.stream_put(opts)
+        self._last_request = req
+        if run_async:
+            req._accl_sync_out = [recvbuf]
+            return req
+        req.wait()
+        req.check()
+        recvbuf.sync_from_device()
+        return req
 
     def barrier(self):
         opts = self._prepare(Operation.barrier, None, None, None, 0)

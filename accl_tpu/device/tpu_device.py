@@ -48,6 +48,11 @@ class TPUDevice(CCLODevice):
         # Pending sends awaiting their recv partner (single-controller
         # pairing of the MPI-style send/recv API).
         self._pending_sends: dict[tuple, CallOptions] = {}
+        # Kernel-stream endpoints (strm != 0 routing, SURVEY.md §3.4).
+        from ..ops.streams import StreamRegistry
+
+        self.streams = StreamRegistry()
+        self._stream_cache: dict = {}
 
     # -- registry ---------------------------------------------------------
 
@@ -192,6 +197,62 @@ class TPUDevice(CCLODevice):
             addr_2=options.addr_2,
         )
         return self._launch(pair)
+
+    # -- kernel streams (stream_put flow, vadd_put analog) -----------------
+
+    def stream_put(self, options: CallOptions) -> BaseRequest:
+        """Producer -> collective fused in one program: the operand comes
+        from the stream producer registered under options.tag (the strm
+        field rides the tag, like the reference's strm=tag routing,
+        dma_mover.cpp:497) and the payload lands in the destination's
+        result buffer after its consumer kernel."""
+        from ..ops.streams import splice_consumer, splice_producer
+        from ..sequencer import schedules
+
+        sid = options.tag
+        src = options.root_src_dst & 0xFFFF
+        dst = (options.root_src_dst >> 16) & 0xFFFF
+        res = self._buf(options.addr_2)
+        prod = self.streams.producer(sid)
+        cons = self.streams.consumer(sid)
+        key = (sid, options.count, options.root_src_dst, options.data_type,
+               id(prod), id(cons))
+        prog = self._stream_cache.get(key)
+        if prog is None:
+            import functools
+
+            from jax.sharding import PartitionSpec
+
+            body = functools.partial(
+                schedules.sendrecv_schedule,
+                src=src,
+                dst=dst,
+                axis=self.axis_name,
+                world=self.world,
+                wire=schedules.Wire(None),
+            )
+            body = splice_producer(body, prod, options.count)
+            body = splice_consumer(body, cons)
+
+            def wrapped(x):
+                out = body(x.reshape(x.shape[-1]))
+                return out.reshape(1, out.shape[-1])
+
+            spec = PartitionSpec(self.axis_name)
+            prog = jax.jit(
+                jax.shard_map(
+                    wrapped, mesh=self.mesh, in_specs=(spec,),
+                    out_specs=spec, check_vma=False,
+                )
+            )
+            self._stream_cache[key] = prog
+        placeholder = res.device[..., : options.count]
+        out = prog(placeholder)
+
+        def place(req):
+            res.device = _place_into(res.device, out)
+
+        return TPURequest("stream_put", [out], on_complete=place)
 
     # -- config calls (ACCL_CONFIG switch, .c:2416-2452) -------------------
 

@@ -1,0 +1,16 @@
+"""Demo model family: workloads that exercise the framework end to end.
+
+The reference ships example kernels (vadd_put: compute fused with a
+collective, kernels/plugins/vadd_put/vadd_put.cpp:25-87) rather than
+models. Here the same role at TPU scale: a transformer LM whose tensor-
+parallel reductions, sequence-parallel attention and data-parallel
+gradient sync all run through the framework's own schedule bodies inside
+one compiled training step.
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    make_train_step,
+    make_forward,
+)

@@ -1,0 +1,72 @@
+"""Kernel streams: device-side producers/consumers fused with collectives.
+
+The reference lets PL kernels push data straight into the CCLO's kernel
+streams: a header with strm != 0 bypasses the rx buffers and routes
+payloads directly to a consumer kernel (stream_put flow, SURVEY.md §3.4;
+vadd_put.cpp:55-72, tcp_depacketizer.cpp:106-117). The TPU-native form:
+a registry of named stream endpoints whose producer/consumer are traced
+functions — the lowering splices them into the collective schedule so
+compute -> collective -> compute runs as ONE compiled device program with
+no HBM round-trip between stages (XLA fuses the seams).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+class StreamRegistry:
+    """Named device-side stream endpoints (the CCLO kernel-stream ports).
+
+    producer: () -> array        (data_to_cclo stream)
+    consumer: array -> array     (data_from_cclo stream; returns the value
+                                  materialized as the program output)
+    """
+
+    def __init__(self):
+        self._producers: dict[int, Callable] = {}
+        self._consumers: dict[int, Callable] = {}
+
+    def register_producer(self, stream_id: int, fn: Callable):
+        if not 0 < stream_id < 247:  # 247..255 reserved, 0 = no stream
+            raise ValueError("stream id must be in 1..246")
+        self._producers[stream_id] = fn
+
+    def register_consumer(self, stream_id: int, fn: Callable):
+        if not 0 < stream_id < 247:
+            raise ValueError("stream id must be in 1..246")
+        self._consumers[stream_id] = fn
+
+    def producer(self, stream_id: int) -> Callable:
+        try:
+            return self._producers[stream_id]
+        except KeyError:
+            raise KeyError(f"no producer registered on stream {stream_id}") from None
+
+    def consumer(self, stream_id: int) -> Callable:
+        return self._consumers.get(stream_id, lambda x: x)
+
+
+def splice_producer(body, producer, n_expected):
+    """Wrap a 1-operand schedule body so its operand comes from a traced
+    producer instead of a buffer (OP0_STREAM semantics: streams are read
+    once, never segmented — .c:929-931)."""
+
+    def wrapped(_placeholder):
+        data = producer()
+        data = jnp.reshape(data, (-1,))[:n_expected]
+        return body(data)
+
+    return wrapped
+
+
+def splice_consumer(body, consumer):
+    """RES_STREAM semantics: route the schedule result through a consumer
+    kernel before it lands in the result buffer."""
+
+    def wrapped(*args):
+        return consumer(body(*args))
+
+    return wrapped

@@ -1,0 +1,51 @@
+"""Mesh construction helpers.
+
+Maps the reference's communicator bring-up (rank tables over a network,
+accl_network_utils) onto jax device meshes: named axes for data, sequence
+and tensor parallelism, with ICI carrying the inner axes. On multi-host
+slices the outermost axis should span hosts so DCN only carries the
+lowest-frequency collectives (data-parallel gradient sync).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factorize_devices(n: int, names=("dp", "sp", "tp")) -> dict[str, int]:
+    """Split n devices over parallelism axes, preferring tp (highest
+    bandwidth demand) then sp then dp, in powers of two."""
+    sizes = {name: 1 for name in names}
+    # growth priority: tp, then sp, then dp when present; custom axis
+    # names fall back to the given order
+    preferred = [m for m in ("tp", "sp", "dp") if m in sizes]
+    order = preferred + [m for m in names if m not in preferred]
+    remaining = n
+    # round-robin factors of two so every axis participates before any
+    # axis grows (8 devices -> tp2 x sp2 x dp2)
+    while remaining % 2 == 0 and remaining > 1:
+        for name in order:
+            if remaining % 2 != 0 or remaining <= 1:
+                break
+            sizes[name] *= 2
+            remaining //= 2
+    if remaining > 1:  # odd leftover rides the first axis
+        sizes[order[0]] *= remaining
+    assert math.prod(sizes.values()) == n
+    return sizes
+
+
+def make_mesh(axes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a named mesh: make_mesh({'dp': 2, 'tp': 4})."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if axes is None:
+        axes = factorize_devices(len(devices))
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    if math.prod(shape) != len(devices):
+        raise ValueError(f"axes {axes} do not cover {len(devices)} devices")
+    return Mesh(np.array(devices).reshape(shape), names)

@@ -1,0 +1,104 @@
+"""Ring attention: exact attention over sequences sharded across a mesh
+axis, with K/V blocks rotating around the ring.
+
+This is the framework's eager-ring schedule applied to attention state:
+the same neighbor-permute relay as the ring collectives
+(sequencer/schedules.py, ccl_offload_control.c:1402-1499's relay
+structure), with the per-hop payload being K/V blocks and the local
+combine being a numerically-stable online-softmax accumulation
+(flash-attention style: running max m, normalizer l, weighted value acc).
+Communication volume per device is O(T_local * D * P) over P-1 hops —
+the ring keeps per-link traffic constant, which is what makes the
+sequence length scalable (long-context first-class, SURVEY.md §5).
+
+Composable inside any shard_map body; differentiable (jax autodiff
+traverses ppermute), so the same function serves training.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, q_pos, k_pos, causal, sm_scale):
+    """Scores + masked online-softmax statistics for one K/V block.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D). Returns (m, l, acc) partials in
+    fp32: per-query running max, normalizer, and value accumulator.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = k_pos[None, :] <= q_pos[:, None]  # (Tq, Tk)
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # (B, H, Tq)
+    # guard fully-masked rows (m = -inf) so exp stays finite
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # (B, H, Tq)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_safe, l, acc
+
+
+def _merge(state, new):
+    """Combine two online-softmax partials (the associative flash merge)."""
+    m0, l0, a0 = state
+    m1, l1, a1 = new
+    m = jnp.maximum(m0, m1)
+    c0 = jnp.exp(m0 - m)
+    c1 = jnp.exp(m1 - m)
+    l = l0 * c0 + l1 * c1
+    a = a0 * c0[..., None] + a1 * c1[..., None]
+    return m, l, a
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: float | None = None,
+):
+    """Per-device body (call inside shard_map).
+
+    q, k, v: local sequence shards of shape (B, T_local, H, D); the global
+    sequence is the concatenation over the axis in rank order. Returns the
+    local attention output (B, T_local, H, D).
+    """
+    world = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    q_pos = me * T + jnp.arange(T)
+
+    # local block first
+    k_pos = me * T + jnp.arange(T)
+    state = _block_attend(q, k, v, q_pos, k_pos, causal, sm_scale)
+
+    if world > 1:
+        perm = [(i, (i + 1) % world) for i in range(world)]
+
+        # lax.scan (not fori_loop) so reverse-mode autodiff can traverse
+        # the ring during training.
+        def step(carry, s):
+            state, (k_r, v_r) = carry
+            k_r = lax.ppermute(k_r, axis_name, perm)
+            v_r = lax.ppermute(v_r, axis_name, perm)
+            # after s+1 hops the arriving block originated at rank me-1-s
+            origin = (me - 1 - s) % world
+            k_pos = origin * T + jnp.arange(T)
+            new = _block_attend(q, k_r, v_r, q_pos, k_pos, causal, sm_scale)
+            return (_merge(state, new), (k_r, v_r)), None
+
+        (state, _), _ = lax.scan(step, (state, (k, v)), jnp.arange(world - 1))
+
+    m, l, acc = state
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows emit zeros
+    out = (acc / l[..., None]).astype(q.dtype)  # (B, H, T, D)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -1,0 +1,64 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The alternative long-context strategy to ring attention: instead of
+rotating K/V, one all-to-all re-shards activations from sequence-sharded
+to head-sharded, attention runs with full sequence visibility per head
+group, and a second all-to-all restores sequence sharding. The all-to-all
+is the rotation pairwise exchange of the sequencer's FLAT_ALLTOALL
+schedule (ccl_offload_control.c:2140-2211), here fused by XLA into one
+ICI collective. Communication is O(T*H*D/P) per device per direction —
+cheaper than the ring when heads divide evenly, at the cost of head-count
+divisibility by the axis size.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _seq_to_heads(x, axis_name, world):
+    """(B, T_local, H, D) -> (B, T_global, H/P, D).
+
+    all_to_all(tiled=False) consumes the world-sized split axis and inserts
+    a new world-sized axis (indexed by origin rank) at concat_axis; origin
+    rank order IS sequence-block order here.
+    """
+    B, T, H, D = x.shape
+    x = x.reshape(B, T, world, H // world, D)  # head-major groups: h = w*Hl+hl
+    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+    return x.reshape(B, T * world, H // world, D)
+
+
+def _heads_to_seq(x, axis_name, world):
+    """(B, T_global, H/P, D) -> (B, T_local, H, D)."""
+    B, TG, Hl, D = x.shape
+    T = TG // world
+    x = x.reshape(B, world, T, Hl, D)
+    # origin rank = head group index; insert it before the local-head axis
+    # so the reshape restores h = w*Hl + hl
+    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
+    return x.reshape(B, T, world * Hl, D)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      sm_scale: float | None = None):
+    """Per-device body (call inside shard_map): sequence-sharded q/k/v of
+    shape (B, T_local, H, D) with H divisible by the axis size."""
+    world = lax.axis_size(axis_name)
+    B, T, H, D = q.shape
+    if H % world != 0:
+        raise ValueError(f"heads {H} must divide by axis size {world}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    qg, kg, vg = (_seq_to_heads(t, axis_name, world) for t in (q, k, v))
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * sm_scale
+    if causal:
+        TG = qg.shape[1]
+        mask = jnp.tril(jnp.ones((TG, TG), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    s = jnp.where(jnp.isfinite(s), s, -1e30)  # stable fully-masked rows
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vg.dtype), vg)
+    return _heads_to_seq(out, axis_name, world)

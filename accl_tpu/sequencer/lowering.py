@@ -104,6 +104,11 @@ class ScheduleCompiler:
         key = (options.signature(), plan, self.axis_name, self.use_pallas_ring)
         fn = self._cache.get(key)
         if fn is None:
+            from ..utils.logging import Log
+
+            Log.info("compiling %s: %s/%s world=%d count=%d",
+                     options.scenario.name, plan.protocol.name,
+                     plan.algorithm.name, self.world, options.count)
             fn = self._build(options, plan, arithcfg)
             self._cache[key] = fn
         return fn

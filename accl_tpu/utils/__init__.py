@@ -1,0 +1,1 @@
+from .logging import Log, log  # noqa: F401

@@ -1,0 +1,41 @@
+"""Leveled, thread-safe logger shared by the driver and test paths.
+
+Reference: test/log/log.hpp:29-48 — a leveled logger threaded through the
+emulator and HLS-sim code paths; here a thin wrapper over the stdlib with
+the same level vocabulary, honoring ACCL_LOG_LEVEL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_LEVELS = {
+    "verbose": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _make_logger() -> logging.Logger:
+    logger = logging.getLogger("accl_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("[ACCL %(levelname)s %(asctime)s] %(message)s",
+                              "%H:%M:%S")
+        )
+        logger.addHandler(h)
+    level = os.environ.get("ACCL_LOG_LEVEL", "warning").lower()
+    logger.setLevel(_LEVELS.get(level, logging.WARNING))
+    return logger
+
+
+Log = _make_logger()
+
+
+def log(level: str, msg: str, *args):
+    Log.log(_LEVELS.get(level, logging.INFO), msg, *args)

@@ -317,3 +317,42 @@ def test_emu_links_survive_idle():
             np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
     finally:
         w.close()
+
+
+def test_emu_stress_async_sendrecv():
+    """Stress: hundreds of back-to-back async sends drained by matching
+    recvs (reference test/host/xrt/src/stress.cpp:24-34 runs 2000; the
+    emulator path covers 400 here to keep CI time bounded)."""
+    w = EmuWorld(2)
+    try:
+        N = 400
+        payload = 64
+        xs = [RNG.standard_normal(payload).astype(np.float32) for _ in range(N)]
+
+        def body(rank, i):
+            from accl_tpu import Operation
+            if i == 0:
+                handles = []
+                bufs = []
+                for j in range(N):
+                    b = xs[j].copy()
+                    bufs.append(b)
+                    h = rank.start(rank._opts(Operation.send, payload,
+                                              np.float32, 1, tag=j),
+                                   op0=b)
+                    handles.append(h)
+                for h in handles:
+                    rank.wait(h)
+            else:
+                outs = []
+                for j in range(N):
+                    o = np.zeros(payload, np.float32)
+                    rank.recv(o, payload, src=0, tag=j)
+                    outs.append(o)
+                return outs
+
+        res = w.run(body)
+        for j in range(N):
+            np.testing.assert_allclose(res[1][j], xs[j], rtol=0)
+    finally:
+        w.close()

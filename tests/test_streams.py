@@ -1,0 +1,66 @@
+"""Kernel-stream tests: the vadd_put flow (reference test/host/hls
+hls_simulator/test.cpp drives vadd_put through the BFM + emulator;
+here the producer/consumer are traced device functions fused into the
+collective program)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accl_tpu.accl import ACCL
+
+WORLD = 8
+RNG = np.random.default_rng(33)
+
+
+@pytest.fixture(scope="module")
+def accl(mesh8):
+    return ACCL(mesh8)
+
+
+def test_vadd_put_flow(accl):
+    """Producer computes a+b on-device (the vadd), streams it to rank 5,
+    whose consumer doubles it — one compiled program, no host data path."""
+    n = 96
+    a = RNG.standard_normal((WORLD, n)).astype(np.float32)
+    b = RNG.standard_normal((WORLD, n)).astype(np.float32)
+    ba = accl.create_buffer(n, data=a)
+    bb = accl.create_buffer(n, data=b)
+    out = accl.create_buffer(n)
+
+    def producer(_a=ba, _b=bb):
+        # runs inside shard_map; closed-over buffers appear replicated, so
+        # each rank selects its own row by axis index
+        from jax import lax
+
+        me = lax.axis_index("ccl")
+        av = lax.dynamic_index_in_dim(_a.device, me, 0, keepdims=False)
+        bv = lax.dynamic_index_in_dim(_b.device, me, 0, keepdims=False)
+        return av + bv
+
+    accl.register_stream_producer(9, producer)
+    accl.register_stream_consumer(9, lambda x: x * 2.0)
+    accl.stream_put(n, stream_id=9, src=2, dst=5, recvbuf=out)
+    expected = (a[2] + b[2]) * 2.0
+    np.testing.assert_allclose(out.host[5], expected, rtol=1e-5)
+
+
+def test_stream_id_validation(accl):
+    with pytest.raises(ValueError):
+        accl.register_stream_producer(0, lambda: None)
+    with pytest.raises(KeyError):
+        out = accl.create_buffer(8)
+        accl.stream_put(8, stream_id=77, src=0, dst=1, recvbuf=out)
+
+
+def test_stream_reregistration_takes_effect(accl):
+    """Re-registering a stream endpoint must not hit a stale compiled
+    program."""
+    out = accl.create_buffer(8)
+    accl.register_stream_producer(11, lambda: jnp.ones(8, jnp.float32))
+    accl.stream_put(8, stream_id=11, src=0, dst=1, recvbuf=out)
+    np.testing.assert_allclose(out.host[1], np.ones(8), rtol=0)
+    accl.register_stream_producer(11, lambda: 2 * jnp.ones(8, jnp.float32))
+    accl.stream_put(8, stream_id=11, src=0, dst=1, recvbuf=out)
+    np.testing.assert_allclose(out.host[1], 2 * np.ones(8), rtol=0)
