@@ -143,17 +143,20 @@ class ACCL:
         return NamedSharding(self.mesh, PartitionSpec(self.axis_name))
 
     def create_buffer(
-        self, count: int, dtype=np.float32, data: np.ndarray | None = None
+        self, count: int, dtype=np.float32, data: np.ndarray | None = None,
+        host_only: bool = False,
     ) -> TPUBuffer:
         """Allocate a stacked (world, count) rank buffer in HBM (the
-        reference's create_buffer factories, accl.hpp:760-987)."""
+        reference's create_buffer factories, accl.hpp:760-987).
+        host_only buffers live in host memory and are staged to HBM around
+        each call (the reference's host-only XRTBuffer / OP*_HOST flags)."""
         if isinstance(dtype, DataType):
             dtype = to_numpy_dtype(dtype)
         if data is None:
             data = np.zeros((self.world, count), dtype)
         else:
             data = np.asarray(data, dtype).reshape(self.world, count)
-        buf = TPUBuffer(data, self._sharding())
+        buf = TPUBuffer(data, self._sharding(), host_only=host_only)
         self.cclo.register_buffer(buf)
         return buf
 
@@ -187,6 +190,11 @@ class ACCL:
                         "compression instead"
                     )
         comp = CompressionFlags.NO_COMPRESSION
+        host = HostFlags.NO_HOST
+        for b, flag in ((op0, HostFlags.OP0_HOST), (op1, HostFlags.OP1_HOST),
+                        (res, HostFlags.RES_HOST)):
+            if b is not None and getattr(b, "host_only", False):
+                host |= flag
         arithcfg_addr = 0
         if dtype is not None:
             pair = (dtype, compress_dtype or dtype)
@@ -205,7 +213,7 @@ class ACCL:
             arithcfg_addr=arithcfg_addr,
             compression_flags=comp,
             stream_flags=StreamFlags.NO_STREAM,
-            host_flags=HostFlags.NO_HOST,
+            host_flags=host,
             addr_0=0 if op0 is None else op0.address,
             addr_1=0 if op1 is None else op1.address,
             addr_2=0 if res is None else res.address,
@@ -222,8 +230,10 @@ class ACCL:
         to_device: bool,
         run_async: bool,
     ):
-        if not from_device:
-            for b in sync_in:
+        for b in sync_in:
+            # host-only operands always stage to HBM; device buffers only
+            # when the caller didn't claim from_device residence
+            if not from_device or getattr(b, "host_only", False):
                 b.sync_to_device()
         Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
                   opts.count, int(opts.compression_flags),
@@ -231,12 +241,18 @@ class ACCL:
         req = self.cclo.start(opts)
         self._last_request = req
         if run_async:
-            req._accl_sync_out = [] if to_device else sync_out
+            if to_device:
+                # host-only results still need their copy-back on wait
+                req._accl_sync_out = [
+                    b for b in sync_out if getattr(b, "host_only", False)
+                ]
+            else:
+                req._accl_sync_out = sync_out
             return req
         req.wait()
         req.check()
-        if not to_device:
-            for b in sync_out:
+        for b in sync_out:
+            if not to_device or getattr(b, "host_only", False):
                 b.sync_from_device()
         return req
 
@@ -347,6 +363,23 @@ class ACCL:
                              count, compress_dtype=compress_dtype)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
+
+    def split(self, rank_indices: list[int], axis_name: str | None = None) -> "ACCL":
+        """Create a sub-communicator over a subset of ranks (reference
+        multi-communicator support: ACCL keeps a communicator list and
+        collectives take a communicator handle; tested by the multi-comm
+        gtest suites). The TPU form: a child ACCL over the sub-mesh of the
+        selected devices, with its own compiled schedules and buffers."""
+        if len(set(rank_indices)) != len(rank_indices):
+            raise ValueError("duplicate ranks in split")
+        if not all(0 <= r < self.world for r in rank_indices):
+            raise ValueError(f"split ranks outside world of {self.world}")
+        if self.mesh is None:
+            raise ValueError("split requires a mesh-backed ACCL")
+        devices = [self.mesh.devices.reshape(-1)[r] for r in rank_indices]
+        sub_mesh = Mesh(np.array(devices), (axis_name or self.axis_name,))
+        return ACCL(sub_mesh, axis_name or self.axis_name,
+                    arith_config=self.arith_config, **self._config)
 
     def register_stream_producer(self, stream_id: int, fn):
         """Attach a device-side producer to a kernel stream (the PL

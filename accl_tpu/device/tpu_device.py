@@ -149,6 +149,8 @@ class TPUDevice(CCLODevice):
 
         def place(req):
             if res is not None and scen != Operation.barrier:
+                if res.device is None:  # host-only result: materialize first
+                    res.sync_to_device()
                 res.device = _place_into(res.device, out)
 
         req = TPURequest(options.scenario.name, [out], on_complete=place)

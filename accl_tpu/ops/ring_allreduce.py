@@ -149,3 +149,147 @@ def ring_allreduce_pallas(
         interpret=interpret,
     )(x2)
     return out.reshape(padded)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Bidirectional ring: both ICI link directions carry half the payload each,
+# doubling effective ring bandwidth (the axis3x/bi-ring optimization the
+# FPGA fabric cannot express — TPU ICI links are full-duplex in both
+# neighbor directions).
+# ---------------------------------------------------------------------------
+
+
+def _kernel_bidir(axis_name, world, chunk, func, x_ref, o_ref,
+                  vf_ref, vb_ref, commf_ref, commb_ref,
+                  sendf_sem, recvf_sem, sendb_sem, recvb_sem,
+                  creditf_sem, creditb_sem):
+    """Two independent ring pipelines in one kernel: rows [0, world*chunk)
+    flow forward (to rank+1), rows [world*chunk, 2*world*chunk) flow
+    backward (to rank-1). Same RS+AG structure and credit protocol as the
+    unidirectional kernel, with mirrored chunk indexing for the reverse
+    direction."""
+    me = lax.axis_index(axis_name)
+    w = jnp.int32(world)
+    nxt = lax.rem(me + 1, w)
+    prv = lax.rem(me + w - 1, w)
+    half = world * chunk  # rows in each direction's region
+    total_hops = 2 * (world - 1)
+
+    def combine(a, b):
+        return a + b if func == ReduceFunction.SUM else jnp.maximum(a, b)
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
+    pltpu.semaphore_wait(barrier, 2)
+
+    def fwd_chunk(idx):
+        return x_ref[pl.ds(idx * chunk, chunk)]
+
+    def bwd_chunk(idx):
+        return x_ref[pl.ds(half + idx * chunk, chunk)]
+
+    def hop(t):
+        slot = t % 2
+        if t >= 2:
+            pltpu.semaphore_wait(creditf_sem.at[slot], 1)
+            pltpu.semaphore_wait(creditb_sem.at[slot], 1)
+        rf = pltpu.make_async_remote_copy(
+            src_ref=vf_ref, dst_ref=commf_ref.at[slot],
+            send_sem=sendf_sem.at[slot], recv_sem=recvf_sem.at[slot],
+            device_id=nxt, device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rb = pltpu.make_async_remote_copy(
+            src_ref=vb_ref, dst_ref=commb_ref.at[slot],
+            send_sem=sendb_sem.at[slot], recv_sem=recvb_sem.at[slot],
+            device_id=prv, device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rf.start()
+        rb.start()
+        rf.wait()
+        rb.wait()
+        return slot
+
+    def release(t, slot):
+        if t + 2 < total_hops:
+            pltpu.semaphore_signal(creditf_sem.at[slot], inc=1, device_id=prv)
+            pltpu.semaphore_signal(creditb_sem.at[slot], inc=1, device_id=nxt)
+
+    # RS phase. Forward direction: start chunk me-1, step-s arrival is
+    # chunk me-2-s. Backward (mirror): start chunk me+1, arrival me+2+s.
+    vf_ref[...] = fwd_chunk(lax.rem(me + w - 1, w))
+    vb_ref[...] = bwd_chunk(lax.rem(me + 1, w))
+    for s in range(world - 1):
+        slot = hop(s)
+        fidx = lax.rem(me + 2 * w - 2 - s, w)
+        bidx = lax.rem(me + 2 + s, w)
+        vf_ref[...] = combine(commf_ref[slot], fwd_chunk(fidx))
+        vb_ref[...] = combine(commb_ref[slot], bwd_chunk(bidx))
+        release(s, slot)
+
+    # AG phase. Forward arrival at step s originated at me-1-s; backward
+    # at me+1+s.
+    o_ref[pl.ds(me * chunk, chunk)] = vf_ref[...]
+    o_ref[pl.ds(half + me * chunk, chunk)] = vb_ref[...]
+    for s in range(world - 1):
+        t = world - 1 + s
+        slot = hop(t)
+        forig = lax.rem(me + 2 * w - 1 - s, w)
+        borig = lax.rem(me + 1 + s, w)
+        vf_ref[...] = commf_ref[slot]
+        vb_ref[...] = commb_ref[slot]
+        o_ref[pl.ds(forig * chunk, chunk)] = commf_ref[slot]
+        o_ref[pl.ds(half + borig * chunk, chunk)] = commb_ref[slot]
+        release(t, slot)
+
+
+def ring_allreduce_pallas_bidir(
+    x,
+    *,
+    axis_name: str,
+    world: int,
+    func: ReduceFunction = ReduceFunction.SUM,
+    interpret=None,
+    detect_races: bool = False,
+):
+    """Bidirectional fused ring allreduce of a flat (n,) buffer."""
+    n = x.shape[-1]
+    # pad so n splits into 2 * world lane-aligned chunks
+    chunk = -(-n // (2 * world))
+    chunk = -(-chunk // 128) * 128
+    padded = 2 * world * chunk
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))
+    x2 = x.reshape(padded // 128, 128)
+    chunk_rows = chunk // 128
+
+    if interpret is None:
+        from .pallas_kernels import _on_tpu
+
+        interpret = (
+            False if _on_tpu() else pltpu.InterpretParams(detect_races=detect_races)
+        )
+
+    kernel = functools.partial(_kernel_bidir, axis_name, world, chunk_rows, func)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((chunk_rows, 128), x2.dtype),       # fwd accumulator
+            pltpu.VMEM((chunk_rows, 128), x2.dtype),       # bwd accumulator
+            pltpu.VMEM((2, chunk_rows, 128), x2.dtype),    # fwd comm slots
+            pltpu.VMEM((2, chunk_rows, 128), x2.dtype),    # bwd comm slots
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(collective_id=1),
+        interpret=interpret,
+    )(x2)
+    return out.reshape(padded)[:n]

@@ -1,0 +1,109 @@
+"""Hierarchical (two-tier) collectives: ICI inside a slice, DCN across.
+
+The reference's three POEs are flat — every rank one hop away on
+Ethernet. TPU pods are not: intra-slice ICI is an order of magnitude
+faster than the inter-slice data-center network, so cross-tier
+collectives must be composed so the slow tier carries 1/P_inner of the
+traffic. The compositions here are the standard bandwidth-optimal
+decompositions, built from the same ring schedule bodies the flat path
+uses (sequencer/schedules.py):
+
+  allreduce      = reduce_scatter(inner) -> allreduce(outer on 1/Pi
+                   shard) -> allgather(inner)
+  reduce_scatter = reduce_scatter(inner) -> reduce_scatter(outer)
+  allgather      = allgather(outer) -> allgather(inner)
+  bcast          = bcast(outer from root's column) -> bcast(inner)
+
+Each runs inside one shard_map over BOTH axes — a single compiled
+program, the host-only-dispatches property preserved across tiers. On a
+real multi-slice mesh the outer axis maps to DCN; on the CPU test mesh
+both axes are virtual, which exercises the identical program structure
+(the driver's dryrun posture).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import ReduceFunction
+from . import schedules
+
+
+def _pad_to(x, m):
+    rem = (-x.shape[-1]) % m
+    return jnp.pad(x, (0, rem)) if rem else x
+
+
+def hierarchical_allreduce_schedule(
+    x, *, func: ReduceFunction, inner_axis: str, outer_axis: str,
+    inner_world: int, outer_world: int, wire,
+):
+    """RS(inner) -> AR(outer) -> AG(inner): the outer (slow) tier moves
+    1/inner_world of the payload per device."""
+    n = x.shape[-1]
+    padded = _pad_to(x, inner_world)
+    # reduce-scatter over the fast tier: each inner rank holds the partial
+    # sum of its 1/Pi chunk across the inner group
+    shard = schedules.reduce_scatter_ring_schedule(
+        padded, func=func, axis=inner_axis, world=inner_world, wire=wire
+    )
+    # allreduce the shard across the slow tier
+    shard = schedules.allreduce_ring_schedule(
+        shard, func=func, axis=outer_axis, world=outer_world, wire=wire,
+        seg_count=shard.shape[-1],
+    )
+    # allgather over the fast tier to rebuild the full buffer
+    full = schedules.allgather_ring_schedule(
+        shard, axis=inner_axis, world=inner_world, wire=wire
+    )
+    return full[:n]
+
+
+def hierarchical_reduce_scatter_schedule(
+    x, *, func, inner_axis, outer_axis, inner_world, outer_world, wire,
+):
+    """Input world*count per rank (world = inner*outer); output: the
+    rank's own chunk under the module's inner-major convention
+    (g = inner_pos * outer_world + outer_pos)."""
+    world = inner_world * outer_world
+    count = x.shape[-1] // world
+    # group the global chunks by outer rank: first reduce-scatter across
+    # the inner axis over blocks of outer_world*count, then across outer
+    inner_rs = schedules.reduce_scatter_ring_schedule(
+        x, func=func, axis=inner_axis, world=inner_world, wire=wire
+    )  # (outer_world * count,) per device: partial chunks for my inner pos
+    out = schedules.reduce_scatter_ring_schedule(
+        inner_rs, func=func, axis=outer_axis, world=outer_world, wire=wire
+    )
+    return out
+
+
+def hierarchical_allgather_schedule(
+    x, *, inner_axis, outer_axis, inner_world, outer_world, wire,
+):
+    """AG(outer) then AG(inner): output ordered (inner, outer, count) —
+    i.e. global rank id = inner_pos * outer_world + outer_pos."""
+    outer = schedules.allgather_ring_schedule(
+        x, axis=outer_axis, world=outer_world, wire=wire
+    )
+    return schedules.allgather_ring_schedule(
+        outer, axis=inner_axis, world=inner_world, wire=wire
+    )
+
+
+def hierarchical_bcast_schedule(
+    x, *, root_inner: int, root_outer: int, inner_axis, outer_axis,
+    inner_world, outer_world, wire,
+):
+    """Root's slice broadcasts across the slow tier once, then every slice
+    fans out internally on ICI."""
+    # outer hop happens only usefully on the root's inner row; other rows
+    # relay garbage among themselves in the same SPMD program, and the
+    # inner bcast from root_inner then overwrites every row with real data.
+    y = schedules.bcast_flat_schedule(
+        x, root=root_outer, axis=outer_axis, world=outer_world, wire=wire
+    )
+    return schedules.bcast_flat_schedule(
+        y, root=root_inner, axis=inner_axis, world=inner_world, wire=wire
+    )

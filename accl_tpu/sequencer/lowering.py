@@ -242,11 +242,11 @@ class ScheduleCompiler:
                     # cannot be fused into the single-dtype ring kernel
                     and (not eth_active or compressed_domain)
                 ):
-                    from ..ops.ring_allreduce import ring_allreduce_pallas
+                    from ..ops.ring_allreduce import ring_allreduce_pallas_bidir
 
                     def body(x, *, _c=common, _f=func):
                         y = _c["wire"].send(x)  # wire compression outside
-                        out = ring_allreduce_pallas(
+                        out = ring_allreduce_pallas_bidir(
                             y, axis_name=_c["axis"], world=_c["world"], func=_f
                         )
                         return _c["wire"].recv(out, x.dtype)

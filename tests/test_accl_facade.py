@@ -133,3 +133,58 @@ def test_smaller_count_than_buffer(accl):
     np.testing.assert_allclose(rb.host[:, :100],
                                np.tile(x[:, :100].sum(0), (WORLD, 1)),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_split_communicator(accl, mesh8):
+    """Sub-communicator collectives stay independent per group (the
+    reference's multi-communicator suites)."""
+    lo = accl.split([0, 1, 2, 3])
+    hi = accl.split([4, 5, 6, 7])
+    xlo = RNG.standard_normal((4, 32)).astype(np.float32)
+    xhi = RNG.standard_normal((4, 32)).astype(np.float32)
+    slo, rlo = lo.create_buffer(32, data=xlo), lo.create_buffer(32)
+    shi, rhi = hi.create_buffer(32, data=xhi), hi.create_buffer(32)
+    lo.allreduce(slo, rlo, 32, ReduceFunction.SUM)
+    hi.allreduce(shi, rhi, 32, ReduceFunction.SUM)
+    np.testing.assert_allclose(rlo.host, np.tile(xlo.sum(0), (4, 1)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(rhi.host, np.tile(xhi.sum(0), (4, 1)),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError):
+        accl.split([0, 0, 1])
+    with pytest.raises(ValueError):
+        accl.split([99])
+
+
+def test_host_only_buffers(accl):
+    """h2h / h2d / d2h variants (reference host-memory gtest suites):
+    host-only operands stage around the call and set the HOST flags."""
+    x = RNG.standard_normal((WORLD, 48)).astype(np.float32)
+    hb = accl.create_buffer(48, data=x, host_only=True)
+    db = accl.create_buffer(48)
+    accl.allreduce(hb, db, 48, ReduceFunction.SUM)  # h2d
+    np.testing.assert_allclose(db.host, np.tile(x.sum(0), (WORLD, 1)),
+                               rtol=1e-5, atol=1e-5)
+    hout = accl.create_buffer(48, host_only=True)
+    accl.allreduce(db, hout, 48, ReduceFunction.MAX, from_device=True)  # d2h
+    from accl_tpu import HostFlags
+    opts = accl._prepare(__import__("accl_tpu").Operation.allreduce,
+                         hb, None, hout, 48)
+    assert opts.host_flags == HostFlags.OP0_HOST | HostFlags.RES_HOST
+
+
+def test_async_host_only_result_syncs(accl):
+    """Async + to_device=True must still copy back host-only results."""
+    x = RNG.standard_normal((WORLD, 24)).astype(np.float32)
+    sb = accl.create_buffer(24, data=x)
+    hout = accl.create_buffer(24, host_only=True)
+    req = accl.allreduce(sb, hout, 24, ReduceFunction.SUM,
+                         to_device=True, run_async=True)
+    accl.wait(req)
+    np.testing.assert_allclose(hout.host, np.tile(x.sum(0), (WORLD, 1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_split_inherits_arith_config(accl):
+    sub = accl.split([0, 1])
+    assert sub.arith_config is accl.arith_config

@@ -118,3 +118,28 @@ def test_pallas_ring_through_facade(mesh8):
     accl.allreduce(sb, rb, 384, ReduceFunction.SUM)
     np.testing.assert_allclose(rb.host, np.tile(x.sum(0), (8, 1)),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("world,n", [(4, 2048), (8, 4000), (2, 512)])
+def test_bidirectional_ring_allreduce(world, n):
+    from accl_tpu.ops.ring_allreduce import ring_allreduce_pallas_bidir
+
+    devs = np.array(jax.devices()[:world])
+    mesh = Mesh(devs, ("ccl",))
+    body = functools.partial(
+        ring_allreduce_pallas_bidir, axis_name="ccl", world=world,
+        func=ReduceFunction.SUM, detect_races=(world == 4),
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            lambda x: body(x.reshape(-1)).reshape(1, -1),
+            mesh=mesh,
+            in_specs=PartitionSpec("ccl"),
+            out_specs=PartitionSpec("ccl"),
+            check_vma=False,
+        )
+    )
+    x = RNG.standard_normal((world, n)).astype(np.float32)
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (world, 1)),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Launch N native emulator ranks as separate OS processes.
+
+The analog of the reference's emulator launcher
+(test/model/emulator/run.py:45-58: spawn N cclo_emu processes wired by
+port). Each process brings up one EmuRank and executes a demo collective
+round (or a user script via --script module:function, called as
+fn(rank, rank_idx, world)).
+
+Usage:
+  python tools/run_emulator.py -n 4                    # demo allreduce
+  python tools/run_emulator.py -n 4 --script mymod:fn  # custom per-rank fn
+"""
+
+import argparse
+import importlib
+import multiprocessing as mp
+import pathlib
+import sys
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _demo(rank, idx, world):
+    from accl_tpu import ReduceFunction
+
+    n = 4096
+    x = np.full(n, float(idx + 1), np.float32)
+    out = np.zeros(n, np.float32)
+    rank.allreduce(x, out, n, ReduceFunction.SUM)
+    expected = world * (world + 1) / 2
+    ok = np.allclose(out, expected)
+    print(f"[rank {idx}] allreduce({n}) -> {out[0]:.1f} "
+          f"(expect {expected:.1f}) {'OK' if ok else 'MISMATCH'}")
+    rank.barrier()
+    return ok
+
+
+def worker(world, idx, ports, script, q):
+    sys.path.insert(0, str(REPO))
+    from accl_tpu.device.emu_device import EmuRank
+
+    rank = EmuRank(world, idx, ports)
+    try:
+        if script:
+            mod, fn = script.split(":")
+            f = getattr(importlib.import_module(mod), fn)
+        else:
+            f = _demo
+        q.put((idx, bool(f(rank, idx, world))))
+    except Exception as e:  # pragma: no cover
+        q.put((idx, f"error: {e}"))
+    finally:
+        rank.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--world", type=int, default=2)
+    ap.add_argument("--script", default=None,
+                    help="module:function run per rank as fn(rank, idx, world)")
+    args = ap.parse_args()
+
+    sys.path.insert(0, str(REPO))
+    from accl_tpu.device.emu_device import free_ports
+
+    ports = free_ports(args.world)
+    q = mp.Queue()
+    procs = [
+        mp.Process(target=worker, args=(args.world, i, ports, args.script, q),
+                   daemon=True)
+        for i in range(args.world)
+    ]
+    try:
+        for p in procs:
+            p.start()
+        results = {}
+        for _ in range(args.world):
+            try:
+                k, v = q.get(timeout=120)
+            except Exception:
+                break  # a rank died before reporting
+            results[k] = v
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    bad = {k: v for k, v in results.items() if v is not True}
+    missing = set(range(args.world)) - set(results)
+    if bad or missing:
+        print(f"FAILED ranks: {bad} missing: {sorted(missing)}",
+              file=sys.stderr)
+        sys.exit(1)
+    print(f"all {args.world} ranks OK")
+
+
+if __name__ == "__main__":
+    main()
