@@ -237,17 +237,31 @@ class ScheduleCompiler:
                 )
                 if (
                     self.use_pallas_ring
-                    and options.count * elem_bytes <= self.PALLAS_RING_MAX_BYTES
                     # per-hop compression with uncompressed-domain arithmetic
                     # cannot be fused into the single-dtype ring kernel
                     and (not eth_active or compressed_domain)
                 ):
                     from ..ops.ring_allreduce import ring_allreduce_pallas_bidir
 
-                    def body(x, *, _c=common, _f=func):
-                        y = _c["wire"].send(x)  # wire compression outside
-                        out = ring_allreduce_pallas_bidir(
+                    # Kernel-resource chunking: the VMEM-resident kernel
+                    # caps per-launch payload, so larger buffers run it per
+                    # segment. Segments are SERIALIZED by an explicit data
+                    # dependency: the fused kernel's neighbor barrier and
+                    # credit semaphores are keyed by one collective_id, so
+                    # overlapping instances would cross-talk. (Protocol
+                    # segmentation — plan.seg_count — stays plan-owned and
+                    # governs the lax path.)
+                    seg_elems = max(self.PALLAS_RING_MAX_BYTES // elem_bytes, 1)
+
+                    def one_seg(y, *, _c=common, _f=func):
+                        return ring_allreduce_pallas_bidir(
                             y, axis_name=_c["axis"], world=_c["world"], func=_f
+                        )
+
+                    def body(x, *, _c=common, _seg=seg_elems):
+                        y = _c["wire"].send(x)  # wire compression outside
+                        out = schedules.segmented_apply(
+                            one_seg, y, _seg, serialize=True
                         )
                         return _c["wire"].recv(out, x.dtype)
 

@@ -324,14 +324,43 @@ def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):
         gathered = allgather_ring_schedule(red, axis=axis, world=world, wire=wire)
         return gathered[: seg.shape[-1]]
 
+    return segmented_apply(one_segment, x, seg_count)
+
+
+def segmented_apply(one_segment, x, seg_count, unroll_limit: int = 8,
+                    serialize: bool = False):
+    """Apply a per-segment schedule over a flat buffer in seg_count-element
+    pieces (the eager segmentation substrate, .c:626-647). Independent
+    segments are unrolled up to unroll_limit so XLA can software-pipeline
+    their permutes (>2 outstanding moves); beyond that, lax.map bounds
+    compile time. serialize=True threads a data dependency between
+    segments for bodies that share stateful device resources (e.g. pallas
+    kernels with a fixed collective_id)."""
+    count = x.shape[-1]
     if count <= seg_count:
         return one_segment(x)
     num_bulk = count // seg_count
     tail = count - num_bulk * seg_count
     bulk = x[: num_bulk * seg_count].reshape(num_bulk, seg_count)
-    bulk_out = lax.map(one_segment, bulk).reshape(num_bulk * seg_count)
+    if serialize or num_bulk <= unroll_limit:
+        outs = []
+        carry = None
+        for i in range(num_bulk):
+            seg_in = bulk[i]
+            if serialize and carry is not None:
+                seg_in = seg_in + carry * 0  # order-only dependency
+            out_i = one_segment(seg_in)
+            if serialize:
+                carry = out_i[0]
+            outs.append(out_i)
+        bulk_out = jnp.concatenate(outs)
+    else:
+        bulk_out = lax.map(one_segment, bulk).reshape(num_bulk * seg_count)
     if tail:
-        tail_out = one_segment(x[num_bulk * seg_count :])
+        tail_in = x[num_bulk * seg_count :]
+        if serialize and num_bulk:
+            tail_in = tail_in + bulk_out[-1] * 0
+        tail_out = one_segment(tail_in)
         return jnp.concatenate([bulk_out, tail_out])
     return bulk_out
 

@@ -143,3 +143,20 @@ def test_bidirectional_ring_allreduce(world, n):
     out = np.asarray(fn(x))
     np.testing.assert_allclose(out, np.tile(x.sum(0), (world, 1)),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_ring_segmented_large_payload(mesh8):
+    """Payloads past the VMEM ceiling run the fused kernel per segment."""
+    from accl_tpu.accl import ACCL
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    dev = TPUDevice(mesh8)
+    dev.compiler.use_pallas_ring = True
+    dev.compiler.PALLAS_RING_MAX_BYTES = 2048  # force segmentation
+    accl = ACCL(device=dev)
+    n = 3000  # 12 KB -> 6 segments
+    x = RNG.standard_normal((8, n)).astype(np.float32)
+    sb, rb = accl.create_buffer(n, data=x), accl.create_buffer(n)
+    accl.allreduce(sb, rb, n, ReduceFunction.SUM)
+    np.testing.assert_allclose(rb.host, np.tile(x.sum(0), (8, 1)),
+                               rtol=1e-4, atol=1e-4)
