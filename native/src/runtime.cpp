@@ -272,6 +272,9 @@ struct Call {
   bool deadline_set = false;
   std::chrono::steady_clock::time_point deadline;
   std::chrono::steady_clock::time_point t_start;
+  // compressed-domain scratch: persists across retry requeues so partial
+  // progress (already-landed segments) survives re-execution
+  std::shared_ptr<std::vector<uint16_t>> c16_op0, c16_op1, c16_res;
 };
 
 struct Completion {
@@ -993,7 +996,79 @@ struct accl_rt {
 
   // ----- sequencer main loop (run(), .c:2308-2483) -----
 
+  // Compressed-domain execution (ETH_COMPRESSED on fp32 operands, the
+  // default (float32,float16) arithconfig with arith_is_compressed=true,
+  // arithconfig.hpp:102-119): cast operands to fp16 scratch, run the
+  // whole collective at half wire width, cast the result back.
   uint32_t execute(Call &c) {
+    constexpr uint32_t ETH_COMPRESSED = 8;
+    uint32_t comp_flags = c.desc[7];
+    if ((comp_flags & ETH_COMPRESSED) && c.dtype == ACCL_DT_FLOAT32) {
+      uint32_t scenario = c.desc[0];
+      uint64_t count = c.desc[1];
+      uint64_t in_elems = count, out_elems = count;
+      switch (scenario) {
+        case SC_SCATTER: in_elems = count * world; break;
+        case SC_REDUCE_SCATTER: in_elems = count * world; break;
+        case SC_ALLTOALL: in_elems = count * world; out_elems = count * world; break;
+        case SC_GATHER: out_elems = count * world; break;
+        case SC_ALLGATHER: out_elems = count * world; break;
+        default: break;
+      }
+      auto to_h = [](const float *src, std::vector<uint16_t> &dst, uint64_t n) {
+        dst.resize(n);
+        for (uint64_t i = 0; i < n; i++) dst[i] = float_to_half(src[i]);
+      };
+      if (c.op0 && !c.c16_op0) {
+        c.c16_op0 = std::make_shared<std::vector<uint16_t>>();
+        to_h((const float *)c.op0, *c.c16_op0, in_elems);
+      }
+      if (c.op1 && !c.c16_op1) {
+        c.c16_op1 = std::make_shared<std::vector<uint16_t>>();
+        to_h((const float *)c.op1, *c.c16_op1, in_elems);
+      }
+      if (c.res && !c.c16_res) {
+        c.c16_res = std::make_shared<std::vector<uint16_t>>(
+            std::max(in_elems, out_elems));
+      }
+      Call inner = c;  // shares the scratch shared_ptrs
+      inner.dtype = ACCL_DT_FLOAT16;
+      inner.desc[7] = comp_flags & ~ETH_COMPRESSED;
+      if (c.c16_op0) inner.op0 = c.c16_op0->data();
+      if (c.c16_op1) inner.op1 = c.c16_op1->data();
+      if (c.c16_res) inner.res = c.c16_res->data();
+      uint32_t rc = execute_inner(inner);
+      // preserve ALL resumption state (current_step AND the armed
+      // deadline) across NOT_READY requeues
+      c.current_step = inner.current_step;
+      c.deadline = inner.deadline;
+      c.deadline_set = inner.deadline_set;
+      if (rc == NOT_READY) return NOT_READY;
+      // only ranks that own the output write it back: gather/reduce
+      // deliver to root alone (non-root recvbufs stay untouched, matching
+      // the uncompressed path)
+      uint32_t root = c.desc[3];
+      bool owns_res =
+          !(scenario == SC_GATHER || scenario == SC_REDUCE) || root == rank;
+      if (c.res && rc == NO_ERROR && owns_res) {
+        float *dst = (float *)c.res;
+        for (uint64_t i = 0; i < out_elems; i++)
+          dst[i] = half_to_float((*c.c16_res)[i]);
+      }
+      // bcast mutates op0 on receivers only: compression is wire-only, so
+      // the root's full-precision source stays untouched (reference
+      // semantics)
+      if (scenario == SC_BCAST && c.op0 && rc == NO_ERROR && root != rank) {
+        float *dst = (float *)c.op0;
+        for (uint64_t i = 0; i < in_elems; i++)
+          dst[i] = half_to_float((*c.c16_op0)[i]);
+      }
+      return rc;
+    }
+    return execute_inner(c);
+  }
+
+  uint32_t execute_inner(Call &c) {
     uint32_t scenario = c.desc[0];
     uint64_t count = c.desc[1];
     uint32_t root = c.desc[3];

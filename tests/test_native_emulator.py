@@ -356,3 +356,89 @@ def test_emu_stress_async_sendrecv():
             np.testing.assert_allclose(res[1][j], xs[j], rtol=0)
     finally:
         w.close()
+
+
+def test_emu_eth_compressed_collectives():
+    """ETH_COMPRESSED on the native runtime: the whole collective runs in
+    the fp16 wire domain (the (float32,float16) arithconfig row with
+    arith_is_compressed, like the firmware's compressed datapath)."""
+    from accl_tpu import CallOptions, Operation, CompressionFlags, DataType
+    w = EmuWorld(4)
+    try:
+        n = 3000
+        xs = RNG.standard_normal((4, n)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(n, np.float32)
+            opts = CallOptions(
+                scenario=Operation.allreduce, count=n,
+                function=int(ReduceFunction.SUM),
+                compression_flags=CompressionFlags.ETH_COMPRESSED,
+                data_type=DataType.float32)
+            rank.call(opts, op0=xs[i].copy(), res=out)
+            b = xs[i].copy()
+            bopts = CallOptions(
+                scenario=Operation.bcast, count=n, root_src_dst=2,
+                compression_flags=CompressionFlags.ETH_COMPRESSED,
+                data_type=DataType.float32)
+            rank.call(bopts, op0=b)
+            return out, b
+
+        res = w.run(body)
+        exp = xs.astype(np.float16).astype(np.float32).sum(0)
+        for i, (out, b) in enumerate(res):
+            np.testing.assert_allclose(out, exp, rtol=5e-2, atol=5e-1)
+            if i == 2:  # root: wire-only compression, source untouched
+                np.testing.assert_array_equal(b, xs[2])
+            else:
+                np.testing.assert_allclose(
+                    b, xs[2].astype(np.float16).astype(np.float32),
+                    rtol=1e-3, atol=1e-3)
+    finally:
+        w.close()
+
+
+def test_emu_peer_death_times_out_cleanly():
+    """Failure detection: a collective whose peer never participates must
+    surface RECEIVE_TIMEOUT, not hang (sticky-error contract +
+    HOUSEKEEP_TIMEOUT, SURVEY.md §5)."""
+    from accl_tpu import CallOptions, Operation
+    w = EmuWorld(3)
+    try:
+        def body(rank, i):
+            rank.call(CallOptions(scenario=Operation.config, function=2,
+                                  count=500))  # 500 ms timeout
+            if i == 2:
+                return "absent"  # rank 2 never joins the collective
+            out = np.zeros(64, np.float32)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.allreduce(np.ones(64, np.float32), out, 64,
+                               ReduceFunction.SUM)
+            return "timed-out"
+
+        res = w.run(body)
+        assert res[:2] == ["timed-out", "timed-out"]
+    finally:
+        w.close()
+
+
+def test_emu_compressed_recv_times_out():
+    """Compressed eager recv with no sender must still hit the deadline
+    (the deadline survives compressed-wrapper requeues)."""
+    from accl_tpu import CallOptions, Operation, CompressionFlags, DataType
+    w = EmuWorld(2)
+    try:
+        def body(rank, i):
+            if i == 0:
+                rank.call(CallOptions(scenario=Operation.config, function=2,
+                                      count=300))
+                opts = CallOptions(
+                    scenario=Operation.recv, count=64, root_src_dst=1,
+                    tag=5, compression_flags=CompressionFlags.ETH_COMPRESSED,
+                    data_type=DataType.float32)
+                out = np.zeros(64, np.float32)
+                with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                    rank.call(opts, res=out)
+        w.run(body)
+    finally:
+        w.close()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Per-collective latency/throughput benchmark against the native emulator.
+
+The Coyote benchmark app analog (reference test/host/Coyote/test.cpp:
+per-collective latency/throughput logging with eager/rendezvous and
+buffer-placement switches, results to accl_log/*.log): sweeps message
+sizes across both protocols over N emulator ranks and writes
+accl_log/emu_bench.csv (Collective,Protocol,Bytes,Seconds,GBps).
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--world", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.device.emu_device import EmuWorld
+
+    w = EmuWorld(args.world, max_eager=4096, rx_buf_bytes=4096)
+    rows = []
+    try:
+        for nbytes in (1024, 4096, 65536, 1 << 20, 4 << 20):
+            count = nbytes // 4
+            proto = "eager" if nbytes <= 4096 else "rndzv"
+            for name in ("allreduce", "bcast", "allgather"):
+                def body(rank, i, _name=name, _n=count):
+                    x = np.ones(_n, np.float32)
+                    out = np.zeros(_n * (args.world if _name == "allgather"
+                                         else 1), np.float32)
+                    rank.barrier()
+                    t0 = time.perf_counter()
+                    for _ in range(args.iters):
+                        if _name == "allreduce":
+                            rank.allreduce(x, out, _n, ReduceFunction.SUM)
+                        elif _name == "bcast":
+                            rank.bcast(x, _n, root=0)
+                        else:
+                            rank.allgather(x, out, _n)
+                    return (time.perf_counter() - t0) / args.iters
+
+                secs = max(w.run(body))
+                gbps = nbytes / secs / 1e9
+                rows.append((name, proto, nbytes, secs, gbps))
+                print(f"{name:10s} {proto:6s} {nbytes:>9d} B "
+                      f"{secs*1e6:10.1f} us  {gbps:7.3f} GB/s",
+                      file=sys.stderr)
+    finally:
+        w.close()
+
+    outdir = REPO / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    with open(outdir / "emu_bench.csv", "w") as f:
+        f.write("Collective,Protocol,Bytes,Seconds,GBps\n")
+        for r in rows:
+            f.write(f"{r[0]},{r[1]},{r[2]},{r[3]:.6e},{r[4]:.3f}\n")
+    print(f"wrote {outdir/'emu_bench.csv'} ({len(rows)} rows)")
+
+
+if __name__ == "__main__":
+    main()
