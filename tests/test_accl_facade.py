@@ -188,3 +188,16 @@ def test_async_host_only_result_syncs(accl):
 def test_split_inherits_arith_config(accl):
     sub = accl.split([0, 1])
     assert sub.arith_config is accl.arith_config
+
+
+def test_send_recv_tag_any(accl):
+    """TAG_ANY recv matches a tagged pending send (rxbuf seek wildcard);
+    a concrete non-matching tag must NOT match."""
+    x = RNG.standard_normal((WORLD, 32)).astype(np.float32)
+    sb = accl.create_buffer(32, data=x)
+    rb = accl.create_buffer(32)
+    accl.send(sb, 32, src=0, dst=4, tag=123)
+    with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+        accl.recv(rb, 32, src=0, dst=4, tag=999)  # exact tag filters
+    accl.recv(rb, 32, src=0, dst=4)  # TAG_ANY default drains the send
+    np.testing.assert_allclose(rb.host[4], x[0], rtol=1e-6)

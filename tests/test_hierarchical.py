@@ -119,3 +119,29 @@ def test_hier_bcast(root_g):
 
     out = run2d(body, mesh, x)
     np.testing.assert_allclose(out, np.tile(x[root_g], (world, 1)), rtol=0)
+
+
+def test_hier_allreduce_wire_compressed():
+    """Two-tier allreduce with fp16 wire compression on both tiers."""
+    from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
+    from accl_tpu.constants import DataType
+
+    outer, inner = 2, 4
+    mesh = mesh2d(outer, inner)
+    world = outer * inner
+    count = 500
+    cfg = DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.float16)]
+    x = RNG.standard_normal((world, count)).astype(np.float32)
+
+    def body(xl):
+        out = hierarchical_allreduce_schedule(
+            xl.reshape(-1), func=ReduceFunction.SUM,
+            inner_axis="inner", outer_axis="outer",
+            inner_world=inner, outer_world=outer,
+            wire=schedules.Wire(cfg),
+        )
+        return out.reshape(1, -1)
+
+    out = run2d(body, mesh, x)
+    np.testing.assert_allclose(out, np.tile(x.sum(0), (world, 1)),
+                               rtol=5e-2, atol=5e-1)
