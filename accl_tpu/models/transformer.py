@@ -94,6 +94,17 @@ def param_specs(cfg: TransformerConfig) -> dict:
     }
 
 
+def _spec_has_axis(spec, axis: str) -> bool:
+    """True if a PartitionSpec shards any dimension over `axis`."""
+    for part in spec:
+        if part is None:
+            continue
+        parts = part if isinstance(part, tuple) else (part,)
+        if axis in parts:
+            return True
+    return False
+
+
 def _rmsnorm(x, g):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
@@ -191,14 +202,27 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
             params, tokens, targets, cfg, wire
         )
 
-        def sync(g):
+        tp_world = lax.axis_size("tp")
+
+        def sync(g, spec):
             # every param (tp-sharded or replicated) saw only its dp batch
             # shard and sp sequence shard: mean-reduce over both axes.
             g = _grad_allreduce(g, "dp", wire)
             g = _grad_allreduce(g, "sp", wire)
+            if tp_world > 1:
+                # The ring-allreduce transpose is itself an allreduce, so a
+                # replicated cotangent entering a tp branch comes back
+                # amplified by tp: tp-sharded weight grads are tp x the true
+                # value (rescale), while tp-replicated params see only their
+                # rank's head/ff-slice contribution (mean-allreduce over tp
+                # restores the full gradient — sum of slices / tp x tp).
+                if _spec_has_axis(spec, "tp"):
+                    g = g / tp_world
+                else:
+                    g = _grad_allreduce(g, "tp", wire)
             return g
 
-        grads = jax.tree.map(sync, grads)
+        grads = jax.tree.map(sync, grads, pspecs)
         new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
         for ax in ("dp", "sp"):

@@ -122,6 +122,43 @@ def test_transformer_train_step_decreases_loss():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.parametrize("axes", [{"dp": 1, "sp": 1, "tp": 2},
+                                  {"dp": 2, "sp": 2, "tp": 2}])
+def test_transformer_train_step_matches_single_device(axes):
+    """One SGD step on a tp-sharded mesh must produce the same updated
+    params as the identical step on one device (the tp-aware gradient
+    sync: replicated params mean-allreduced over tp, tp-sharded grads
+    rescaled by 1/tp to undo the allreduce-transpose amplification)."""
+    from accl_tpu.models import TransformerConfig, init_params, make_train_step
+    from accl_tpu.models.transformer import demo_batch, shard_params
+
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=4, n_layers=2,
+                            d_ff=32)
+    params = init_params(cfg, jax.random.key(2))
+    lr = 0.1
+
+    mesh1 = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    tokens1, targets1 = demo_batch(cfg, mesh1, batch=4, seq=16)
+    step1 = make_train_step(cfg, mesh1, lr=lr)
+    ref_params, ref_loss = step1(shard_params(params, cfg, mesh1),
+                                 tokens1, targets1)
+
+    n = int(np.prod(list(axes.values())))
+    mesh = make_mesh(axes, devices=jax.devices()[:n])
+    tokens, targets = demo_batch(cfg, mesh, batch=4, seq=16)
+    np.testing.assert_array_equal(np.asarray(tokens), np.asarray(tokens1))
+    step = make_train_step(cfg, mesh, lr=lr)
+    new_params, loss = step(shard_params(params, cfg, mesh), tokens, targets)
+
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+    flat_ref = jax.tree_util.tree_flatten_with_path(ref_params)[0]
+    flat_new = jax.tree.leaves(new_params)
+    for (path, r), nw in zip(flat_ref, flat_new):
+        np.testing.assert_allclose(
+            np.asarray(nw), np.asarray(r), rtol=2e-4, atol=2e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged on {axes}")
+
+
 def test_transformer_forward_parallel_equals_single():
     """The sharded forward must equal the same model on one device."""
     from accl_tpu.models import TransformerConfig, init_params, make_forward
