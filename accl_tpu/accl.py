@@ -91,7 +91,10 @@ class ACCL:
         dev.write(CCLOAddr.EGR_RX_BUF_SIZE, cfg["egr_rx_buf_size"])
         dev.write(CCLOAddr.NUM_EGR_RX_BUFS, cfg["n_egr_rx_bufs"])
         dev.eager_rx_buf_size = cfg["egr_rx_buf_size"]
-        # default communicator over the whole axis
+        # default communicator over the whole axis; re-initialization
+        # invalidates all prior communicator handles (their exchange-memory
+        # addresses are reallocated), so the list starts fresh
+        self.communicators.clear()
         world = dev.world
         ranks = [Rank(device_index=i, session_id=i) for i in range(world)]
         self.communicators.append(Communicator(ranks, 0, CCLOAddr.DYNAMIC_BASE))
@@ -102,6 +105,9 @@ class ACCL:
         for key, ac in self.arith_config.items():
             ac.set_exchmem(addr)
             addr += 4 * 8  # eight words per config row (arithconfig.hpp layout)
+        # dynamic exchange-memory allocator tail: later communicators
+        # (split) are laid out from here
+        self._exchmem_alloc = addr
         # tuning registers (configure_tuning_parameters, accl.cpp:1198-1208)
         tuning = TuningParams.default(cfg["max_rendezvous_size"])
         dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN, tuning.gather_flat_tree_max_fanin)
@@ -178,7 +184,24 @@ class ACCL:
         function: int = 0,
         tag: int = TAG_ANY,
         compress_dtype: DataType | None = None,
+        comm: Communicator | None = None,
     ) -> CallOptions:
+        if comm is None:
+            comm = self.communicators[0]
+        elif comm not in self.communicators:
+            raise ValueError("communicator does not belong to this ACCL")
+        # roots and src/dst ranks are communicator-relative; an out-of-range
+        # rank would compile a schedule in which nobody is root
+        if scenario in (Operation.bcast, Operation.scatter, Operation.gather,
+                        Operation.reduce):
+            if not 0 <= root_src_dst < comm.size:
+                raise ValueError(
+                    f"root {root_src_dst} outside communicator of {comm.size}")
+        elif scenario in (Operation.send, Operation.recv):
+            src, dst = root_src_dst & 0xFFFF, (root_src_dst >> 16) & 0xFFFF
+            if src >= comm.size or dst >= comm.size:
+                raise ValueError(
+                    f"src/dst ({src},{dst}) outside communicator of {comm.size}")
         dtype = None
         for b in (op0, op1, res):
             if b is not None and not isinstance(b, DummyBuffer):
@@ -206,7 +229,7 @@ class ACCL:
         return CallOptions(
             scenario=scenario,
             count=count,
-            comm_addr=self.communicators[0].exchmem_addr,
+            comm_addr=comm.exchmem_addr,
             root_src_dst=root_src_dst,
             function=function,
             tag=tag,
@@ -289,97 +312,119 @@ class ACCL:
                              run_async)
 
     def send(self, srcbuf, count, src, dst, tag=TAG_ANY, *, from_device=False,
-             run_async=False, compress_dtype=None):
+             run_async=False, compress_dtype=None, comm=None):
         opts = self._prepare(Operation.send, srcbuf, None, None, count,
                              root_src_dst=src | (dst << 16), tag=tag,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [srcbuf], [], from_device, True, run_async)
 
     def recv(self, dstbuf, count, src, dst, tag=TAG_ANY, *, to_device=False,
-             run_async=False, compress_dtype=None):
+             run_async=False, compress_dtype=None, comm=None):
         opts = self._prepare(Operation.recv, None, None, dstbuf, count,
                              root_src_dst=src | (dst << 16), tag=tag,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [], [dstbuf], True, to_device, run_async)
 
     def bcast(self, buf, count, root, *, from_device=False, to_device=False,
-              run_async=False, compress_dtype=None):
+              run_async=False, compress_dtype=None, comm=None):
         opts = self._prepare(Operation.bcast, buf, None, buf, count,
-                             root_src_dst=root, compress_dtype=compress_dtype)
+                             root_src_dst=root, compress_dtype=compress_dtype,
+                             comm=comm)
         return self._execute(opts, [buf], [buf], from_device, to_device,
                              run_async)
 
     def scatter(self, sendbuf, recvbuf, count, root, *, from_device=False,
-                to_device=False, run_async=False, compress_dtype=None):
+                to_device=False, run_async=False, compress_dtype=None,
+                comm=None):
         opts = self._prepare(Operation.scatter, sendbuf, None, recvbuf, count,
-                             root_src_dst=root, compress_dtype=compress_dtype)
+                             root_src_dst=root, compress_dtype=compress_dtype,
+                             comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def gather(self, sendbuf, recvbuf, count, root, *, from_device=False,
-               to_device=False, run_async=False, compress_dtype=None):
+               to_device=False, run_async=False, compress_dtype=None,
+               comm=None):
         opts = self._prepare(Operation.gather, sendbuf, None, recvbuf, count,
-                             root_src_dst=root, compress_dtype=compress_dtype)
+                             root_src_dst=root, compress_dtype=compress_dtype,
+                             comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def allgather(self, sendbuf, recvbuf, count, *, from_device=False,
-                  to_device=False, run_async=False, compress_dtype=None):
+                  to_device=False, run_async=False, compress_dtype=None,
+                  comm=None):
         opts = self._prepare(Operation.allgather, sendbuf, None, recvbuf,
-                             count, compress_dtype=compress_dtype)
+                             count, compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def reduce(self, sendbuf, recvbuf, count, root, function, *,
                from_device=False, to_device=False, run_async=False,
-               compress_dtype=None):
+               compress_dtype=None, comm=None):
         opts = self._prepare(Operation.reduce, sendbuf, None, recvbuf, count,
                              root_src_dst=root, function=int(function),
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def allreduce(self, sendbuf, recvbuf, count, function, *,
                   from_device=False, to_device=False, run_async=False,
-                  compress_dtype=None):
+                  compress_dtype=None, comm=None):
         opts = self._prepare(Operation.allreduce, sendbuf, None, recvbuf,
                              count, function=int(function),
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def reduce_scatter(self, sendbuf, recvbuf, count, function, *,
                        from_device=False, to_device=False, run_async=False,
-                       compress_dtype=None):
+                       compress_dtype=None, comm=None):
         opts = self._prepare(Operation.reduce_scatter, sendbuf, None, recvbuf,
                              count, function=int(function),
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def alltoall(self, sendbuf, recvbuf, count, *, from_device=False,
-                 to_device=False, run_async=False, compress_dtype=None):
+                 to_device=False, run_async=False, compress_dtype=None,
+                 comm=None):
         opts = self._prepare(Operation.alltoall, sendbuf, None, recvbuf,
-                             count, compress_dtype=compress_dtype)
+                             count, compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
-    def split(self, rank_indices: list[int], axis_name: str | None = None) -> "ACCL":
+    def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
-        multi-communicator support: ACCL keeps a communicator list and
-        collectives take a communicator handle; tested by the multi-comm
-        gtest suites). The TPU form: a child ACCL over the sub-mesh of the
-        selected devices, with its own compiled schedules and buffers."""
+        multi-communicator support: the firmware caches the addressed
+        communicator per call from the descriptor's comm_addr,
+        ccl_offload_control.c:2317-2372). The new communicator's rank
+        table is written to exchange memory and its handle can be passed
+        as `comm=` to any collective — no new ACCL, no new device, no new
+        compile caches. Buffers stay full-world stacked arrays; a
+        sub-communicator collective touches only its member rows."""
         if len(set(rank_indices)) != len(rank_indices):
             raise ValueError("duplicate ranks in split")
         if not all(0 <= r < self.world for r in rank_indices):
             raise ValueError(f"split ranks outside world of {self.world}")
-        if self.mesh is None:
-            raise ValueError("split requires a mesh-backed ACCL")
-        devices = [self.mesh.devices.reshape(-1)[r] for r in rank_indices]
-        sub_mesh = Mesh(np.array(devices), (axis_name or self.axis_name,))
-        return ACCL(sub_mesh, axis_name or self.axis_name,
-                    arith_config=self.arith_config, **self._config)
+        parent = self.communicators[0].ranks
+        ranks = [
+            Rank(ip=parent[r].ip, port=parent[r].port,
+                 session_id=parent[r].session_id,
+                 max_segment_size=parent[r].max_segment_size,
+                 device_index=parent[r].device_index)
+            for r in rank_indices
+        ]
+        nwords = 2 + len(ranks) * Communicator.WORDS_PER_RANK
+        # the dynamic region ends where the register block begins (tuning
+        # registers, CFGRDY, RETCODE live at 0x1FC4-0x1FFC)
+        if self._exchmem_alloc + 4 * nwords > CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN:
+            raise MemoryError("exchange memory exhausted by communicators")
+        comm = Communicator(ranks, 0, self._exchmem_alloc)
+        self._exchmem_alloc += 4 * nwords
+        self.communicators.append(comm)
+        self._write_communicator(comm)
+        return comm
 
     def register_stream_producer(self, stream_id: int, fn):
         """Attach a device-side producer to a kernel stream (the PL
@@ -414,8 +459,8 @@ class ACCL:
         recvbuf.sync_from_device()
         return req
 
-    def barrier(self):
-        opts = self._prepare(Operation.barrier, None, None, None, 0)
+    def barrier(self, comm=None):
+        opts = self._prepare(Operation.barrier, None, None, None, 0, comm=comm)
         req = self.cclo.start(opts)
         req.wait()
         req.check()
@@ -437,5 +482,5 @@ class ACCL:
     def dump_exchange_memory(self) -> str:
         return self.cclo.dump_exchange_memory()
 
-    def dump_communicator(self) -> str:
-        return self.communicators[0].dump()
+    def dump_communicator(self, index: int = 0) -> str:
+        return self.communicators[index].dump()

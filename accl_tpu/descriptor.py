@@ -103,6 +103,7 @@ class CallOptions:
         return (
             self.scenario,
             self.count,
+            self.comm_addr,
             self.root_src_dst,
             self.function,
             self.data_type,

@@ -191,20 +191,34 @@ class EmuRank:
         self.wait(h)
         return h
 
+    # -- communicators (multi-communicator support) -----------------------
+
+    def write_communicator(self, comm) -> None:
+        """Write a Communicator's rank table into this rank's exchange
+        memory at comm.exchmem_addr; pass that address as comm_addr to any
+        collective (the firmware reads the table back per call,
+        ccl_offload_control.c:2317-2372). Membership is derived from each
+        entry's device_index (= global transport rank)."""
+        for i, w in enumerate(comm.exchmem_words()):
+            self.write(comm.exchmem_addr + 4 * i, w)
+
     # -- convenience collective wrappers (per-rank ACCL-style API) --------
 
-    def _opts(self, scenario, count, dtype, root=0, func=0, tag=TAG_ANY):
+    def _opts(self, scenario, count, dtype, root=0, func=0, tag=TAG_ANY,
+              comm_addr=0):
         return CallOptions(
             scenario=scenario, count=count, root_src_dst=root,
-            function=int(func), tag=tag,
+            function=int(func), tag=tag, comm_addr=comm_addr,
             data_type=from_numpy_dtype(dtype),
         )
 
-    def send(self, buf, count, dst, tag=TAG_ANY):
-        return self.call(self._opts(Operation.send, count, buf.dtype, dst, tag=tag), op0=buf)
+    def send(self, buf, count, dst, tag=TAG_ANY, comm_addr=0):
+        return self.call(self._opts(Operation.send, count, buf.dtype, dst,
+                                    tag=tag, comm_addr=comm_addr), op0=buf)
 
-    def recv(self, buf, count, src, tag=TAG_ANY):
-        return self.call(self._opts(Operation.recv, count, buf.dtype, src, tag=tag), res=buf)
+    def recv(self, buf, count, src, tag=TAG_ANY, comm_addr=0):
+        return self.call(self._opts(Operation.recv, count, buf.dtype, src,
+                                    tag=tag, comm_addr=comm_addr), res=buf)
 
     def copy(self, src, dst, count):
         return self.call(self._opts(Operation.copy, count, src.dtype), op0=src, res=dst)
@@ -213,39 +227,49 @@ class EmuRank:
         return self.call(self._opts(Operation.combine, count, op0.dtype, func=func),
                          op0=op0, op1=op1, res=res)
 
-    def bcast(self, buf, count, root):
-        return self.call(self._opts(Operation.bcast, count, buf.dtype, root), op0=buf)
+    def bcast(self, buf, count, root, comm_addr=0):
+        return self.call(self._opts(Operation.bcast, count, buf.dtype, root,
+                                    comm_addr=comm_addr), op0=buf)
 
-    def scatter(self, sendbuf, recvbuf, count, root):
-        return self.call(self._opts(Operation.scatter, count, recvbuf.dtype, root),
+    def scatter(self, sendbuf, recvbuf, count, root, comm_addr=0):
+        return self.call(self._opts(Operation.scatter, count, recvbuf.dtype,
+                                    root, comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def gather(self, sendbuf, recvbuf, count, root):
-        return self.call(self._opts(Operation.gather, count, sendbuf.dtype, root),
+    def gather(self, sendbuf, recvbuf, count, root, comm_addr=0):
+        return self.call(self._opts(Operation.gather, count, sendbuf.dtype,
+                                    root, comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def allgather(self, sendbuf, recvbuf, count):
-        return self.call(self._opts(Operation.allgather, count, sendbuf.dtype),
+    def allgather(self, sendbuf, recvbuf, count, comm_addr=0):
+        return self.call(self._opts(Operation.allgather, count, sendbuf.dtype,
+                                    comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def reduce(self, sendbuf, recvbuf, count, root, func):
-        return self.call(self._opts(Operation.reduce, count, sendbuf.dtype, root, func),
+    def reduce(self, sendbuf, recvbuf, count, root, func, comm_addr=0):
+        return self.call(self._opts(Operation.reduce, count, sendbuf.dtype,
+                                    root, func, comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def allreduce(self, sendbuf, recvbuf, count, func):
-        return self.call(self._opts(Operation.allreduce, count, sendbuf.dtype, func=func),
+    def allreduce(self, sendbuf, recvbuf, count, func, comm_addr=0):
+        return self.call(self._opts(Operation.allreduce, count, sendbuf.dtype,
+                                    func=func, comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def reduce_scatter(self, sendbuf, recvbuf, count, func):
-        return self.call(self._opts(Operation.reduce_scatter, count, sendbuf.dtype, func=func),
+    def reduce_scatter(self, sendbuf, recvbuf, count, func, comm_addr=0):
+        return self.call(self._opts(Operation.reduce_scatter, count,
+                                    sendbuf.dtype, func=func,
+                                    comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def alltoall(self, sendbuf, recvbuf, count):
-        return self.call(self._opts(Operation.alltoall, count, sendbuf.dtype),
+    def alltoall(self, sendbuf, recvbuf, count, comm_addr=0):
+        return self.call(self._opts(Operation.alltoall, count, sendbuf.dtype,
+                                    comm_addr=comm_addr),
                          op0=sendbuf, res=recvbuf)
 
-    def barrier(self):
-        return self.call(self._opts(Operation.barrier, 0, np.float32))
+    def barrier(self, comm_addr=0):
+        return self.call(self._opts(Operation.barrier, 0, np.float32,
+                                    comm_addr=comm_addr))
 
 
 class EmuWorld:

@@ -53,6 +53,10 @@ class TPUDevice(CCLODevice):
 
         self.streams = StreamRegistry()
         self._stream_cache: dict = {}
+        # comm_addr -> resolved communicator context (the firmware caches
+        # the addressed communicator per call, ccl_offload_control.c:2317-2372)
+        self._comm_cache: dict[int, "_CommCtx"] = {}
+        self._comm_extents: dict[int, int] = {}  # comm_addr -> table end
 
     # -- registry ---------------------------------------------------------
 
@@ -92,6 +96,100 @@ class TPUDevice(CCLODevice):
             or defaults.reduce_flat_tree_max_count,
         )
 
+    # -- communicator resolution (comm_addr -> rank group) -----------------
+
+    def _comm_ctx(self, comm_addr: int) -> "_CommCtx":
+        """Resolve a descriptor's comm_addr into an execution context by
+        reading the rank table back from exchange memory — the same
+        caching the firmware does per call (ccl_offload_control.c:2317-2372).
+        comm_addr 0 or a full-world identity table is the default axis."""
+        ctx = self._comm_cache.get(comm_addr)
+        if ctx is not None:
+            return ctx
+        rows = None
+        table_words = 0
+        if comm_addr != 0:
+            from ..communicator import Communicator
+
+            size = self.read(comm_addr)
+            if not 0 < size <= self.world:
+                raise ValueError(
+                    f"invalid communicator at {comm_addr:#x}: size={size}")
+            nwords = 2 + size * Communicator.WORDS_PER_RANK
+            table_words = nwords
+            words = [self.read(comm_addr + 4 * i) for i in range(nwords)]
+            comm = Communicator.from_exchmem_words(words, comm_addr)
+            members = tuple(r.device_index for r in comm.ranks)
+            if any(not 0 <= d < self.world for d in members):
+                raise ValueError(
+                    f"communicator at {comm_addr:#x} references device "
+                    f"indices {members} outside world {self.world}")
+            if len(set(members)) != len(members):
+                raise ValueError(
+                    f"communicator at {comm_addr:#x} has duplicate "
+                    f"members {members}")
+            if members != tuple(range(self.world)):
+                rows = members
+        if rows is None:
+            ctx = _CommCtx(self.world, self.mesh, self.compiler, None)
+        else:
+            from jax.sharding import Mesh
+
+            devices = self.mesh.devices.reshape(-1)
+            sub_mesh = Mesh(np.array([devices[r] for r in rows]),
+                            (self.axis_name,))
+            compiler = ScheduleCompiler(
+                sub_mesh, self.axis_name,
+                arith_table=self.compiler.arith_table,
+                use_pallas_ring=self.compiler.use_pallas_ring,
+            )
+            ctx = _CommCtx(len(rows), sub_mesh, compiler, rows)
+        self._comm_cache[comm_addr] = ctx
+        if table_words:
+            self._comm_extents[comm_addr] = comm_addr + 4 * table_words
+        return ctx
+
+    def write(self, addr: int, value: int) -> None:
+        # a write into a cached communicator table invalidates that cache
+        # entry (the firmware re-reads exchange memory per call; the cache
+        # must not outlive the table it mirrors)
+        for start, end in list(self._comm_extents.items()):
+            if start <= addr < end:
+                self._comm_cache.pop(start, None)
+                self._comm_extents.pop(start, None)
+        super().write(addr, value)
+
+    def _rows_to_submesh(self, arr, ctx: "_CommCtx", n: int):
+        """View the member rows of a full-world stacked buffer as a
+        (group, n) array on the sub-mesh. Each row already lives on its
+        member device, so this is shard re-labelling, not data movement."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        by_dev = {s.device: s.data for s in arr.addressable_shards}
+        shards = [by_dev[d][..., :n] for d in ctx.mesh.devices.reshape(-1)]
+        sharding = NamedSharding(ctx.mesh, PartitionSpec(self.axis_name))
+        return jax.make_array_from_single_device_arrays(
+            (ctx.world, n), sharding, shards)
+
+    def _scatter_rows(self, full, ctx: "_CommCtx", out):
+        """Write a sub-communicator result back into the member rows of a
+        full-world buffer, leaving non-member rows untouched."""
+        by_dev = {s.device: s.data for s in full.addressable_shards}
+        out_by_dev = {s.device: s.data for s in out.addressable_shards}
+        shards = []
+        member_devs = set(out_by_dev)
+        for d in self.mesh.devices.reshape(-1):
+            cur = by_dev[d]
+            if d in member_devs:
+                new = out_by_dev[d].astype(cur.dtype)
+                if new.shape[-1] != cur.shape[-1]:
+                    new = cur.at[..., : new.shape[-1]].set(new)
+                shards.append(new)
+            else:
+                shards.append(cur)
+        return jax.make_array_from_single_device_arrays(
+            full.shape, full.sharding, shards)
+
     # -- execution --------------------------------------------------------
 
     def start(self, options: CallOptions) -> BaseRequest:
@@ -109,18 +207,19 @@ class TPUDevice(CCLODevice):
         return self._launch(options)
 
     def _launch(self, options: CallOptions) -> BaseRequest:
+        ctx = self._comm_ctx(options.comm_addr)
         plan = select_algorithm(
             options.scenario,
             options.count,
             dtype_nbytes(options.data_type),
-            self.world,
+            ctx.world,
             options.compression_flags,
             options.stream_flags,
             max_eager_size=self.max_eager_size,
             eager_rx_buf_size=self.eager_rx_buf_size,
             tuning=self.tuning(),
         )
-        fn = self.compiler.lower(options, plan)
+        fn = ctx.compiler.lower(options, plan)
 
         op0 = self._buf(options.addr_0)
         op1 = self._buf(options.addr_1)
@@ -128,7 +227,7 @@ class TPUDevice(CCLODevice):
         args = []
         n = options.count
         scen = options.scenario
-        in_n = n * self.world if scen in (
+        in_n = n * ctx.world if scen in (
             Operation.scatter,
             Operation.reduce_scatter,
             Operation.alltoall,
@@ -136,14 +235,18 @@ class TPUDevice(CCLODevice):
         if scen == Operation.barrier:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            token_sharding = NamedSharding(self.mesh, PartitionSpec(self.axis_name))
+            token_sharding = NamedSharding(ctx.mesh, PartitionSpec(self.axis_name))
             args.append(
-                jax.device_put(np.ones((self.world, 1), np.float32), token_sharding)
+                jax.device_put(np.ones((ctx.world, 1), np.float32), token_sharding)
             )
-        else:
+        elif ctx.rows is None:
             args.append(_slice_to(op0.device, in_n))
             if scen == Operation.combine:
                 args.append(_slice_to(op1.device, in_n))
+        else:
+            args.append(self._rows_to_submesh(op0.device, ctx, in_n))
+            if scen == Operation.combine:
+                args.append(self._rows_to_submesh(op1.device, ctx, in_n))
 
         out = fn(*args)
 
@@ -151,7 +254,10 @@ class TPUDevice(CCLODevice):
             if res is not None and scen != Operation.barrier:
                 if res.device is None:  # host-only result: materialize first
                     res.sync_to_device()
-                res.device = _place_into(res.device, out)
+                if ctx.rows is None:
+                    res.device = _place_into(res.device, out)
+                else:
+                    res.device = self._scatter_rows(res.device, ctx, out)
 
         req = TPURequest(options.scenario.name, [out], on_complete=place)
         req.plan = plan
@@ -165,7 +271,7 @@ class TPUDevice(CCLODevice):
         queue plays per-rank in the reference (rxbuf_seek.cpp:20-79)."""
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
-        self._pending_sends[(src, dst, options.tag)] = options
+        self._pending_sends[(options.comm_addr, src, dst, options.tag)] = options
         req = BaseRequest("send")
         req.running()
         req.complete(0)
@@ -175,11 +281,11 @@ class TPUDevice(CCLODevice):
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
         match = None
-        for (s, d, tag) in self._pending_sends:
-            if s == src and d == dst and (
+        for (ca, s, d, tag) in self._pending_sends:
+            if ca == options.comm_addr and s == src and d == dst and (
                 tag == options.tag or TAG_ANY in (tag, options.tag)
             ):
-                match = (s, d, tag)
+                match = (ca, s, d, tag)
                 break
         if match is None:
             req = BaseRequest("recv")
@@ -190,8 +296,9 @@ class TPUDevice(CCLODevice):
         pair = CallOptions(
             scenario=Operation.send,
             count=options.count,
+            comm_addr=options.comm_addr,
             root_src_dst=src | (dst << 16),
-            tag=match[2],
+            tag=match[3],
             compression_flags=options.compression_flags,
             stream_flags=options.stream_flags,
             data_type=options.data_type,
@@ -265,6 +372,8 @@ class TPUDevice(CCLODevice):
         if fn == CfgFunc.reset_periph:
             self._pending_sends.clear()
             self.compiler._cache.clear()
+            self._comm_cache.clear()
+            self._comm_extents.clear()
         elif fn == CfgFunc.enable_pkt:
             self.pkt_enabled = True
         elif fn == CfgFunc.set_timeout:
@@ -279,6 +388,20 @@ class TPUDevice(CCLODevice):
             self.max_rendezvous_size = options.count
         req.complete(0)
         return req
+
+
+class _CommCtx:
+    """Resolved communicator: group size, the mesh it executes on, its
+    schedule compiler, and the member rows of full-world buffers (None for
+    the default full-axis communicator)."""
+
+    __slots__ = ("world", "mesh", "compiler", "rows")
+
+    def __init__(self, world, mesh, compiler, rows):
+        self.world = world
+        self.mesh = mesh
+        self.compiler = compiler
+        self.rows = rows
 
 
 def _slice_to(arr, n: int):

@@ -41,6 +41,7 @@ namespace {
 // ---------------------------------------------------------------------------
 enum Err : uint32_t {
   NO_ERROR = 0,
+  DMA_DECODE_ERROR = 1u << 2,
   RECEIVE_TIMEOUT_ERROR = 1u << 11,
   COLLECTIVE_NOT_IMPLEMENTED = 1u << 14,
   DMA_SIZE_ERROR = 1u << 18,
@@ -263,12 +264,27 @@ struct RndzvDone {
   uint32_t tag;
 };
 
+// Resolved communicator view: group size, this rank's position in the
+// group, and the group-rank -> global-rank map (empty = identity over the
+// transport world). The firmware equivalent caches the communicator
+// addressed by the descriptor's comm_addr per call
+// (ccl_offload_control.c:2317-2372).
+struct CommView {
+  uint32_t world = 0;
+  uint32_t rank = 0;
+  std::vector<uint32_t> map;
+  uint32_t g(uint32_t r) const { return map.empty() ? r : map[r]; }
+};
+
 struct Call {
   int64_t handle;
   uint32_t desc[15];
   uint32_t dtype;
   void *op0, *op1, *res;
   uint32_t current_step = 0;  // resumption point across NOT_READY requeues
+  // resolved communicator persists across requeues like current_step
+  bool comm_resolved = false;
+  CommView comm;
   bool deadline_set = false;
   std::chrono::steady_clock::time_point deadline;
   std::chrono::steady_clock::time_point t_start;
@@ -339,6 +355,57 @@ struct accl_rt {
   uint32_t tuning(uint32_t addr, uint32_t dflt) {
     uint32_t v = rd(addr);
     return v ? v : dflt;
+  }
+
+  // Parse the communicator table at comm_addr out of exchange memory
+  // (layout: size, local_rank, then per rank 7 words of which word 6 is
+  // the device index == global transport rank; communicator.py
+  // exchmem_words). comm_addr 0 means the full transport world.
+  // Membership is derived from the device-index column so each rank's
+  // exchmem copy needs no rank-specific local_rank word.
+  //
+  // Wire-format note: like the reference 64 B header (eth_intf.h:94-151),
+  // eager frames carry (src, tag, seqn) but no communicator id, so
+  // OVERLAPPING communicators must use distinct tags for concurrent
+  // traffic on a shared link — the same discipline the reference
+  // firmware's rxbuf seek (tag, src, seqn) matching requires. Disjoint
+  // groups never share links and need no care.
+  bool resolve_comm(uint32_t comm_addr, CommView &cm) {
+    cm.map.clear();
+    if (comm_addr == 0) {
+      cm.world = world;
+      cm.rank = rank;
+      return true;
+    }
+    if (comm_addr % 4 != 0 || (uint64_t)comm_addr + 4 > EXCHMEM_BYTES)
+      return false;
+    uint32_t size = rd(comm_addr);
+    if (size == 0 || size > world) return false;
+    if ((uint64_t)comm_addr + 4ull * (2 + 7ull * size) > EXCHMEM_BYTES)
+      return false;
+    cm.map.resize(size);
+    cm.rank = UINT32_MAX;
+    bool ident = (size == world);
+    uint64_t seen = 0;  // duplicate-member bitmap (world <= 64 in practice;
+                        // larger worlds fall back to the O(n^2) scan)
+    for (uint32_t i = 0; i < size; i++) {
+      uint32_t dev = rd(comm_addr + 4 * (2 + 7 * i + 6));
+      if (dev >= world) return false;
+      if (dev < 64) {
+        if (seen & (1ull << dev)) return false;  // duplicate member
+        seen |= 1ull << dev;
+      } else {
+        for (uint32_t j = 0; j < i; j++)
+          if (cm.map[j] == dev) return false;
+      }
+      cm.map[i] = dev;
+      if (dev == rank) cm.rank = i;
+      if (dev != i) ident = false;
+    }
+    if (cm.rank == UINT32_MAX) return false;  // caller not a member
+    cm.world = size;
+    if (ident) cm.map.clear();
+    return true;
   }
 
   // ----- transport -----
@@ -645,23 +712,24 @@ struct accl_rt {
 
   // ----- collective algorithms (firmware ports; cites in each) -----
 
-  uint32_t do_bcast(uint8_t *buf, uint64_t bytes, uint32_t root, uint32_t tag) {
-    if (world == 1) return NO_ERROR;
+  uint32_t do_bcast(const CommView &cm, uint8_t *buf, uint64_t bytes,
+                    uint32_t root, uint32_t tag) {
+    if (cm.world == 1) return NO_ERROR;
     if (is_rndzv(bytes) &&
-        world > tuning(BCAST_FLAT_TREE_MAX_RANKS, 3)) {
+        cm.world > tuning(BCAST_FLAT_TREE_MAX_RANKS, 3)) {
       // binary distance-doubling tree (.c:814-867)
-      uint32_t l = (rank + world - root) % world;
-      bool sender = (rank == root);
+      uint32_t l = (cm.rank + cm.world - root) % cm.world;
+      bool sender = (cm.rank == root);
       uint32_t d = 1;
-      while ((d << 1) <= world - 1) d <<= 1;
+      while ((d << 1) <= cm.world - 1) d <<= 1;
       uint32_t err = NO_ERROR;
       while (d > 0) {
-        if (sender && l % (2 * d) == 0 && l + d < world) {
-          uint32_t peer = (l + d + root) % world;
-          err |= p2p_send(peer, buf, bytes, tag);
+        if (sender && l % (2 * d) == 0 && l + d < cm.world) {
+          uint32_t peer = (l + d + root) % cm.world;
+          err |= p2p_send(cm.g(peer), buf, bytes, tag);
         } else if (!sender && l % d == 0 && l >= d && (l - d) % (2 * d) == 0) {
-          uint32_t peer = (l - d + root) % world;
-          err |= p2p_recv(peer, buf, bytes, tag);
+          uint32_t peer = (l - d + root) % cm.world;
+          err |= p2p_recv(cm.g(peer), buf, bytes, tag);
           sender = true;
         }
         d >>= 1;
@@ -670,44 +738,44 @@ struct accl_rt {
     }
     // flat fan-out, eager or rendezvous (.c:868-988)
     uint32_t err = NO_ERROR;
-    if (rank == root) {
-      for (uint32_t i = 0; i < world; i++)
-        if (i != root) err |= p2p_send(i, buf, bytes, tag);
+    if (cm.rank == root) {
+      for (uint32_t i = 0; i < cm.world; i++)
+        if (i != root) err |= p2p_send(cm.g(i), buf, bytes, tag);
     } else {
-      err |= p2p_recv(root, buf, bytes, tag);
+      err |= p2p_recv(cm.g(root), buf, bytes, tag);
     }
     return err;
   }
 
-  uint32_t do_scatter(const uint8_t *src, uint8_t *dst, uint64_t bytes,
-                      uint32_t root, uint32_t tag) {
+  uint32_t do_scatter(const CommView &cm, const uint8_t *src, uint8_t *dst,
+                      uint64_t bytes, uint32_t root, uint32_t tag) {
     uint32_t err = NO_ERROR;
-    if (rank == root) {
-      for (uint32_t i = 0; i < world; i++) {
+    if (cm.rank == root) {
+      for (uint32_t i = 0; i < cm.world; i++) {
         if (i == root) continue;
-        err |= p2p_send(i, src + (uint64_t)i * bytes, bytes, tag);
+        err |= p2p_send(cm.g(i), src + (uint64_t)i * bytes, bytes, tag);
       }
       std::memcpy(dst, src + (uint64_t)root * bytes, bytes);
     } else {
-      err |= p2p_recv(root, dst, bytes, tag);
+      err |= p2p_recv(cm.g(root), dst, bytes, tag);
     }
     return err;
   }
 
-  uint32_t do_gather(const uint8_t *src, uint8_t *dst, uint64_t bytes,
-                     uint32_t root, uint32_t tag) {
+  uint32_t do_gather(const CommView &cm, const uint8_t *src, uint8_t *dst,
+                     uint64_t bytes, uint32_t root, uint32_t tag) {
     // eager: ring daisy-chain (.c:1206-1293); rendezvous: flat to root
     // (.c:1142-1204). The ring keeps per-link traffic constant.
     uint32_t err = NO_ERROR;
     if (!is_rndzv(bytes)) {
-      uint32_t nxt = (rank + 1) % world;
-      uint32_t prv = (rank + world - 1) % world;
-      if (rank == root) {
+      uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+      uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
+      if (cm.rank == root) {
         std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
         std::vector<uint8_t> tmp(bytes);
-        for (uint32_t s = 0; s < world - 1; s++) {
+        for (uint32_t s = 0; s < cm.world - 1; s++) {
           err |= egr_recv(prv, tag, tmp.data(), bytes);
-          uint32_t origin = (root + world - 1 - s) % world;
+          uint32_t origin = (root + cm.world - 1 - s) % cm.world;
           std::memcpy(dst + (uint64_t)origin * bytes, tmp.data(), bytes);
         }
       } else {
@@ -715,45 +783,47 @@ struct accl_rt {
         // farther from root than us — world-1-dist(rank) messages, where
         // dist is the +1-direction hop count to root.
         err |= egr_send(nxt, src, bytes, tag);
-        uint32_t dist = (root + world - rank) % world;
+        uint32_t dist = (root + cm.world - cm.rank) % cm.world;
         std::vector<uint8_t> tmp(bytes);
-        for (uint32_t s = 0; s + 1 + dist < world; s++) {
+        for (uint32_t s = 0; s + 1 + dist < cm.world; s++) {
           err |= egr_recv(prv, tag, tmp.data(), bytes);
           err |= egr_send(nxt, tmp.data(), bytes, tag);
         }
       }
       return err;
     }
-    if (rank == root) {
+    if (cm.rank == root) {
       std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
-      for (uint32_t i = 0; i < world; i++) {
+      for (uint32_t i = 0; i < cm.world; i++) {
         if (i == root) continue;
-        rendezvous_send_addr(i, (uint64_t)(uintptr_t)(dst + (uint64_t)i * bytes),
+        rendezvous_send_addr(cm.g(i),
+                             (uint64_t)(uintptr_t)(dst + (uint64_t)i * bytes),
                              bytes, tag);
       }
-      for (uint32_t i = 0; i + 1 < world; i++) {
+      for (uint32_t i = 0; i + 1 < cm.world; i++) {
         uint32_t s;
         uint64_t va;
         err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
       }
     } else {
       uint64_t vaddr;
-      err |= rendezvous_get_addr(root, bytes, tag, &vaddr);
-      if (err == NO_ERROR) err |= rendezvous_write(root, vaddr, src, bytes, tag);
+      err |= rendezvous_get_addr(cm.g(root), bytes, tag, &vaddr);
+      if (err == NO_ERROR)
+        err |= rendezvous_write(cm.g(root), vaddr, src, bytes, tag);
     }
     return err;
   }
 
-  uint32_t do_allgather(const uint8_t *src, uint8_t *dst, uint64_t bytes,
-                        uint32_t tag) {
+  uint32_t do_allgather(const CommView &cm, const uint8_t *src, uint8_t *dst,
+                        uint64_t bytes, uint32_t tag) {
     // ring allgather in both protocols (.c:1297-1499)
-    uint32_t nxt = (rank + 1) % world;
-    uint32_t prv = (rank + world - 1) % world;
+    uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+    uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
     uint32_t err = NO_ERROR;
-    std::memcpy(dst + (uint64_t)rank * bytes, src, bytes);
+    std::memcpy(dst + (uint64_t)cm.rank * bytes, src, bytes);
     const uint8_t *send_ptr = src;
-    for (uint32_t s = 0; s < world - 1; s++) {
-      uint32_t origin = (rank + world - 1 - s) % world;
+    for (uint32_t s = 0; s < cm.world - 1; s++) {
+      uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
       uint8_t *recv_ptr = dst + (uint64_t)origin * bytes;
       // send current, then receive from prev (socket buffering absorbs the
       // send so the ring cannot deadlock at these sizes; rendezvous path
@@ -776,27 +846,27 @@ struct accl_rt {
     return err;
   }
 
-  uint32_t do_reduce(uint32_t dt, uint32_t func, const uint8_t *src,
-                     uint8_t *dst, uint64_t count, uint32_t root,
-                     uint32_t tag) {
+  uint32_t do_reduce(const CommView &cm, uint32_t dt, uint32_t func,
+                     const uint8_t *src, uint8_t *dst, uint64_t count,
+                     uint32_t root, uint32_t tag) {
     uint64_t bytes = count * dtype_bytes(dt);
     uint32_t err = NO_ERROR;
-    if (world == 1) {
+    if (cm.world == 1) {
       std::memcpy(dst, src, bytes);
       return NO_ERROR;
     }
     if (!is_rndzv(bytes)) {
       // eager ring relay with fused recv-reduce-send (.c:1730-1743)
-      uint32_t prv = (rank + world - 1) % world;
-      uint32_t nxt = (rank + 1) % world;
-      uint32_t l = (rank + world - root) % world;  // root at 0
+      uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
+      uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+      uint32_t l = (cm.rank + cm.world - root) % cm.world;  // root at 0
       std::vector<uint8_t> acc(src, src + bytes);
       if (l != 1) {  // everyone except the chain head receives a partial
         err |= egr_recv(prv, tag, acc.data(), bytes);
         if (err) return err;
         err |= combine_buffers(dt, func, acc.data(), src, count);
       }
-      if (rank != root) {
+      if (cm.rank != root) {
         err |= egr_send(nxt, acc.data(), bytes, tag);
       } else {
         std::memcpy(dst, acc.data(), bytes);
@@ -805,21 +875,22 @@ struct accl_rt {
     }
     // rendezvous: flat tree when small world/message, else binomial
     // (.c:1531-1727)
-    bool flat = world <= tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4) ||
+    bool flat = cm.world <= tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4) ||
                 bytes <= tuning(REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024);
-    uint32_t l = (rank + world - root) % world;
+    uint32_t l = (cm.rank + cm.world - root) % cm.world;
     if (flat) {
-      if (rank == root) {
-        std::vector<uint8_t> scratch((uint64_t)(world - 1) * bytes);
-        for (uint32_t i = 0, j = 0; i < world; i++) {
+      if (cm.rank == root) {
+        std::vector<uint8_t> scratch((uint64_t)(cm.world - 1) * bytes);
+        for (uint32_t i = 0, j = 0; i < cm.world; i++) {
           if (i == root) continue;
           rendezvous_send_addr(
-              i, (uint64_t)(uintptr_t)(scratch.data() + (uint64_t)j * bytes),
+              cm.g(i),
+              (uint64_t)(uintptr_t)(scratch.data() + (uint64_t)j * bytes),
               bytes, tag);
           j++;
         }
         std::memcpy(dst, src, bytes);
-        for (uint32_t i = 0; i + 1 < world; i++) {
+        for (uint32_t i = 0; i + 1 < cm.world; i++) {
           uint32_t s;
           uint64_t va;
           err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
@@ -828,58 +899,59 @@ struct accl_rt {
         }
       } else {
         uint64_t vaddr;
-        err |= rendezvous_get_addr(root, bytes, tag, &vaddr);
+        err |= rendezvous_get_addr(cm.g(root), bytes, tag, &vaddr);
         if (err) return err;
-        err |= rendezvous_write(root, vaddr, src, bytes, tag);
+        err |= rendezvous_write(cm.g(root), vaddr, src, bytes, tag);
       }
       return err;
     }
     // binomial combining tree: children l%2d==d send to parent l-d
     std::vector<uint8_t> acc(src, src + bytes);
     std::vector<uint8_t> tmp(bytes);
-    for (uint32_t d = 1; d < world; d <<= 1) {
+    for (uint32_t d = 1; d < cm.world; d <<= 1) {
       if (l % (2 * d) == d) {
-        uint32_t peer = (l - d + root) % world;
-        err |= p2p_send(peer, acc.data(), bytes, tag);
+        uint32_t peer = (l - d + root) % cm.world;
+        err |= p2p_send(cm.g(peer), acc.data(), bytes, tag);
         return err;  // sent our subtree: done
       }
-      if (l % (2 * d) == 0 && l + d < world) {
-        uint32_t peer = (l + d + root) % world;
-        err |= p2p_recv(peer, tmp.data(), bytes, tag);
+      if (l % (2 * d) == 0 && l + d < cm.world) {
+        uint32_t peer = (l + d + root) % cm.world;
+        err |= p2p_recv(cm.g(peer), tmp.data(), bytes, tag);
         if (err) return err;
         err |= combine_buffers(dt, func, acc.data(), tmp.data(), count);
       }
     }
-    if (rank == root) std::memcpy(dst, acc.data(), bytes);
+    if (cm.rank == root) std::memcpy(dst, acc.data(), bytes);
     return err;
   }
 
-  uint32_t do_allreduce(uint32_t dt, uint32_t func, const uint8_t *src,
-                        uint8_t *dst, uint64_t count, uint32_t tag) {
+  uint32_t do_allreduce(const CommView &cm, uint32_t dt, uint32_t func,
+                        const uint8_t *src, uint8_t *dst, uint64_t count,
+                        uint32_t tag) {
     uint64_t eb = dtype_bytes(dt);
     uint64_t bytes = count * eb;
-    if (world == 1) {
+    if (cm.world == 1) {
       std::memcpy(dst, src, bytes);
       return NO_ERROR;
     }
     if (is_rndzv(bytes)) {
       // reduce + bcast composition (.c:1878-1887)
-      uint32_t err = do_reduce(dt, func, src, dst, count, 0, tag);
+      uint32_t err = do_reduce(cm, dt, func, src, dst, count, 0, tag);
       if (err) return err;
-      return do_bcast(dst, bytes, 0, tag);
+      return do_bcast(cm, dst, bytes, 0, tag);
     }
     // segmented ring reduce-scatter + allgather (.c:1888-2071)
     uint64_t max_seg = rx_buf_bytes / eb;
-    max_seg -= max_seg % world;
-    if (max_seg == 0) max_seg = world;
+    max_seg -= max_seg % cm.world;
+    if (max_seg == 0) max_seg = cm.world;
     std::vector<uint8_t> chunk_buf, tmp;
     std::memcpy(dst, src, bytes);
-    uint32_t nxt = (rank + 1) % world;
-    uint32_t prv = (rank + world - 1) % world;
+    uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+    uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
     uint32_t err = NO_ERROR;
     for (uint64_t off = 0; off < count; off += max_seg) {
       uint64_t elems = std::min<uint64_t>(max_seg, count - off);
-      uint64_t bulk = (elems + world - 1) / world;
+      uint64_t bulk = (elems + cm.world - 1) / cm.world;
       auto seg_chunk = [&](uint32_t idx) -> std::pair<uint64_t, uint64_t> {
         uint64_t lo = std::min<uint64_t>(idx * bulk, elems);
         uint64_t hi = std::min<uint64_t>(lo + bulk, elems);
@@ -888,26 +960,26 @@ struct accl_rt {
       uint8_t *seg = dst + off * eb;
       // reduce-scatter: send chunk rank-1 first; hop-s arrival is chunk
       // rank-2-s (same derivation as schedules.reduce_scatter_ring)
-      uint32_t cidx = (rank + world - 1) % world;
+      uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
       auto [clo, cn] = seg_chunk(cidx);
       chunk_buf.assign(seg + clo * eb, seg + (clo + cn) * eb);
       err |= egr_send(nxt, chunk_buf.data(), cn * eb, tag);
-      for (uint32_t s = 0; s < world - 1; s++) {
-        uint32_t idx = (rank + 2 * world - 2 - s) % world;
+      for (uint32_t s = 0; s < cm.world - 1; s++) {
+        uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
         auto [lo, n] = seg_chunk(idx);
         tmp.resize(n * eb);
         err |= egr_recv(prv, tag, tmp.data(), n * eb);
         if (err) return err;
         err |= combine_buffers(dt, func, seg + lo * eb, tmp.data(), n);
-        if (s + 1 < world - 1)
+        if (s + 1 < cm.world - 1)
           err |= egr_send(nxt, seg + lo * eb, n * eb, tag);
       }
       // ring allgather of reduced chunks (chunk `rank` now final)
-      uint32_t gidx = rank;
-      for (uint32_t s = 0; s < world - 1; s++) {
+      uint32_t gidx = cm.rank;
+      for (uint32_t s = 0; s < cm.world - 1; s++) {
         auto [glo, gn] = seg_chunk(gidx);
         err |= egr_send(nxt, seg + glo * eb, gn * eb, tag);
-        uint32_t origin = (rank + world - 1 - s) % world;
+        uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
         auto [olo, on] = seg_chunk(origin);
         err |= egr_recv(prv, tag, seg + olo * eb, on * eb);
         if (err) return err;
@@ -917,79 +989,82 @@ struct accl_rt {
     return err;
   }
 
-  uint32_t do_reduce_scatter(uint32_t dt, uint32_t func, const uint8_t *src,
-                             uint8_t *dst, uint64_t count, uint32_t tag) {
+  uint32_t do_reduce_scatter(const CommView &cm, uint32_t dt, uint32_t func,
+                             const uint8_t *src, uint8_t *dst, uint64_t count,
+                             uint32_t tag) {
     // count = per-rank output elements; input holds world*count.
     uint64_t eb = dtype_bytes(dt);
     uint64_t bytes = count * eb;
-    if (world == 1) {
+    if (cm.world == 1) {
       std::memcpy(dst, src, bytes);
       return NO_ERROR;
     }
     if (is_rndzv(bytes)) {
       // reduce(count*world) to 0 then scatter (.c:1768-1781)
-      std::vector<uint8_t> full((uint64_t)world * bytes);
-      uint32_t err =
-          do_reduce(dt, func, src, full.data(), (uint64_t)count * world, 0, tag);
+      std::vector<uint8_t> full((uint64_t)cm.world * bytes);
+      uint32_t err = do_reduce(cm, dt, func, src, full.data(),
+                               (uint64_t)count * cm.world, 0, tag);
       if (err) return err;
-      return do_scatter(full.data(), dst, bytes, 0, tag);
+      return do_scatter(cm, full.data(), dst, bytes, 0, tag);
     }
     // eager ring (.c:1782-1850)
-    uint32_t nxt = (rank + 1) % world;
-    uint32_t prv = (rank + world - 1) % world;
+    uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+    uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
     uint32_t err = NO_ERROR;
     std::vector<uint8_t> acc(bytes), tmp(bytes);
-    uint32_t cidx = (rank + world - 1) % world;
+    uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
     std::memcpy(acc.data(), src + (uint64_t)cidx * bytes, bytes);
     err |= egr_send(nxt, acc.data(), bytes, tag);
-    for (uint32_t s = 0; s < world - 1; s++) {
-      uint32_t idx = (rank + 2 * world - 2 - s) % world;
+    for (uint32_t s = 0; s < cm.world - 1; s++) {
+      uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
       err |= egr_recv(prv, tag, tmp.data(), bytes);
       if (err) return err;
       err |= combine_buffers(dt, func, tmp.data(),
                              src + (uint64_t)idx * bytes, count);
-      if (s + 1 < world - 1) err |= egr_send(nxt, tmp.data(), bytes, tag);
+      if (s + 1 < cm.world - 1) err |= egr_send(nxt, tmp.data(), bytes, tag);
     }
     std::memcpy(dst, tmp.data(), bytes);
     return err;
   }
 
-  uint32_t do_alltoall(const uint8_t *src, uint8_t *dst, uint64_t bytes,
-                       uint32_t tag) {
+  uint32_t do_alltoall(const CommView &cm, const uint8_t *src, uint8_t *dst,
+                       uint64_t bytes, uint32_t tag) {
     // pairwise rotation exchange (.c:2140-2211)
     uint32_t err = NO_ERROR;
-    std::memcpy(dst + (uint64_t)rank * bytes, src + (uint64_t)rank * bytes,
-                bytes);
+    std::memcpy(dst + (uint64_t)cm.rank * bytes,
+                src + (uint64_t)cm.rank * bytes, bytes);
     bool rv = is_rndzv(bytes);
-    for (uint32_t k = 1; k < world; k++) {
-      uint32_t to = (rank + k) % world;
-      uint32_t from = (rank + world - k) % world;
+    for (uint32_t k = 1; k < cm.world; k++) {
+      uint32_t to = (cm.rank + k) % cm.world;
+      uint32_t from = (cm.rank + cm.world - k) % cm.world;
       uint8_t *rptr = dst + (uint64_t)from * bytes;
       if (rv) {
         // post our landing address before sending: every rank's step-k
         // target posted its own at step k, so no addr-wait cycle forms
-        rendezvous_send_addr(from, (uint64_t)(uintptr_t)rptr, bytes, tag);
-        err |= p2p_send(to, src + (uint64_t)to * bytes, bytes, tag);
-        err |= rendezvous_get_completion(from, (uint64_t)(uintptr_t)rptr,
+        rendezvous_send_addr(cm.g(from), (uint64_t)(uintptr_t)rptr, bytes, tag);
+        err |= p2p_send(cm.g(to), src + (uint64_t)to * bytes, bytes, tag);
+        err |= rendezvous_get_completion(cm.g(from), (uint64_t)(uintptr_t)rptr,
                                          bytes, tag);
       } else {
-        err |= p2p_send(to, src + (uint64_t)to * bytes, bytes, tag);
-        err |= p2p_recv(from, rptr, bytes, tag);
+        err |= p2p_send(cm.g(to), src + (uint64_t)to * bytes, bytes, tag);
+        err |= p2p_recv(cm.g(from), rptr, bytes, tag);
       }
       if (err) return err;
     }
     return err;
   }
 
-  uint32_t do_barrier(uint32_t tag) {
+  uint32_t do_barrier(const CommView &cm, uint32_t tag) {
     // zero-payload notification gather to 0 + fan-out (.c:2078-2120)
     uint32_t err = NO_ERROR;
-    if (rank == 0) {
-      for (uint32_t i = 1; i < world; i++) err |= egr_recv(i, tag, nullptr, 0);
-      for (uint32_t i = 1; i < world; i++) err |= egr_send(i, nullptr, 0, tag);
+    if (cm.rank == 0) {
+      for (uint32_t i = 1; i < cm.world; i++)
+        err |= egr_recv(cm.g(i), tag, nullptr, 0);
+      for (uint32_t i = 1; i < cm.world; i++)
+        err |= egr_send(cm.g(i), nullptr, 0, tag);
     } else {
-      err |= egr_send(0, nullptr, 0, tag);
-      err |= egr_recv(0, tag, nullptr, 0);
+      err |= egr_send(cm.g(0), nullptr, 0, tag);
+      err |= egr_recv(cm.g(0), tag, nullptr, 0);
     }
     return err;
   }
@@ -1001,6 +1076,15 @@ struct accl_rt {
   // arithconfig.hpp:102-119): cast operands to fp16 scratch, run the
   // whole collective at half wire width, cast the result back.
   uint32_t execute(Call &c) {
+    // The firmware caches the communicator addressed by desc word 2 per
+    // call (ccl_offload_control.c:2317-2372); malformed tables or calls
+    // from a non-member rank fail descriptor decode. The resolved view
+    // rides the Call so NOT_READY requeues skip the re-parse.
+    if (!c.comm_resolved) {
+      if (!resolve_comm(c.desc[2], c.comm)) return DMA_DECODE_ERROR;
+      c.comm_resolved = true;
+    }
+    const CommView &cm = c.comm;
     constexpr uint32_t ETH_COMPRESSED = 8;
     uint32_t comp_flags = c.desc[7];
     if ((comp_flags & ETH_COMPRESSED) && c.dtype == ACCL_DT_FLOAT32) {
@@ -1008,11 +1092,11 @@ struct accl_rt {
       uint64_t count = c.desc[1];
       uint64_t in_elems = count, out_elems = count;
       switch (scenario) {
-        case SC_SCATTER: in_elems = count * world; break;
-        case SC_REDUCE_SCATTER: in_elems = count * world; break;
-        case SC_ALLTOALL: in_elems = count * world; out_elems = count * world; break;
-        case SC_GATHER: out_elems = count * world; break;
-        case SC_ALLGATHER: out_elems = count * world; break;
+        case SC_SCATTER: in_elems = count * cm.world; break;
+        case SC_REDUCE_SCATTER: in_elems = count * cm.world; break;
+        case SC_ALLTOALL: in_elems = count * cm.world; out_elems = count * cm.world; break;
+        case SC_GATHER: out_elems = count * cm.world; break;
+        case SC_ALLGATHER: out_elems = count * cm.world; break;
         default: break;
       }
       auto to_h = [](const float *src, std::vector<uint16_t> &dst, uint64_t n) {
@@ -1037,7 +1121,7 @@ struct accl_rt {
       if (c.c16_op0) inner.op0 = c.c16_op0->data();
       if (c.c16_op1) inner.op1 = c.c16_op1->data();
       if (c.c16_res) inner.res = c.c16_res->data();
-      uint32_t rc = execute_inner(inner);
+      uint32_t rc = execute_inner(inner, cm);
       // preserve ALL resumption state (current_step AND the armed
       // deadline) across NOT_READY requeues
       c.current_step = inner.current_step;
@@ -1049,7 +1133,7 @@ struct accl_rt {
       // the uncompressed path)
       uint32_t root = c.desc[3];
       bool owns_res =
-          !(scenario == SC_GATHER || scenario == SC_REDUCE) || root == rank;
+          !(scenario == SC_GATHER || scenario == SC_REDUCE) || root == cm.rank;
       if (c.res && rc == NO_ERROR && owns_res) {
         float *dst = (float *)c.res;
         for (uint64_t i = 0; i < out_elems; i++)
@@ -1058,17 +1142,17 @@ struct accl_rt {
       // bcast mutates op0 on receivers only: compression is wire-only, so
       // the root's full-precision source stays untouched (reference
       // semantics)
-      if (scenario == SC_BCAST && c.op0 && rc == NO_ERROR && root != rank) {
+      if (scenario == SC_BCAST && c.op0 && rc == NO_ERROR && root != cm.rank) {
         float *dst = (float *)c.op0;
         for (uint64_t i = 0; i < in_elems; i++)
           dst[i] = half_to_float((*c.c16_op0)[i]);
       }
       return rc;
     }
-    return execute_inner(c);
+    return execute_inner(c, cm);
   }
 
-  uint32_t execute_inner(Call &c) {
+  uint32_t execute_inner(Call &c, const CommView &cm) {
     uint32_t scenario = c.desc[0];
     uint64_t count = c.desc[1];
     uint32_t root = c.desc[3];
@@ -1079,6 +1163,15 @@ struct accl_rt {
     auto *op0 = (const uint8_t *)c.op0;
     auto *op1 = (const uint8_t *)c.op1;
     auto *res = (uint8_t *)c.res;
+    // rooted collectives: the root is communicator-relative and must
+    // exist, or the group hangs waiting on a root nobody is
+    switch (scenario) {
+      case SC_BCAST: case SC_SCATTER: case SC_GATHER: case SC_REDUCE:
+        if (root >= cm.world) return DMA_DECODE_ERROR;
+        break;
+      default:
+        break;
+    }
     switch (scenario) {
       case SC_NOP:
         return NO_ERROR;
@@ -1097,14 +1190,18 @@ struct accl_rt {
         return combine_buffers(c.dtype, func, res, op1, count);
       }
       case SC_SEND:
-        // root_src_dst is the destination rank (reference send semantics)
-        return p2p_send(root, op0, bytes, tag);
+        // root_src_dst is the destination rank, communicator-relative
+        // (reference send semantics)
+        if (root >= cm.world) return DMA_DECODE_ERROR;
+        return p2p_send(cm.g(root), op0, bytes, tag);
       case SC_RECV: {
+        if (root >= cm.world) return DMA_DECODE_ERROR;
+        uint32_t gsrc = cm.g(root);
         // root_src_dst is the source rank. The eager path is resumable:
         // current_step counts segments already landed, and a missing
         // segment parks the call on the retry queue instead of blocking
         // the sequencer (the firmware retry contract, .c:2336-2477).
-        if (is_rndzv(bytes)) return p2p_recv(root, res, bytes, tag);
+        if (is_rndzv(bytes)) return p2p_recv(gsrc, res, bytes, tag);
         if (!c.deadline_set) {
           c.deadline = std::chrono::steady_clock::now() +
                        std::chrono::milliseconds(timeout_ms);
@@ -1114,7 +1211,7 @@ struct accl_rt {
           uint64_t off = (uint64_t)c.current_step * rx_buf_bytes;
           if (off >= bytes && !(bytes == 0 && c.current_step == 0)) break;
           uint64_t got = 0;
-          uint32_t rc = egr_recv_seg(root, tag, res ? res + off : nullptr,
+          uint32_t rc = egr_recv_seg(gsrc, tag, res ? res + off : nullptr,
                                      bytes - off, &got);
           if (rc == NOT_READY) {
             if (std::chrono::steady_clock::now() > c.deadline)
@@ -1128,23 +1225,23 @@ struct accl_rt {
         return NO_ERROR;
       }
       case SC_BCAST:
-        return do_bcast((uint8_t *)op0, bytes, root, tag);
+        return do_bcast(cm, (uint8_t *)op0, bytes, root, tag);
       case SC_SCATTER:
-        return do_scatter(op0, res, bytes, root, tag);
+        return do_scatter(cm, op0, res, bytes, root, tag);
       case SC_GATHER:
-        return do_gather(op0, res, bytes, root, tag);
+        return do_gather(cm, op0, res, bytes, root, tag);
       case SC_ALLGATHER:
-        return do_allgather(op0, res, bytes, tag);
+        return do_allgather(cm, op0, res, bytes, tag);
       case SC_REDUCE:
-        return do_reduce(c.dtype, func, op0, res, count, root, tag);
+        return do_reduce(cm, c.dtype, func, op0, res, count, root, tag);
       case SC_ALLREDUCE:
-        return do_allreduce(c.dtype, func, op0, res, count, tag);
+        return do_allreduce(cm, c.dtype, func, op0, res, count, tag);
       case SC_REDUCE_SCATTER:
-        return do_reduce_scatter(c.dtype, func, op0, res, count, tag);
+        return do_reduce_scatter(cm, c.dtype, func, op0, res, count, tag);
       case SC_ALLTOALL:
-        return do_alltoall(op0, res, bytes, tag);
+        return do_alltoall(cm, op0, res, bytes, tag);
       case SC_BARRIER:
-        return do_barrier(tag);
+        return do_barrier(cm, tag);
       default:
         return COLLECTIVE_NOT_IMPLEMENTED;
     }

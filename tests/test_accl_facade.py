@@ -136,24 +136,61 @@ def test_smaller_count_than_buffer(accl):
 
 
 def test_split_communicator(accl, mesh8):
-    """Sub-communicator collectives stay independent per group (the
-    reference's multi-communicator suites)."""
+    """First-class communicators (reference: every collective takes a
+    communicator handle resolved from the descriptor's comm_addr,
+    ccl_offload_control.c:2317-2372): one ACCL, one set of buffers,
+    concurrent collectives on disjoint sub-groups."""
     lo = accl.split([0, 1, 2, 3])
     hi = accl.split([4, 5, 6, 7])
-    xlo = RNG.standard_normal((4, 32)).astype(np.float32)
-    xhi = RNG.standard_normal((4, 32)).astype(np.float32)
-    slo, rlo = lo.create_buffer(32, data=xlo), lo.create_buffer(32)
-    shi, rhi = hi.create_buffer(32, data=xhi), hi.create_buffer(32)
-    lo.allreduce(slo, rlo, 32, ReduceFunction.SUM)
-    hi.allreduce(shi, rhi, 32, ReduceFunction.SUM)
-    np.testing.assert_allclose(rlo.host, np.tile(xlo.sum(0), (4, 1)),
+    assert lo.exchmem_addr != 0 and hi.exchmem_addr != lo.exchmem_addr
+    x = RNG.standard_normal((WORLD, 32)).astype(np.float32)
+    sb = accl.create_buffer(32, data=x)
+    rb = accl.create_buffer(32)
+    r1 = accl.allreduce(sb, rb, 32, ReduceFunction.SUM, comm=lo,
+                        run_async=True)
+    r2 = accl.allreduce(sb, rb, 32, ReduceFunction.SUM, comm=hi,
+                        run_async=True)
+    accl.wait(r1)
+    accl.wait(r2)
+    np.testing.assert_allclose(rb.host[:4], np.tile(x[:4].sum(0), (4, 1)),
                                rtol=1e-5, atol=1e-5)
-    np.testing.assert_allclose(rhi.host, np.tile(xhi.sum(0), (4, 1)),
+    np.testing.assert_allclose(rb.host[4:], np.tile(x[4:].sum(0), (4, 1)),
                                rtol=1e-5, atol=1e-5)
     with pytest.raises(ValueError):
         accl.split([0, 0, 1])
     with pytest.raises(ValueError):
         accl.split([99])
+
+
+def test_split_subgroup_rooted_and_p2p(accl):
+    """Roots and src/dst ranks are communicator-relative; non-member rows
+    stay untouched (rank-local buffer semantics)."""
+    mid = accl.split([2, 5, 6])
+    x = RNG.standard_normal((WORLD, 16)).astype(np.float32)
+    b = accl.create_buffer(16, data=x)
+    accl.bcast(b, 16, root=1, comm=mid)  # comm rank 1 == global rank 5
+    exp = x.copy()
+    exp[[2, 6]] = x[5]
+    np.testing.assert_allclose(b.host, exp, rtol=1e-6)
+
+    sb = accl.create_buffer(16, data=x)
+    rb = accl.create_buffer(16)
+    accl.send(sb, 16, src=0, dst=2, tag=9, comm=mid)
+    accl.recv(rb, 16, src=0, dst=2, tag=9, comm=mid)
+    np.testing.assert_allclose(rb.host[6], x[2], rtol=1e-6)  # global rows
+    np.testing.assert_allclose(rb.host[0], 0)
+
+
+def test_split_gather_scatter_shapes(accl):
+    """Counted collectives scale with the communicator size, not the
+    device world."""
+    grp = accl.split([1, 3, 5, 7])
+    x = RNG.standard_normal((WORLD, 8)).astype(np.float32)
+    sb = accl.create_buffer(8, data=x)
+    gb = accl.create_buffer(8 * 4)
+    accl.gather(sb, gb, 8, root=0, comm=grp)  # root 0 == global 1
+    np.testing.assert_allclose(
+        gb.host[1], np.concatenate([x[1], x[3], x[5], x[7]]), rtol=1e-6)
 
 
 def test_host_only_buffers(accl):
@@ -185,9 +222,26 @@ def test_async_host_only_result_syncs(accl):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_split_inherits_arith_config(accl):
+def test_split_registers_and_persists(accl):
+    """split() registers the communicator on the same ACCL (no child
+    object), writes its table to exchange memory, and collectives reject
+    foreign communicators."""
+    from accl_tpu.communicator import Communicator
+
     sub = accl.split([0, 1])
-    assert sub.arith_config is accl.arith_config
+    assert sub in accl.communicators
+    assert "size=2" in accl.dump_communicator(accl.communicators.index(sub))
+    # round-trip the table straight out of device exchange memory
+    n = 2 + 2 * Communicator.WORDS_PER_RANK
+    words = [accl.cclo.read(sub.exchmem_addr + 4 * i) for i in range(n)]
+    rt = Communicator.from_exchmem_words(words)
+    assert [r.device_index for r in rt.ranks] == [0, 1]
+    # a communicator from a different ACCL is rejected
+    foreign = Communicator(sub.ranks, 0, sub.exchmem_addr)
+    x = RNG.standard_normal((WORLD, 8)).astype(np.float32)
+    sb, rb = accl.create_buffer(8, data=x), accl.create_buffer(8)
+    with pytest.raises(ValueError, match="does not belong"):
+        accl.allreduce(sb, rb, 8, ReduceFunction.SUM, comm=foreign)
 
 
 def test_send_recv_tag_any(accl):
