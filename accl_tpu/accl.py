@@ -161,8 +161,11 @@ class ACCL:
         if data is None:
             data = np.zeros((self.world, count), dtype)
         else:
-            data = np.asarray(data, dtype).reshape(self.world, count)
-        buf = TPUBuffer(data, self._sharding(), host_only=host_only)
+            # always copy: the buffer owns its memory (reference buffer
+            # semantics), and backends may update the host mirror in place
+            data = np.array(data, dtype).reshape(self.world, count)
+        buf_cls = getattr(self.cclo, "buffer_class", TPUBuffer)
+        buf = buf_cls(data, self._sharding(), host_only=host_only)
         self.cclo.register_buffer(buf)
         return buf
 
@@ -403,22 +406,23 @@ class ACCL:
         as `comm=` to any collective — no new ACCL, no new device, no new
         compile caches. Buffers stay full-world stacked arrays; a
         sub-communicator collective touches only its member rows."""
+        if not getattr(self.cclo, "supports_split", True):
+            raise NotImplementedError(
+                f"{type(self.cclo).__name__} does not support "
+                "sub-communicators yet")
         if len(set(rank_indices)) != len(rank_indices):
             raise ValueError("duplicate ranks in split")
         if not all(0 <= r < self.world for r in rank_indices):
             raise ValueError(f"split ranks outside world of {self.world}")
+        import dataclasses
+
         parent = self.communicators[0].ranks
         ranks = [
-            Rank(ip=parent[r].ip, port=parent[r].port,
-                 session_id=parent[r].session_id,
-                 max_segment_size=parent[r].max_segment_size,
-                 device_index=parent[r].device_index)
+            dataclasses.replace(parent[r], inbound_seq=0, outbound_seq=0)
             for r in rank_indices
         ]
         nwords = 2 + len(ranks) * Communicator.WORDS_PER_RANK
-        # the dynamic region ends where the register block begins (tuning
-        # registers, CFGRDY, RETCODE live at 0x1FC4-0x1FFC)
-        if self._exchmem_alloc + 4 * nwords > CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN:
+        if self._exchmem_alloc + 4 * nwords > CCLOAddr.DYNAMIC_END:
             raise MemoryError("exchange memory exhausted by communicators")
         comm = Communicator(ranks, 0, self._exchmem_alloc)
         self._exchmem_alloc += 4 * nwords

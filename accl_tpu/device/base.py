@@ -35,6 +35,9 @@ class CCLOAddr:
     # Start of the dynamically-laid-out region (communicators, arith
     # configs), after the rx-ring descriptor table.
     DYNAMIC_BASE = 0x200
+    # End of the dynamic region: the lowest-addressed register above
+    # (keep in sync when adding registers).
+    DYNAMIC_END = 0x1FC4
 
 
 # The hardware id this framework reports, with capability bits analogous

@@ -1,0 +1,236 @@
+"""DCNDevice: the multi-host backend — third interchangeable CCLODevice.
+
+Reference: the CoyoteDevice slot. The reference driver offers three
+backends behind one CCLO interface (driver/xrt/include/accl/cclo.hpp:85-89);
+CoyoteDevice's constructor brings up the RDMA queue pairs to every peer
+before any collective runs (driver/xrt/src/coyotedevice.cpp:38-220). The
+TPU analog of that bring-up is `jax.distributed.initialize`: one process
+per host joins a coordinator, after which `jax.devices()` is the global
+device list and compiled programs span hosts, with XLA routing intra-host
+traffic over ICI and cross-host traffic over DCN.
+
+Topology: a two-tier mesh (outer axis = processes/hosts over DCN, inner
+axis = local devices over ICI), global rank = process * local + device
+(process-major, so each process's buffer rows are contiguous). Collectives
+with a bandwidth-optimal two-tier decomposition (allreduce,
+reduce_scatter, allgather, bcast — sequencer/hierarchical.py) lower to it
+so the slow tier carries 1/inner_world of the traffic; everything else
+lowers flat over the combined (outer, inner) axis, which JAX treats as one
+named ring in process-major order.
+
+CPU test form (the reference's emulator posture): N processes x M virtual
+CPU devices on one host — same program structure, no TPU in the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..constants import Operation, ReduceFunction
+from ..sequencer.hierarchical import (
+    hierarchical_allgather_schedule,
+    hierarchical_allreduce_schedule,
+    hierarchical_bcast_schedule,
+    hierarchical_reduce_scatter_schedule,
+)
+from ..sequencer.lowering import ScheduleCompiler
+from ..buffers import TPUBuffer
+from .tpu_device import TPUDevice
+
+
+class DCNCompiler(ScheduleCompiler):
+    """Two-tier lowering over (outer, inner): hierarchical compositions
+    for the four ops that have one whenever both tiers are wider than 1,
+    flat combined-axis schedules otherwise. Outputs are adapted from the
+    compositions' inner-major chunk order to the device's process-major
+    rank numbering with local (on-device) transposes."""
+
+    HIER_OPS = frozenset(
+        {Operation.allreduce, Operation.reduce_scatter,
+         Operation.allgather, Operation.bcast}
+    )
+
+    def __init__(self, mesh, outer_axis: str, inner_axis: str,
+                 arith_table=None):
+        super().__init__(mesh, (outer_axis, inner_axis),
+                         arith_table=arith_table, use_pallas_ring=False)
+        self.outer_axis = outer_axis
+        self.inner_axis = inner_axis
+
+    @property
+    def world(self) -> int:
+        return self.mesh.shape[self.outer_axis] * self.mesh.shape[self.inner_axis]
+
+    def _build(self, options, plan, arithcfg):
+        P = self.mesh.shape[self.outer_axis]
+        L = self.mesh.shape[self.inner_axis]
+        op = options.scenario
+        if P == 1 or L == 1 or op not in self.HIER_OPS:
+            # flat over the combined axis: every schedule body takes the
+            # (outer, inner) tuple as its axis name; the combined index is
+            # process-major, matching the device's rank numbering
+            return super()._build(options, plan, arithcfg)
+
+        func = ReduceFunction(options.function) if op in (
+            Operation.allreduce, Operation.reduce_scatter) else None
+        wire = self._wire(options, arithcfg, func, False)
+        common = dict(inner_axis=self.inner_axis, outer_axis=self.outer_axis,
+                      inner_world=L, outer_world=P, wire=wire)
+
+        if op == Operation.allreduce:
+            body = functools.partial(
+                hierarchical_allreduce_schedule, func=func, **common)
+        elif op == Operation.bcast:
+            root = options.root_src_dst
+            body = functools.partial(
+                hierarchical_bcast_schedule,
+                root_outer=root // L, root_inner=root % L, **common)
+        elif op == Operation.allgather:
+            # composition output is inner-major (chunk j from device
+            # (p=j%P, l=j//P)); transpose locally to process-major
+            def body(x, *, _c=common, _P=P, _L=L):
+                raw = hierarchical_allgather_schedule(x, **_c)
+                c = raw.shape[-1] // (_P * _L)
+                return raw.reshape(_L, _P, c).transpose(1, 0, 2).reshape(-1)
+        else:  # reduce_scatter
+            # pre-permute the input's process-major chunks to the
+            # composition's inner-major layout so each device ends with
+            # its own (process-major) chunk
+            def body(x, *, _c=common, _f=func, _P=P, _L=L):
+                c = x.shape[-1] // (_P * _L)
+                xim = x.reshape(_P, _L, c).transpose(1, 0, 2).reshape(-1)
+                return hierarchical_reduce_scatter_schedule(
+                    xim, func=_f, **_c)
+
+        from jax.sharding import PartitionSpec
+
+        spec = PartitionSpec(self.axis_name)
+
+        def wrapped(x):
+            out = body(x.reshape(x.shape[-1]))
+            return out.reshape(1, out.shape[-1])
+
+        return jax.jit(
+            jax.shard_map(wrapped, mesh=self.mesh, in_specs=(spec,),
+                          out_specs=spec, check_vma=False)
+        )
+
+
+def _distributed_active() -> bool:
+    """True if jax.distributed is already initialized — checked WITHOUT
+    touching the backend (jax.process_count would initialise XLA and make
+    a later distributed.initialize impossible)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+class DCNBuffer(TPUBuffer):
+    """Multi-process stacked buffer: the device array is global, the host
+    mirror is authoritative only for rows on this process's devices
+    (remote rows are not addressable — the reference analog is each host
+    syncing only its own FPGA's DDR)."""
+
+    def sync_to_device(self):
+        # assemble from process-local rows: each process contributes the
+        # shards its devices own, so host mirrors may legitimately differ
+        # across processes on remote rows (jax.device_put's global
+        # equality check would wrongly reject that)
+        imap = self.sharding.addressable_devices_indices_map(self.shape)
+        shards = [jax.device_put(np.ascontiguousarray(self.host[idx]), d)
+                  for d, idx in imap.items()]
+        self.device = jax.make_array_from_single_device_arrays(
+            self.shape, self.sharding, shards)
+        return self
+
+    def sync_from_device(self):
+        if self.device is not None:
+            for s in self.device.addressable_shards:
+                self.host[s.index] = np.asarray(s.data)
+        return self
+
+
+class DCNDevice(TPUDevice):
+    """Multi-process/multi-host device backend over a (dcn, ici) mesh."""
+
+    supports_split = False  # sub-communicators over DCN: future round
+    buffer_class = DCNBuffer
+
+    def __init__(
+        self,
+        num_processes: int = 1,
+        process_id: int = 0,
+        coordinator_address: str | None = None,
+        local_device_count: int | None = None,
+        outer_axis: str = "dcn",
+        inner_axis: str = "ici",
+        platform: str | None = None,
+        mesh: Mesh | None = None,
+    ):
+        if mesh is None:
+            # bring-up (CoyoteDevice ctor analog): force the platform
+            # before any backend touch, then join the coordinator
+            if platform is not None:
+                try:
+                    jax.config.update("jax_platforms", platform)
+                    if local_device_count:
+                        jax.config.update("jax_num_cpu_devices",
+                                          local_device_count)
+                except Exception:
+                    pass  # backend already initialized
+            if num_processes > 1 and not _distributed_active():
+                if coordinator_address is None:
+                    raise ValueError(
+                        "multi-process DCNDevice needs a coordinator_address")
+                # must run before ANY backend-initialising jax call
+                # (jax.devices / device_put / process_count)
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            devs = sorted(jax.devices(),
+                          key=lambda d: (d.process_index, d.id))
+            n_proc = max(jax.process_count(), 1)
+            if len(devs) % n_proc:
+                raise ValueError(
+                    f"{len(devs)} devices not uniform over {n_proc} processes")
+            local = len(devs) // n_proc
+            mesh = Mesh(np.array(devs).reshape(n_proc, local),
+                        (outer_axis, inner_axis))
+        else:
+            outer_axis, inner_axis = mesh.axis_names
+        super().__init__(mesh, axis_name=(outer_axis, inner_axis))
+        self.outer_axis = outer_axis
+        self.inner_axis = inner_axis
+        self.compiler = DCNCompiler(mesh, outer_axis, inner_axis)
+
+    @property
+    def world(self) -> int:
+        return (self.mesh.shape[self.outer_axis]
+                * self.mesh.shape[self.inner_axis])
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    def local_rows(self) -> list[int]:
+        """Global rank rows whose buffers live on this process."""
+        flat = self.mesh.devices.reshape(-1)
+        me = jax.process_index()
+        return [i for i, d in enumerate(flat) if d.process_index == me]
+
+    def _comm_ctx(self, comm_addr: int):
+        ctx = super()._comm_ctx(comm_addr)
+        if ctx.rows is not None:
+            raise NotImplementedError(
+                "sub-communicators on the DCN backend are not supported yet; "
+                "use the default world communicator")
+        return ctx

@@ -57,6 +57,7 @@ class TPUDevice(CCLODevice):
         # the addressed communicator per call, ccl_offload_control.c:2317-2372)
         self._comm_cache: dict[int, "_CommCtx"] = {}
         self._comm_extents: dict[int, int] = {}  # comm_addr -> table end
+        self._group_cache: dict[tuple, "_CommCtx"] = {}  # members -> ctx
 
     # -- registry ---------------------------------------------------------
 
@@ -133,17 +134,22 @@ class TPUDevice(CCLODevice):
         if rows is None:
             ctx = _CommCtx(self.world, self.mesh, self.compiler, None)
         else:
-            from jax.sharding import Mesh
+            # identical member sets at different table addresses share one
+            # context, so re-splits reuse the compiled schedules
+            ctx = self._group_cache.get(rows)
+            if ctx is None:
+                from jax.sharding import Mesh
 
-            devices = self.mesh.devices.reshape(-1)
-            sub_mesh = Mesh(np.array([devices[r] for r in rows]),
-                            (self.axis_name,))
-            compiler = ScheduleCompiler(
-                sub_mesh, self.axis_name,
-                arith_table=self.compiler.arith_table,
-                use_pallas_ring=self.compiler.use_pallas_ring,
-            )
-            ctx = _CommCtx(len(rows), sub_mesh, compiler, rows)
+                devices = self.mesh.devices.reshape(-1)
+                sub_mesh = Mesh(np.array([devices[r] for r in rows]),
+                                (self.axis_name,))
+                compiler = ScheduleCompiler(
+                    sub_mesh, self.axis_name,
+                    arith_table=self.compiler.arith_table,
+                    use_pallas_ring=self.compiler.use_pallas_ring,
+                )
+                ctx = _CommCtx(len(rows), sub_mesh, compiler, rows)
+                self._group_cache[rows] = ctx
         self._comm_cache[comm_addr] = ctx
         if table_words:
             self._comm_extents[comm_addr] = comm_addr + 4 * table_words
@@ -374,6 +380,7 @@ class TPUDevice(CCLODevice):
             self.compiler._cache.clear()
             self._comm_cache.clear()
             self._comm_extents.clear()
+            self._group_cache.clear()
         elif fn == CfgFunc.enable_pkt:
             self.pkt_enabled = True
         elif fn == CfgFunc.set_timeout:
