@@ -27,7 +27,7 @@ from ..constants import (
     dtype_nbytes,
 )
 from ..descriptor import CallOptions
-from ..request import BaseRequest, TPURequest
+from ..request import BaseRequest, ParkedRecvRequest, TPURequest
 from ..sequencer.lowering import ScheduleCompiler
 from ..sequencer.plan import select_algorithm
 from .base import CCLOAddr, CCLODevice
@@ -46,8 +46,12 @@ class TPUDevice(CCLODevice):
         self.eager_rx_buf_size = DEFAULT_EAGER_RX_BUF_SIZE
         self.pkt_enabled = False
         # Pending sends awaiting their recv partner (single-controller
-        # pairing of the MPI-style send/recv API).
+        # pairing of the MPI-style send/recv API) and recvs parked until
+        # their send arrives (the firmware retry-queue contract,
+        # ccl_offload_control.c:2460-2479 — a recv with no matching
+        # message is requeued, not failed, until the timeout).
         self._pending_sends: dict[tuple, CallOptions] = {}
+        self._pending_recvs: dict[tuple, list[ParkedRecvRequest]] = {}
         # Kernel-stream endpoints (strm != 0 routing, SURVEY.md §3.4).
         from ..ops.streams import StreamRegistry
 
@@ -277,11 +281,46 @@ class TPUDevice(CCLODevice):
         queue plays per-rank in the reference (rxbuf_seek.cpp:20-79)."""
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
-        self._pending_sends[(options.comm_addr, src, dst, options.tag)] = options
+        # a parked recv waiting for this send fires immediately
+        parked = None
+        for key, queue in list(self._pending_recvs.items()):
+            ca, s, d, tag = key
+            if ca == options.comm_addr and s == src and d == dst and (
+                tag == options.tag or TAG_ANY in (tag, options.tag)
+            ):
+                while queue and parked is None:
+                    candidate = queue.pop(0)
+                    if candidate.claim():  # FIFO; skip already-timed-out
+                        parked = candidate
+                if not queue:
+                    self._pending_recvs.pop(key, None)
+                if parked is not None:
+                    break
+        if parked is not None:
+            parked.resolve(self._launch(self._pair(parked.options, options)))
+        else:
+            self._pending_sends[
+                (options.comm_addr, src, dst, options.tag)] = options
         req = BaseRequest("send")
         req.running()
         req.complete(0)
         return req
+
+    def _pair(self, recv_opts: CallOptions, send_opts: CallOptions) -> CallOptions:
+        src = recv_opts.root_src_dst & 0xFFFF
+        dst = (recv_opts.root_src_dst >> 16) & 0xFFFF
+        return CallOptions(
+            scenario=Operation.send,
+            count=recv_opts.count,
+            comm_addr=recv_opts.comm_addr,
+            root_src_dst=src | (dst << 16),
+            tag=send_opts.tag,
+            compression_flags=recv_opts.compression_flags,
+            stream_flags=recv_opts.stream_flags,
+            data_type=recv_opts.data_type,
+            addr_0=send_opts.addr_0,
+            addr_2=recv_opts.addr_2,
+        )
 
     def _match_recv(self, options: CallOptions) -> BaseRequest:
         src = options.root_src_dst & 0xFFFF
@@ -294,24 +333,27 @@ class TPUDevice(CCLODevice):
                 match = (ca, s, d, tag)
                 break
         if match is None:
-            req = BaseRequest("recv")
-            req.running()
-            req.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+            # park until the send arrives or the configured timeout lapses
+            # (reference: unmatched recvs ride the retry queue until
+            # HOUSEKEEP_TIMEOUT, ccl_offload_control.c:2460-2479)
+            req = ParkedRecvRequest(options, self.timeout / 1e6)
+            key = (options.comm_addr, src, dst, options.tag)
+            self._pending_recvs.setdefault(key, []).append(req)
+
+            def unpark(_key=key, _req=req):
+                queue = self._pending_recvs.get(_key)
+                if queue is not None:
+                    try:
+                        queue.remove(_req)  # by identity/equality of self
+                    except ValueError:
+                        pass
+                    if not queue:
+                        self._pending_recvs.pop(_key, None)
+
+            req._unpark = unpark
             return req
         send_opts = self._pending_sends.pop(match)
-        pair = CallOptions(
-            scenario=Operation.send,
-            count=options.count,
-            comm_addr=options.comm_addr,
-            root_src_dst=src | (dst << 16),
-            tag=match[3],
-            compression_flags=options.compression_flags,
-            stream_flags=options.stream_flags,
-            data_type=options.data_type,
-            addr_0=send_opts.addr_0,
-            addr_2=options.addr_2,
-        )
-        return self._launch(pair)
+        return self._launch(self._pair(options, send_opts))
 
     # -- kernel streams (stream_put flow, vadd_put analog) -----------------
 
@@ -377,6 +419,11 @@ class TPUDevice(CCLODevice):
         fn = CfgFunc(options.function)
         if fn == CfgFunc.reset_periph:
             self._pending_sends.clear()
+            for queue in list(self._pending_recvs.values()):
+                for parked in list(queue):
+                    if parked.claim():
+                        parked._timeout_fire()
+            self._pending_recvs.clear()
             self.compiler._cache.clear()
             self._comm_cache.clear()
             self._comm_extents.clear()

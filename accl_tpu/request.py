@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 
-from .constants import ACCLError, OperationStatus
+from .constants import ACCLError, ErrorCode, OperationStatus
 
 
 class BaseRequest:
@@ -66,7 +66,13 @@ class BaseRequest:
 
 
 class TPURequest(BaseRequest):
-    """Request whose completion is the readiness of jax output arrays."""
+    """Request whose completion is the readiness of jax output arrays.
+
+    On platforms where `block_until_ready` returns before execution
+    actually finishes (the tunneled axon TPU), completion falls back to a
+    data dependency: a one-element fetch from each output, which cannot
+    succeed before the producing program has run.
+    """
 
     def __init__(self, function_name: str, outputs, on_complete=None):
         super().__init__(function_name)
@@ -86,6 +92,9 @@ class TPURequest(BaseRequest):
         try:
             for o in self.outputs:
                 o.block_until_ready()
+            if _needs_fetch_probe():
+                for o in self.outputs:
+                    _fetch_probe(o)
             self.complete(0)
         except Exception:
             self.complete(-1)
@@ -101,6 +110,113 @@ class TPURequest(BaseRequest):
             self.wait()
             return True
         return False
+
+
+class ParkedRecvRequest(BaseRequest):
+    """A recv issued before its matching send: parks until the send
+    arrives (then mirrors the launched pair program) or the device's
+    configured timeout lapses (then completes with RECEIVE_TIMEOUT_ERROR).
+    The reference equivalent is the firmware retry queue re-running an
+    unmatched recv until HOUSEKEEP_TIMEOUT (ccl_offload_control.c:2460-2479).
+
+    The outcome is decided exactly once: pairing (the device thread) and
+    timeout (any waiter/test thread) race through `claim()`, so a send
+    arriving at the deadline can never be reported as a timeout after its
+    transfer ran, and vice versa."""
+
+    def __init__(self, options, timeout_s: float):
+        super().__init__("recv")
+        self.options = options
+        self.running()
+        self._deadline = time.monotonic() + timeout_s
+        self._inner: BaseRequest | None = None
+        self._paired = threading.Event()
+        self._claim_lock = threading.Lock()
+        self._claimed = False
+        self._unpark = lambda: None  # set by the device to drop the parking
+
+    def claim(self) -> bool:
+        """Atomically claim the right to decide this request's outcome."""
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def resolve(self, inner: BaseRequest):
+        """Called by the device (after a successful claim) when the
+        matching send arrives."""
+        self._inner = inner
+        self._paired.set()
+
+    def _timeout_fire(self) -> bool:
+        self._unpark()
+        self.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+        return True
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if self.status == OperationStatus.COMPLETED:
+            return True
+        caller_deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            now = time.monotonic()
+            if caller_deadline is not None and now >= caller_deadline:
+                return False
+            if self._paired.is_set():
+                remain = (None if caller_deadline is None
+                          else max(caller_deadline - time.monotonic(), 0))
+                if not self._inner.wait(remain):
+                    return False
+                self.complete(self._inner.retcode)
+                return True
+            if now >= self._deadline:
+                if self.claim():
+                    return self._timeout_fire()
+                # lost the race to a concurrent send: pairing in flight
+                self._paired.wait(0.05)
+                continue
+            limit = self._deadline - now
+            if caller_deadline is not None:
+                limit = min(limit, caller_deadline - now)
+            self._paired.wait(max(limit, 0))
+
+    def test(self) -> bool:
+        if self.status == OperationStatus.COMPLETED:
+            return True
+        if self._paired.is_set():
+            if self._inner.test():
+                self.complete(self._inner.retcode)
+                return True
+            return False
+        if time.monotonic() >= self._deadline and self.claim():
+            return self._timeout_fire()
+        return False
+
+
+_fetch_probe_needed: bool | None = None
+
+
+def _needs_fetch_probe() -> bool:
+    """True on platforms whose block_until_ready returns early (axon)."""
+    global _fetch_probe_needed
+    if _fetch_probe_needed is None:
+        try:
+            import jax
+
+            _fetch_probe_needed = jax.devices()[0].platform == "axon"
+        except Exception:
+            _fetch_probe_needed = False
+    return _fetch_probe_needed
+
+
+def _fetch_probe(o) -> None:
+    """Force real completion via a data dependency: fetch one element of
+    the first addressable shard (a few-byte transfer)."""
+    import numpy as np
+
+    shards = getattr(o, "addressable_shards", None)
+    data = shards[0].data if shards else o
+    np.asarray(data.ravel()[:1])
 
 
 def _is_ready(x) -> bool:

@@ -56,10 +56,51 @@ def test_send_recv_pairing(accl):
     np.testing.assert_allclose(rb.host[6], x[1], rtol=1e-6)
 
 
-def test_recv_without_send_raises(accl):
-    rb = accl.create_buffer(16)
-    with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
-        accl.recv(rb, 16, src=0, dst=3, tag=77)
+def test_recv_without_send_times_out(accl):
+    """An unmatched recv parks for the configured timeout before failing
+    (firmware retry-queue semantics, ccl_offload_control.c:2460-2479) —
+    it does not fail instantly."""
+    import time
+
+    accl.set_timeout(200_000)  # 0.2 s
+    try:
+        rb = accl.create_buffer(16)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+            accl.recv(rb, 16, src=0, dst=3, tag=77)
+        assert time.monotonic() - t0 >= 0.15
+    finally:
+        accl.set_timeout(1_000_000)
+
+
+def test_two_parked_recvs_same_signature(accl):
+    """Two parked recvs with an identical (src, dst, tag) signature pair
+    FIFO with two later sends — neither is orphaned."""
+    x = RNG.standard_normal((WORLD, 20)).astype(np.float32)
+    y = RNG.standard_normal((WORLD, 20)).astype(np.float32)
+    sx, sy = accl.create_buffer(20, data=x), accl.create_buffer(20, data=y)
+    r1, r2 = accl.create_buffer(20), accl.create_buffer(20)
+    q1 = accl.recv(r1, 20, src=0, dst=1, tag=42, run_async=True)
+    q2 = accl.recv(r2, 20, src=0, dst=1, tag=42, run_async=True)
+    accl.send(sx, 20, src=0, dst=1, tag=42)
+    accl.send(sy, 20, src=0, dst=1, tag=42)
+    accl.wait(q1)
+    accl.wait(q2)
+    np.testing.assert_allclose(r1.host[1], x[0], rtol=1e-6)
+    np.testing.assert_allclose(r2.host[1], y[0], rtol=1e-6)
+
+
+def test_recv_before_send_pairs(accl):
+    """recv issued BEFORE send succeeds once the send arrives within the
+    timeout (order-independence of the reference driver's p2p API)."""
+    x = RNG.standard_normal((WORLD, 48)).astype(np.float32)
+    sb = accl.create_buffer(48, data=x)
+    rb = accl.create_buffer(48)
+    req = accl.recv(rb, 48, src=2, dst=5, tag=11, run_async=True)
+    assert not req.test()  # parked, not failed
+    accl.send(sb, 48, src=2, dst=5, tag=11)
+    accl.wait(req)
+    np.testing.assert_allclose(rb.host[5], x[2], rtol=1e-6)
 
 
 def test_bcast_scatter_gather(accl):

@@ -112,10 +112,9 @@ def test_dcn_two_process_end_to_end():
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    # children force the CPU platform themselves before any backend touch,
+    # so a wedged TPU tunnel cannot hang them
     env = dict(os.environ, PYTHONPATH=str(REPO))
-    # strip the axon sitecustomize: a wedged TPU tunnel must not be able
-    # to hang the children (they force the CPU platform themselves anyway)
-    env["PYTHONPATH"] = str(REPO)
     procs = []
     logs = []
     for pid in range(2):
