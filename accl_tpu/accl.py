@@ -104,7 +104,9 @@ class ACCL:
         addr = CCLOAddr.DYNAMIC_BASE + 4 * (2 + world * Communicator.WORDS_PER_RANK)
         for key, ac in self.arith_config.items():
             ac.set_exchmem(addr)
-            addr += 4 * 8  # eight words per config row (arithconfig.hpp layout)
+            for i, w in enumerate(ac.exchmem_words()):
+                dev.write(addr + 4 * i, w)
+            addr += 4 * ac.WORDS_PER_ROW
         # dynamic exchange-memory allocator tail: later communicators
         # (split) are laid out from here
         self._exchmem_alloc = addr

@@ -45,6 +45,37 @@ class ArithConfig:
     def set_exchmem(self, address: int) -> None:
         object.__setattr__(self, "_exchmem_addr", address)
 
+    # Exchange-memory row layout: 8 words mirroring the reference write
+    # order (arithconfig.hpp:73-79 writes elem bytes, ratio, lanes,
+    # compressed-domain flag, then the per-function arith lanes): [unc
+    # bytes, cmp bytes, ratio_log, compressor, decompressor, is_compressed,
+    # lane_sum, lane_max].
+    WORDS_PER_ROW = 8
+
+    def exchmem_words(self) -> list[int]:
+        return [
+            self.uncompressed_elem_bytes,
+            self.compressed_elem_bytes,
+            self.elem_ratio_log,
+            self.compressor_lane,
+            self.decompressor_lane,
+            int(self.arith_is_compressed),
+            self.arith_lanes[0],
+            self.arith_lanes[1],
+        ]
+
+    @classmethod
+    def from_exchmem_words(cls, words: list[int]) -> "ArithConfig":
+        return cls(
+            uncompressed_elem_bytes=words[0],
+            compressed_elem_bytes=words[1],
+            elem_ratio_log=words[2],
+            compressor_lane=words[3],
+            decompressor_lane=words[4],
+            arith_is_compressed=bool(words[5]),
+            arith_lanes=(words[6], words[7]),
+        )
+
 
 # Kernel lane numbering (see accl_tpu/ops/reduce_ops.py):
 #   arith lanes 0-4: SUM for fp32, fp64, i32, i64, fp16  — reference

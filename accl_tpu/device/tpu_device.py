@@ -12,6 +12,8 @@ executes the collective for every rank in the communicator.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import jax
 
@@ -51,6 +53,9 @@ class TPUDevice(CCLODevice):
         # ccl_offload_control.c:2460-2479 — a recv with no matching
         # message is requeued, not failed, until the timeout).
         self._pending_sends: dict[tuple, CallOptions] = {}
+        # guarded by _recv_mu: mutated by the driver thread (park/pair)
+        # and by waiter threads firing timeouts (unpark)
+        self._recv_mu = threading.Lock()
         self._pending_recvs: dict[tuple, list[ParkedRecvRequest]] = {}
         # Kernel-stream endpoints (strm != 0 routing, SURVEY.md §3.4).
         from ..ops.streams import StreamRegistry
@@ -283,19 +288,20 @@ class TPUDevice(CCLODevice):
         dst = (options.root_src_dst >> 16) & 0xFFFF
         # a parked recv waiting for this send fires immediately
         parked = None
-        for key, queue in list(self._pending_recvs.items()):
-            ca, s, d, tag = key
-            if ca == options.comm_addr and s == src and d == dst and (
-                tag == options.tag or TAG_ANY in (tag, options.tag)
-            ):
-                while queue and parked is None:
-                    candidate = queue.pop(0)
-                    if candidate.claim():  # FIFO; skip already-timed-out
-                        parked = candidate
-                if not queue:
-                    self._pending_recvs.pop(key, None)
-                if parked is not None:
-                    break
+        with self._recv_mu:
+            for key, queue in list(self._pending_recvs.items()):
+                ca, s, d, tag = key
+                if ca == options.comm_addr and s == src and d == dst and (
+                    tag == options.tag or TAG_ANY in (tag, options.tag)
+                ):
+                    while queue and parked is None:
+                        candidate = queue.pop(0)
+                        if candidate.claim():  # FIFO; skip already-timed-out
+                            parked = candidate
+                    if not queue:
+                        self._pending_recvs.pop(key, None)
+                    if parked is not None:
+                        break
         if parked is not None:
             parked.resolve(self._launch(self._pair(parked.options, options)))
         else:
@@ -338,17 +344,19 @@ class TPUDevice(CCLODevice):
             # HOUSEKEEP_TIMEOUT, ccl_offload_control.c:2460-2479)
             req = ParkedRecvRequest(options, self.timeout / 1e6)
             key = (options.comm_addr, src, dst, options.tag)
-            self._pending_recvs.setdefault(key, []).append(req)
+            with self._recv_mu:
+                self._pending_recvs.setdefault(key, []).append(req)
 
             def unpark(_key=key, _req=req):
-                queue = self._pending_recvs.get(_key)
-                if queue is not None:
-                    try:
-                        queue.remove(_req)  # by identity/equality of self
-                    except ValueError:
-                        pass
-                    if not queue:
-                        self._pending_recvs.pop(_key, None)
+                with self._recv_mu:
+                    queue = self._pending_recvs.get(_key)
+                    if queue is not None:
+                        try:
+                            queue.remove(_req)  # by identity of self
+                        except ValueError:
+                            pass
+                        if not queue:
+                            self._pending_recvs.pop(_key, None)
 
             req._unpark = unpark
             return req
@@ -419,11 +427,13 @@ class TPUDevice(CCLODevice):
         fn = CfgFunc(options.function)
         if fn == CfgFunc.reset_periph:
             self._pending_sends.clear()
-            for queue in list(self._pending_recvs.values()):
-                for parked in list(queue):
+            with self._recv_mu:
+                queues = [q for q in self._pending_recvs.values()]
+                self._pending_recvs.clear()
+            for queue in queues:
+                for parked in queue:
                     if parked.claim():
                         parked._timeout_fire()
-            self._pending_recvs.clear()
             self.compiler._cache.clear()
             self._comm_cache.clear()
             self._comm_extents.clear()

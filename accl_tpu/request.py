@@ -159,6 +159,9 @@ class ParkedRecvRequest(BaseRequest):
             return True
         caller_deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            # another thread (test(), reset) may decide the outcome
+            if self.status == OperationStatus.COMPLETED:
+                return True
             now = time.monotonic()
             if caller_deadline is not None and now >= caller_deadline:
                 return False
@@ -172,7 +175,9 @@ class ParkedRecvRequest(BaseRequest):
             if now >= self._deadline:
                 if self.claim():
                     return self._timeout_fire()
-                # lost the race to a concurrent send: pairing in flight
+                # outcome claimed elsewhere: either a concurrent send is
+                # pairing (resolve sets _paired) or another thread fired
+                # the timeout (sets COMPLETED) — poll for whichever
                 self._paired.wait(0.05)
                 continue
             limit = self._deadline - now

@@ -46,6 +46,8 @@ enum Err : uint32_t {
   COLLECTIVE_NOT_IMPLEMENTED = 1u << 14,
   DMA_SIZE_ERROR = 1u << 18,
   ARITH_ERROR = 1u << 19,
+  PACK_SEQ_NUMBER_ERROR = 1u << 21,
+  DMA_TAG_MISMATCH_ERROR = 1u << 26,
   NOT_READY = 0x80000000u,  // internal: requeue with current_step saved
 };
 
@@ -135,7 +137,19 @@ static inline uint16_t float_to_half(float f) {
   if (exp8 == 0xFF)  // inf / NaN propagate
     return (uint16_t)(sign | 0x7C00 | (man ? 0x200 : 0));
   int32_t exp = (int32_t)exp8 - 127 + 15;
-  if (exp <= 0) return (uint16_t)sign;             // flush to zero
+  if (exp <= 0) {
+    // subnormal fp16 (matches IEEE/ml_dtypes/XLA, not flush-to-zero):
+    // shift the full 24-bit significand right with round-to-nearest-even
+    if (exp < -10) return (uint16_t)sign;  // underflows even subnormals
+    uint32_t sig = man | 0x800000;         // implicit leading 1
+    uint32_t shift = (uint32_t)(14 - exp); // 14..24
+    uint32_t kept = sig >> shift;
+    uint32_t rem = sig & ((1u << shift) - 1);
+    uint32_t half_pt = 1u << (shift - 1);
+    if (rem > half_pt || (rem == half_pt && (kept & 1)))
+      kept++;  // may carry into the normal range (exp field 1) — still valid
+    return (uint16_t)(sign | kept);
+  }
   if (exp >= 31) return (uint16_t)(sign | 0x7C00); // overflow to inf
   // round to nearest even: add 0xFFF + the lsb of the kept mantissa
   uint32_t rounded = man + 0xFFF + ((man >> 13) & 1);
@@ -323,6 +337,11 @@ struct accl_rt {
   // rendezvous pending queues (CMD/STS_RNDZV(_PENDING) analog)
   std::deque<RndzvAddr> addr_q;
   std::deque<RndzvDone> done_q;
+  // addresses this rank has posted via rendezvous_send_addr, keyed by
+  // vaddr with the peer allowed to write them: the ONLY targets a
+  // MSG_RNDZV_WRITE may land on (anything else is an arbitrary-write
+  // attempt and is dropped)
+  std::deque<RndzvAddr> posted_addrs;  // src = the peer we posted to
   std::mutex rndzv_mu;
   std::condition_variable rndzv_cv;
 
@@ -489,8 +508,31 @@ struct accl_rt {
           break;
         }
         case MSG_RNDZV_WRITE: {
-          // one-sided write: land payload at the receiver-registered vaddr,
-          // then surface the local completion (RNDZVS_WR_DONE analog).
+          // one-sided write: valid ONLY into an address this rank posted
+          // to exactly this peer with exactly this size — otherwise any
+          // connected peer would hold an arbitrary-write primitive into
+          // the process. Unposted writes are dropped (and logged).
+          bool posted = false;
+          {
+            std::lock_guard<std::mutex> g(rndzv_mu);
+            for (auto it = posted_addrs.begin(); it != posted_addrs.end();
+                 ++it) {
+              if (it->vaddr == h.vaddr && it->src == h.src &&
+                  it->bytes == h.bytes) {
+                posted_addrs.erase(it);
+                posted = true;
+                break;
+              }
+            }
+          }
+          if (!posted) {
+            fprintf(stderr,
+                    "[r%u] DROP unposted RNDZV_WRITE from r%u vaddr=%llx "
+                    "bytes=%llu\n",
+                    rank, h.src, (unsigned long long)h.vaddr,
+                    (unsigned long long)h.bytes);
+            break;
+          }
           std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
           std::lock_guard<std::mutex> g(rndzv_mu);
           done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
@@ -520,22 +562,42 @@ struct accl_rt {
   // Seek one segment matching (src, tag, expected seqn) with rx_mu HELD;
   // copy out (clamped to `cap`) + release (rxbuf_seek semantics). Returns
   // NOT_READY when absent, DMA_SIZE_ERROR on an oversized segment.
+  //
+  // Ordering faults are detected instead of wedging the link (reference
+  // seqn-mismatch detection, dma_mover.cpp:342-352):
+  //  - a slot from src whose seqn is out of order while the expected seqn
+  //    is absent can never legally occur on the ordered per-link
+  //    transport -> PACK_SEQ_NUMBER_ERROR;
+  //  - `strict_tag`: an exact-tag mismatch AT the expected seqn is a
+  //    protocol violation inside a collective (the head segment can never
+  //    match) -> DMA_TAG_MISMATCH_ERROR. The non-strict SC_RECV retry
+  //    path keeps NOT_READY there, because another parked recv with the
+  //    matching tag may legally consume the head first.
   uint32_t seek_locked(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
-                       uint64_t *got) {
+                       uint64_t *got, bool strict_tag = false) {
     uint32_t want = inbound_seq[src];
+    bool head_tag_mismatch = false, stray_seqn = false;
     for (auto &s : rx_slots) {
-      if (s.status == RxSlot::VALID && s.src == src && s.seqn == want &&
-          (tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY)) {
-        if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
-        *got = s.data.size();
-        if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
-        s.status = RxSlot::IDLE;
-        s.data.clear();
-        inbound_seq[src] = want + 1;
-        rx_cv.notify_all();
-        return NO_ERROR;
+      if (s.status != RxSlot::VALID || s.src != src) continue;
+      if (s.seqn == want) {
+        if (tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY) {
+          if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
+          *got = s.data.size();
+          if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
+          s.status = RxSlot::IDLE;
+          s.data.clear();
+          inbound_seq[src] = want + 1;
+          rx_cv.notify_all();
+          return NO_ERROR;
+        }
+        head_tag_mismatch = true;
+      } else {
+        stray_seqn = true;
       }
     }
+    if (head_tag_mismatch)
+      return strict_tag ? DMA_TAG_MISMATCH_ERROR : NOT_READY;
+    if (stray_seqn) return PACK_SEQ_NUMBER_ERROR;
     return NOT_READY;
   }
 
@@ -555,13 +617,13 @@ struct accl_rt {
     std::unique_lock<std::mutex> lk(rx_mu);
     while (off < bytes || bytes == 0) {
       uint64_t got = 0;
-      uint32_t rc =
-          seek_locked(src, tag, ptr ? ptr + off : nullptr, bytes - off, &got);
+      uint32_t rc = seek_locked(src, tag, ptr ? ptr + off : nullptr,
+                                bytes - off, &got, /*strict_tag=*/true);
       if (rc == NOT_READY) {
         if (rx_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
           // final re-check before declaring a timeout
           rc = seek_locked(src, tag, ptr ? ptr + off : nullptr, bytes - off,
-                           &got);
+                           &got, /*strict_tag=*/true);
           if (rc == NO_ERROR) {
             off += got;
             if (bytes == 0) break;
@@ -585,6 +647,11 @@ struct accl_rt {
 
   void rendezvous_send_addr(uint32_t dst, uint64_t vaddr, uint64_t bytes,
                             uint32_t tag, uint32_t host = 0) {
+    {
+      // register the posting BEFORE the peer can possibly write it
+      std::lock_guard<std::mutex> g(rndzv_mu);
+      posted_addrs.push_back({dst, vaddr, bytes, tag, host});
+    }
     frame_out(dst, MSG_RNDZV_ADDR, tag, 0, bytes, vaddr, nullptr, 0, host);
   }
 
@@ -639,6 +706,20 @@ struct accl_rt {
                : RECEIVE_TIMEOUT_ERROR;
   }
 
+  // Drop postings matching the filter (src == UINT32_MAX matches any peer):
+  // called with rndzv_mu HELD when a completion wait times out, so a late
+  // write cannot land in a buffer the caller is about to free.
+  void revoke_posted_locked(uint32_t src, uint64_t bytes, uint32_t tag) {
+    for (auto it = posted_addrs.begin(); it != posted_addrs.end();) {
+      if ((src == UINT32_MAX || it->src == src) && it->bytes == bytes &&
+          (tag == TAG_ANY || it->tag == tag)) {
+        it = posted_addrs.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
   uint32_t rendezvous_get_completion(uint32_t src, uint64_t vaddr,
                                      uint64_t bytes, uint32_t tag) {
     auto deadline =
@@ -656,6 +737,7 @@ struct accl_rt {
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_completion timeout src=%u bytes=%llu done_q=%zu\n",
                   rank, src, (unsigned long long)bytes, done_q.size());
+        revoke_posted_locked(src, bytes, tag);
         return RECEIVE_TIMEOUT_ERROR;
       }
     }
@@ -679,6 +761,7 @@ struct accl_rt {
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_any_completion timeout bytes=%llu\n", rank,
                   (unsigned long long)bytes);
+        revoke_posted_locked(UINT32_MAX, bytes, tag);
         return RECEIVE_TIMEOUT_ERROR;
       }
     }
@@ -789,6 +872,53 @@ struct accl_rt {
           err |= egr_recv(prv, tag, tmp.data(), bytes);
           err |= egr_send(nxt, tmp.data(), bytes, tag);
         }
+      }
+      return err;
+    }
+    // fan-in cap (accl.cpp:1200-1201 via the tuning registers, same rule
+    // as plan.py gather selection): above the count threshold the flat
+    // tree becomes a binomial combining tree. Any cap value below
+    // world-1 selects the radix-2 binomial on BOTH executors (the XLA
+    // gather_flat_schedule makes the identical binary choice), so the
+    // register is a threshold switch, not a radix.
+    uint32_t fanin = bytes > tuning(GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
+                         ? std::max(tuning(GATHER_FLAT_TREE_MAX_FANIN, 2), 1u)
+                         : cm.world - 1;
+    if (fanin < cm.world - 1) {
+      // binomial: normalized rank l accumulates subtree chunks
+      // [l, l + 2^k); children with l % 2d == d relay their block to
+      // l - d chunk-by-chunk, so per-message size never exceeds what the
+      // flat tree would send (the rendezvous ceiling applies per chunk).
+      uint32_t l = (cm.rank + cm.world - root) % cm.world;
+      std::vector<uint8_t> acc((uint64_t)cm.world * bytes);
+      std::memcpy(acc.data() + (uint64_t)l * bytes, src, bytes);
+      uint32_t have = 1;  // chunks accumulated at [l, l + have)
+      for (uint32_t d = 1; d < cm.world; d <<= 1) {
+        if (l % (2 * d) == d) {
+          uint32_t parent = (l - d + root) % cm.world;
+          for (uint32_t c = 0; c < have && err == NO_ERROR; c++)
+            err |= p2p_send(cm.g(parent),
+                            acc.data() + (uint64_t)(l + c) * bytes, bytes,
+                            tag);
+          return err;  // subtree delivered
+        }
+        if (l % (2 * d) == 0 && l + d < cm.world) {
+          uint32_t child = (l + d + root) % cm.world;
+          uint32_t n_ch = std::min(d, cm.world - (l + d));
+          for (uint32_t c = 0; c < n_ch; c++) {
+            err |= p2p_recv(cm.g(child),
+                            acc.data() + (uint64_t)(l + d + c) * bytes, bytes,
+                            tag);
+            if (err) return err;
+          }
+          have += n_ch;
+        }
+      }
+      // root (l == 0) de-normalizes chunk order into dst
+      for (uint32_t ln = 0; ln < cm.world; ln++) {
+        uint32_t g = (ln + root) % cm.world;
+        std::memcpy(dst + (uint64_t)g * bytes,
+                    acc.data() + (uint64_t)ln * bytes, bytes);
       }
       return err;
     }

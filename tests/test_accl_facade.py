@@ -26,6 +26,20 @@ def test_initialize_writes_exchange_memory(accl):
         accl.initialize()  # double-config guard (accl.cpp:1074)
 
 
+def test_initialize_writes_arith_config_rows(accl):
+    """Every arithmetic config row is written to exchange memory at its
+    assigned address and round-trips (configure_arithmetic,
+    accl.cpp:1116-1125) — the dump shows the words, not just addresses."""
+    from accl_tpu.arithconfig import ArithConfig
+
+    for key, ac in accl.arith_config.items():
+        words = [accl.cclo.read(ac.addr() + 4 * i)
+                 for i in range(ArithConfig.WORDS_PER_ROW)]
+        rt = ArithConfig.from_exchmem_words(words)
+        assert rt == ac, f"arith row {key} did not round-trip"
+        assert f"{ac.addr():#06x}" in accl.dump_exchange_memory()
+
+
 def test_allreduce_end_to_end(accl):
     x = RNG.standard_normal((WORLD, 500)).astype(np.float32)
     sb = accl.create_buffer(500, data=x)

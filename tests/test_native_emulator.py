@@ -516,3 +516,107 @@ def test_emu_non_member_comm_rejected(world4):
         return True
 
     assert world4.run(body)[2] is True
+
+
+def test_emu_gather_binomial_fanin_cap():
+    """Rendezvous gather honors GATHER_FLAT_TREE_MAX_FANIN: above the
+    count threshold the flat tree becomes a binomial combining tree —
+    the same selection plan.py makes for the XLA path (cross-validated
+    here), reference tuning accl.cpp:1200-1201."""
+    from accl_tpu.constants import Operation, TuningParams
+    from accl_tpu.device.base import CCLOAddr
+    from accl_tpu.sequencer.plan import Algorithm, select_algorithm
+
+    threshold = 2048
+    count = 1024  # 4 KB > threshold and > max_eager -> rendezvous binomial
+    # the shared selection rule picks the capped flat tree (binomial)
+    tuning = TuningParams(gather_flat_tree_max_count=threshold)
+    plan = select_algorithm(Operation.gather, count, 4, 4,
+                            max_eager_size=1024, eager_rx_buf_size=1024,
+                            tuning=tuning)
+    assert plan.algorithm == Algorithm.RNDZV_FLAT_TREE
+    assert plan.tree_fanin < 3  # capped -> binomial branch on both executors
+
+    w = EmuWorld(4)
+    try:
+        x = RNG.standard_normal((4, count)).astype(np.float32)
+        for root in (0, 2):
+            def body(rank, i, _root=root):
+                rank.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, threshold)
+                send = x[i].copy()
+                out = np.zeros(4 * count, np.float32)
+                rank.gather(send, out, count, _root)
+                return out
+            res = w.run(body)
+            np.testing.assert_allclose(res[root], x.reshape(-1), rtol=0,
+                                       err_msg=f"binomial gather root={root}")
+    finally:
+        w.close()
+
+
+def test_emu_collective_tag_mismatch_fails_fast():
+    """A stray eager segment with a non-matching exact tag at the head of
+    the link surfaces DMA_TAG_MISMATCH_ERROR inside a collective instead
+    of wedging the link until RECEIVE_TIMEOUT (head-of-line detection)."""
+    import time
+
+    from accl_tpu.constants import Operation
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu import DataType
+
+    w = EmuWorld(2)
+    try:
+        def body(rank, i):
+            if i == 0:
+                # stray message tag 9 that nobody will ever recv
+                rank.send(np.ones(8, np.float32), 8, dst=1, tag=9)
+                # then a tagged bcast: root only sends -> succeeds
+                rank.bcast(np.ones(16, np.float32), 16, root=0)
+                return None
+            # rank 1's bcast recv (exact tag 5) meets the stray tag-9 head
+            opts = CallOptions(scenario=Operation.bcast, count=16,
+                               root_src_dst=0, tag=5,
+                               data_type=DataType.float32)
+            t0 = time.monotonic()
+            with pytest.raises(ACCLError, match="DMA_TAG_MISMATCH"):
+                rank.call(opts, op0=np.zeros(16, np.float32))
+            return time.monotonic() - t0
+
+        res = w.run(body)
+        assert res[1] < 2.0, f"should fail fast, took {res[1]:.1f}s"
+    finally:
+        w.close()
+
+
+def test_emu_fp16_subnormal_wire_parity():
+    """Compressed-domain (fp32->fp16 wire) collectives preserve fp16
+    subnormals like ml_dtypes/XLA — no flush-to-zero divergence between
+    the native and JAX executors (IEEE fp16 subnormal encoding)."""
+    from accl_tpu.constants import CompressionFlags, Operation
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu import DataType
+
+    w = EmuWorld(2)
+    try:
+        # values deep in the fp16 subnormal range (min normal ~6.1e-5)
+        x = np.array([[3e-6, -2.5e-6, 5.96e-8, 1e-7, 4.8e-5, 0.25, -7e-6, 1e-3],
+                      [1e-6, 2.5e-6, 5.96e-8, -1e-7, 3.1e-5, 0.5, 7e-6, 2e-3]],
+                     np.float32)
+
+        def body(rank, i):
+            opts = CallOptions(
+                scenario=Operation.allreduce, count=8, function=0,
+                compression_flags=CompressionFlags.ETH_COMPRESSED,
+                data_type=DataType.float32)
+            out = np.zeros(8, np.float32)
+            rank.call(opts, op0=x[i].copy(), res=out)
+            return out
+
+        res = w.run(body)
+        expected = (x[0].astype(np.float16) + x[1].astype(np.float16)
+                    ).astype(np.float32)
+        for r in range(2):
+            np.testing.assert_allclose(res[r], expected, rtol=1e-3, atol=6e-8,
+                                       err_msg="fp16 subnormal parity")
+    finally:
+        w.close()
