@@ -330,11 +330,35 @@ class ACCL:
                              compress_dtype=compress_dtype, comm=comm)
         return self._execute(opts, [], [dstbuf], True, to_device, run_async)
 
+    def _stream_opts(self, opts, op0_stream, res_stream):
+        """Arm OP0_STREAM/RES_STREAM on a prepared descriptor (reference:
+        streams route through any collective, ccl_offload_control.c:628-636).
+        Stream ids ride the tag: low byte producer, second byte consumer."""
+        if op0_stream is None and res_stream is None:
+            return opts
+        if not hasattr(self.cclo, "streams"):
+            raise NotImplementedError(
+                f"{type(self.cclo).__name__} does not support streamed "
+                "collectives")
+        flags = StreamFlags.NO_STREAM
+        tag = 0
+        if op0_stream is not None:
+            flags |= StreamFlags.OP0_STREAM
+            tag |= int(op0_stream) & 0xFF
+        if res_stream is not None:
+            flags |= StreamFlags.RES_STREAM
+            tag |= (int(res_stream) & 0xFF) << 8
+        opts.stream_flags = flags
+        opts.tag = tag
+        return opts
+
     def bcast(self, buf, count, root, *, from_device=False, to_device=False,
-              run_async=False, compress_dtype=None, comm=None):
+              run_async=False, compress_dtype=None, comm=None,
+              op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.bcast, buf, None, buf, count,
                              root_src_dst=root, compress_dtype=compress_dtype,
                              comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [buf], [buf], from_device, to_device,
                              run_async)
 
@@ -375,10 +399,12 @@ class ACCL:
 
     def allreduce(self, sendbuf, recvbuf, count, function, *,
                   from_device=False, to_device=False, run_async=False,
-                  compress_dtype=None, comm=None):
+                  compress_dtype=None, comm=None,
+                  op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.allreduce, sendbuf, None, recvbuf,
                              count, function=int(function),
                              compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 

@@ -234,7 +234,23 @@ class TPUDevice(CCLODevice):
             eager_rx_buf_size=self.eager_rx_buf_size,
             tuning=self.tuning(),
         )
-        fn = ctx.compiler.lower(options, plan)
+        if options.stream_flags and options.scenario not in (
+            Operation.send, Operation.recv,
+        ):
+            # streamed collective: stream ids ride the tag (low byte op0
+            # producer, second byte res consumer — the strm-in-tag
+            # convention stream_put already uses, dma_mover.cpp:497)
+            from ..constants import StreamFlags
+
+            producer = consumer = None
+            if options.stream_flags & StreamFlags.OP0_STREAM:
+                producer = self.streams.producer(options.tag & 0xFF)
+            if options.stream_flags & StreamFlags.RES_STREAM:
+                consumer = self.streams.consumer((options.tag >> 8) & 0xFF,
+                                                 strict=True)
+            fn = ctx.compiler.lower_streamed(options, plan, producer, consumer)
+        else:
+            fn = ctx.compiler.lower(options, plan)
 
         op0 = self._buf(options.addr_0)
         op1 = self._buf(options.addr_1)

@@ -45,8 +45,18 @@ class StreamRegistry:
         except KeyError:
             raise KeyError(f"no producer registered on stream {stream_id}") from None
 
-    def consumer(self, stream_id: int) -> Callable:
-        return self._consumers.get(stream_id, lambda x: x)
+    def consumer(self, stream_id: int, strict: bool = False) -> Callable:
+        """strict=True (an explicitly requested RES_STREAM) raises on an
+        unregistered id instead of silently passing data through; the
+        non-strict fallback is one shared identity so compile caches keyed
+        on the endpoint object stay stable."""
+        if strict and stream_id not in self._consumers:
+            raise KeyError(f"no consumer registered on stream {stream_id}")
+        return self._consumers.get(stream_id, _IDENTITY)
+
+
+def _IDENTITY(x):
+    return x
 
 
 def splice_producer(body, producer, n_expected):

@@ -116,6 +116,60 @@ class ScheduleCompiler:
     # -- construction -----------------------------------------------------
 
     def _build(self, options: CallOptions, plan: Plan, arithcfg) -> Callable:
+        body, n_in = self._body(options, plan, arithcfg)
+        return self._finalize(body, n_in)
+
+    def _finalize(self, body, n_in: int) -> Callable:
+        spec = PartitionSpec(self.axis_name)
+        # vma checking is disabled because the pallas-lowered bodies carry
+        # explicit vma annotations the checker cannot yet propagate through.
+        shmapped = jax.shard_map(
+            _squeeze_wrap(body, n_in),
+            mesh=self.mesh,
+            in_specs=(spec,) * n_in,
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(shmapped)
+
+    def lower_streamed(
+        self,
+        options: CallOptions,
+        plan: Plan,
+        producer: Callable | None = None,
+        consumer: Callable | None = None,
+    ) -> Callable:
+        """Streamed-operand collective (reference OP0_STREAM/RES_STREAM
+        routing through any collective, ccl_offload_control.c:628-636 and
+        the depacketizer's strm!=0 kernel-stream path,
+        tcp_depacketizer.cpp:106-117): the operand comes from a traced
+        on-device producer and/or the result is routed through a traced
+        consumer, fused into the same compiled program."""
+        from ..ops.streams import splice_consumer, splice_producer
+
+        arithcfg = None
+        if options.data_type != DataType.none:
+            arithcfg = _arithcfg_for(self.arith_table, options)
+        # the endpoint callables themselves are part of the key: holding a
+        # strong reference prevents id-reuse after GC from resurrecting a
+        # stale compiled program when an endpoint is re-registered
+        key = (options.signature(), plan, self.axis_name, "streamed",
+               producer, consumer)
+        fn = self._cache.get(key)
+        if fn is None:
+            body, n_in = self._body(options, plan, arithcfg)
+            if producer is not None:
+                if n_in != 1:
+                    raise ValueError(
+                        f"OP0_STREAM unsupported for {options.scenario.name}")
+                body = splice_producer(body, producer, options.count)
+            if consumer is not None:
+                body = splice_consumer(body, consumer)
+            fn = self._finalize(body, n_in)
+            self._cache[key] = fn
+        return fn
+
+    def _body(self, options: CallOptions, plan: Plan, arithcfg):
         axis, world = self.axis_name, self.world
         op = options.scenario
         root = options.root_src_dst
@@ -290,17 +344,7 @@ class ScheduleCompiler:
                 out = _inner(*(a.astype(_wd) for a in args))
                 return out.astype(orig)
 
-        spec = PartitionSpec(self.axis_name)
-        # vma checking is disabled because the pallas-lowered bodies carry
-        # explicit vma annotations the checker cannot yet propagate through.
-        shmapped = jax.shard_map(
-            _squeeze_wrap(body, n_in),
-            mesh=self.mesh,
-            in_specs=(spec,) * n_in,
-            out_specs=spec,
-            check_vma=False,
-        )
-        return jax.jit(shmapped)
+        return body, n_in
 
     def _reduce_body(self, stage_plan: Plan, root: int, func, common):
         """The reduce stage of a composed collective, shaped by its

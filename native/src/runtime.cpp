@@ -512,6 +512,9 @@ struct accl_rt {
           // to exactly this peer with exactly this size — otherwise any
           // connected peer would hold an arbitrary-write primitive into
           // the process. Unposted writes are dropped (and logged).
+          // validate + land + complete in ONE critical section: a
+          // completion timeout cannot slip between the posted-check and
+          // the memcpy and free the target buffer underneath the write
           bool posted = false;
           {
             std::lock_guard<std::mutex> g(rndzv_mu);
@@ -524,19 +527,18 @@ struct accl_rt {
                 break;
               }
             }
+            if (posted) {
+              std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
+              done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+              rndzv_cv.notify_all();
+            }
           }
-          if (!posted) {
+          if (!posted)
             fprintf(stderr,
                     "[r%u] DROP unposted RNDZV_WRITE from r%u vaddr=%llx "
                     "bytes=%llu\n",
                     rank, h.src, (unsigned long long)h.vaddr,
                     (unsigned long long)h.bytes);
-            break;
-          }
-          std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
-          std::lock_guard<std::mutex> g(rndzv_mu);
-          done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
-          rndzv_cv.notify_all();
           break;
         }
       }
@@ -706,14 +708,19 @@ struct accl_rt {
                : RECEIVE_TIMEOUT_ERROR;
   }
 
-  // Drop postings matching the filter (src == UINT32_MAX matches any peer):
-  // called with rndzv_mu HELD when a completion wait times out, so a late
-  // write cannot land in a buffer the caller is about to free.
-  void revoke_posted_locked(uint32_t src, uint64_t bytes, uint32_t tag) {
+  // Drop postings matching the filter (src == UINT32_MAX matches any
+  // peer, vaddr == 0 matches any address): called with rndzv_mu HELD when
+  // a completion wait times out, so a late write cannot land in a buffer
+  // the caller is about to free. An exact (src, vaddr) filter erases at
+  // most one entry so other in-flight recvs keep their postings.
+  void revoke_posted_locked(uint32_t src, uint64_t vaddr, uint64_t bytes,
+                            uint32_t tag) {
     for (auto it = posted_addrs.begin(); it != posted_addrs.end();) {
-      if ((src == UINT32_MAX || it->src == src) && it->bytes == bytes &&
+      if ((src == UINT32_MAX || it->src == src) &&
+          (vaddr == 0 || it->vaddr == vaddr) && it->bytes == bytes &&
           (tag == TAG_ANY || it->tag == tag)) {
         it = posted_addrs.erase(it);
+        if (vaddr != 0) return;  // exact posting: done
       } else {
         ++it;
       }
@@ -737,7 +744,7 @@ struct accl_rt {
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_completion timeout src=%u bytes=%llu done_q=%zu\n",
                   rank, src, (unsigned long long)bytes, done_q.size());
-        revoke_posted_locked(src, bytes, tag);
+        revoke_posted_locked(src, vaddr, bytes, tag);
         return RECEIVE_TIMEOUT_ERROR;
       }
     }
@@ -761,7 +768,7 @@ struct accl_rt {
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_any_completion timeout bytes=%llu\n", rank,
                   (unsigned long long)bytes);
-        revoke_posted_locked(UINT32_MAX, bytes, tag);
+        revoke_posted_locked(UINT32_MAX, 0, bytes, tag);
         return RECEIVE_TIMEOUT_ERROR;
       }
     }
@@ -889,17 +896,20 @@ struct accl_rt {
       // [l, l + 2^k); children with l % 2d == d relay their block to
       // l - d chunk-by-chunk, so per-message size never exceeds what the
       // flat tree would send (the rendezvous ceiling applies per chunk).
+      // The accumulation buffer holds only this rank's maximum subtree
+      // (lowest set bit of l), not the full world, indexed relative to l.
       uint32_t l = (cm.rank + cm.world - root) % cm.world;
-      std::vector<uint8_t> acc((uint64_t)cm.world * bytes);
-      std::memcpy(acc.data() + (uint64_t)l * bytes, src, bytes);
+      uint32_t max_have =
+          l == 0 ? cm.world : std::min(l & (~l + 1), cm.world - l);
+      std::vector<uint8_t> acc((uint64_t)max_have * bytes);
+      std::memcpy(acc.data(), src, bytes);  // relative chunk 0 == chunk l
       uint32_t have = 1;  // chunks accumulated at [l, l + have)
       for (uint32_t d = 1; d < cm.world; d <<= 1) {
         if (l % (2 * d) == d) {
           uint32_t parent = (l - d + root) % cm.world;
           for (uint32_t c = 0; c < have && err == NO_ERROR; c++)
-            err |= p2p_send(cm.g(parent),
-                            acc.data() + (uint64_t)(l + c) * bytes, bytes,
-                            tag);
+            err |= p2p_send(cm.g(parent), acc.data() + (uint64_t)c * bytes,
+                            bytes, tag);
           return err;  // subtree delivered
         }
         if (l % (2 * d) == 0 && l + d < cm.world) {
@@ -907,7 +917,7 @@ struct accl_rt {
           uint32_t n_ch = std::min(d, cm.world - (l + d));
           for (uint32_t c = 0; c < n_ch; c++) {
             err |= p2p_recv(cm.g(child),
-                            acc.data() + (uint64_t)(l + d + c) * bytes, bytes,
+                            acc.data() + (uint64_t)(d + c) * bytes, bytes,
                             tag);
             if (err) return err;
           }

@@ -64,3 +64,61 @@ def test_stream_reregistration_takes_effect(accl):
     accl.register_stream_producer(11, lambda: 2 * jnp.ones(8, jnp.float32))
     accl.stream_put(8, stream_id=11, src=0, dst=1, recvbuf=out)
     np.testing.assert_allclose(out.host[1], 2 * np.ones(8), rtol=0)
+
+
+def test_streamed_allreduce_op0_and_res(accl):
+    """OP0_STREAM + RES_STREAM on allreduce (reference: streams route
+    through any collective, ccl_offload_control.c:628-636): every rank's
+    contribution is produced on-device, the reduced result passes through
+    a consumer kernel, all one compiled program."""
+    from accl_tpu import ReduceFunction
+
+    n = 64
+    base = RNG.standard_normal((WORLD, n)).astype(np.float32)
+    src = accl.create_buffer(n, data=base)
+    out = accl.create_buffer(n)
+
+    def producer(_b=src):
+        from jax import lax
+
+        me = lax.axis_index("ccl")
+        return lax.dynamic_index_in_dim(_b.device, me, 0, keepdims=False) * 3.0
+
+    accl.register_stream_producer(21, producer)
+    accl.register_stream_consumer(22, lambda x: x + 1.0)
+    accl.allreduce(src, out, n, ReduceFunction.SUM,
+                   op0_stream=21, res_stream=22)
+    expected = base.sum(0) * 3.0 + 1.0
+    np.testing.assert_allclose(out.host, np.tile(expected, (WORLD, 1)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_streamed_bcast_res_stream(accl):
+    """RES_STREAM on bcast: the broadcast value lands through each rank's
+    consumer kernel (the depacketizer's strm!=0 direct-to-kernel routing,
+    tcp_depacketizer.cpp:106-117)."""
+    n = 32
+    x = RNG.standard_normal((WORLD, n)).astype(np.float32)
+    b = accl.create_buffer(n, data=x)
+    accl.register_stream_consumer(23, lambda v: v * v)
+    accl.bcast(b, n, root=4, res_stream=23)
+    np.testing.assert_allclose(b.host, np.tile(x[4] * x[4], (WORLD, 1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_streamed_bcast_op0_from_root(accl):
+    """OP0_STREAM on bcast: the root's payload is produced on-device."""
+    n = 16
+    b = accl.create_buffer(n)
+
+    def producer():
+        from jax import lax
+        import jax.numpy as jnp
+
+        me = lax.axis_index("ccl")
+        return (me.astype(jnp.float32) + 1.0) * jnp.ones(n, jnp.float32)
+
+    accl.register_stream_producer(24, producer)
+    accl.bcast(b, n, root=6, op0_stream=24)
+    # only the root's produced value (6 + 1 = 7) propagates
+    np.testing.assert_allclose(b.host, np.full((WORLD, n), 7.0), rtol=0)
