@@ -342,12 +342,15 @@ class ACCL:
                 "collectives")
         flags = StreamFlags.NO_STREAM
         tag = 0
+        for sid in (op0_stream, res_stream):
+            if sid is not None and not 0 < int(sid) < 247:
+                raise ValueError(f"stream id {sid} outside 1..246")
         if op0_stream is not None:
             flags |= StreamFlags.OP0_STREAM
-            tag |= int(op0_stream) & 0xFF
+            tag |= int(op0_stream)
         if res_stream is not None:
             flags |= StreamFlags.RES_STREAM
-            tag |= (int(res_stream) & 0xFF) << 8
+            tag |= int(res_stream) << 8
         opts.stream_flags = flags
         opts.tag = tag
         return opts

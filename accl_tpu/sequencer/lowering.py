@@ -153,8 +153,8 @@ class ScheduleCompiler:
         # the endpoint callables themselves are part of the key: holding a
         # strong reference prevents id-reuse after GC from resurrecting a
         # stale compiled program when an endpoint is re-registered
-        key = (options.signature(), plan, self.axis_name, "streamed",
-               producer, consumer)
+        key = (options.signature(), plan, self.axis_name,
+               self.use_pallas_ring, "streamed", producer, consumer)
         fn = self._cache.get(key)
         if fn is None:
             body, n_in = self._body(options, plan, arithcfg)

@@ -732,15 +732,23 @@ struct accl_rt {
     auto deadline =
         std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
     std::unique_lock<std::mutex> lk(rndzv_mu);
-    for (;;) {
+    auto match = [&]() -> bool {
       for (auto it = done_q.begin(); it != done_q.end(); ++it) {
         if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
             (tag == TAG_ANY || it->tag == tag)) {
           done_q.erase(it);
-          return NO_ERROR;
+          return true;
         }
       }
+      return false;
+    };
+    for (;;) {
+      if (match()) return NO_ERROR;
       if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        // a write may have landed exactly as the wait expired: re-check
+        // before revoking, or the completion would be orphaned and a
+        // future recv of the same signature falsely satisfied by it
+        if (match()) return NO_ERROR;
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_completion timeout src=%u bytes=%llu done_q=%zu\n",
                   rank, src, (unsigned long long)bytes, done_q.size());
@@ -755,16 +763,21 @@ struct accl_rt {
     auto deadline =
         std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
     std::unique_lock<std::mutex> lk(rndzv_mu);
-    for (;;) {
+    auto match = [&]() -> bool {
       for (auto it = done_q.begin(); it != done_q.end(); ++it) {
         if (it->bytes == bytes && (tag == TAG_ANY || it->tag == tag)) {
           *src = it->src;
           *vaddr = it->vaddr;
           done_q.erase(it);
-          return NO_ERROR;
+          return true;
         }
       }
+      return false;
+    };
+    for (;;) {
+      if (match()) return NO_ERROR;
       if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
+        if (match()) return NO_ERROR;  // landed at the deadline edge
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] get_any_completion timeout bytes=%llu\n", rank,
                   (unsigned long long)bytes);
