@@ -340,17 +340,16 @@ class ACCL:
             raise NotImplementedError(
                 f"{type(self.cclo).__name__} does not support streamed "
                 "collectives")
+        from .ops.streams import check_stream_id
+
         flags = StreamFlags.NO_STREAM
         tag = 0
-        for sid in (op0_stream, res_stream):
-            if sid is not None and not 0 < int(sid) < 247:
-                raise ValueError(f"stream id {sid} outside 1..246")
         if op0_stream is not None:
             flags |= StreamFlags.OP0_STREAM
-            tag |= int(op0_stream)
+            tag |= check_stream_id(op0_stream)
         if res_stream is not None:
             flags |= StreamFlags.RES_STREAM
-            tag |= int(res_stream) << 8
+            tag |= check_stream_id(res_stream) << 8
         opts.stream_flags = flags
         opts.tag = tag
         return opts

@@ -17,6 +17,14 @@ from typing import Callable
 import jax.numpy as jnp
 
 
+def check_stream_id(stream_id: int) -> int:
+    """Valid kernel-stream ids are 1..246 (247..255 reserved, 0 = no
+    stream — the reference's strm-field convention)."""
+    if not 0 < int(stream_id) < 247:
+        raise ValueError(f"stream id {stream_id} outside 1..246")
+    return int(stream_id)
+
+
 class StreamRegistry:
     """Named device-side stream endpoints (the CCLO kernel-stream ports).
 
@@ -30,13 +38,11 @@ class StreamRegistry:
         self._consumers: dict[int, Callable] = {}
 
     def register_producer(self, stream_id: int, fn: Callable):
-        if not 0 < stream_id < 247:  # 247..255 reserved, 0 = no stream
-            raise ValueError("stream id must be in 1..246")
+        check_stream_id(stream_id)
         self._producers[stream_id] = fn
 
     def register_consumer(self, stream_id: int, fn: Callable):
-        if not 0 < stream_id < 247:
-            raise ValueError("stream id must be in 1..246")
+        check_stream_id(stream_id)
         self._consumers[stream_id] = fn
 
     def producer(self, stream_id: int) -> Callable:
