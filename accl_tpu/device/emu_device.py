@@ -58,6 +58,10 @@ def load_native():
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
             ctypes.c_uint64,
         ]
+        lib.accl_rt_create_ex.restype = ctypes.c_void_p
+        lib.accl_rt_create_ex.argtypes = lib.accl_rt_create.argtypes + [
+            ctypes.c_uint32,
+        ]
         lib.accl_rt_destroy.argtypes = [ctypes.c_void_p]
         lib.accl_rt_start.restype = ctypes.c_int64
         lib.accl_rt_start.argtypes = [
@@ -113,13 +117,19 @@ class EmuRank:
         # ceiling so rendezvous tests exercise real sizes. The limit stays
         # enforced (DMA_SIZE_ERROR past it).
         max_rndzv: int = 64 * 1024 * 1024,
+        # "tcp" = session full mesh (EasyNet-class POE); "udp" = sessionless
+        # datagram transport (VNX POE analog, eager-only)
+        transport: str = "tcp",
     ):
         lib = load_native()
         self.world = world
         self.rank = rank
+        self.transport = transport
         arr = (ctypes.c_uint16 * world)(*ports)
-        self._rt = lib.accl_rt_create(
-            world, rank, arr, n_rx_bufs, rx_buf_bytes, max_eager, max_rndzv
+        tr = {"tcp": 0, "udp": 1}[transport]
+        self._rt = lib.accl_rt_create_ex(
+            world, rank, arr, n_rx_bufs, rx_buf_bytes, max_eager, max_rndzv,
+            tr,
         )
         if not self._rt:
             raise RuntimeError(f"native runtime bring-up failed (rank {rank})")

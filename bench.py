@@ -74,7 +74,10 @@ def _timeit_loop(make_fn, args, op_est_sec, target=0.25, kmax=200_000,
     _fetch(fk(*args))  # compile
     t0 = _fetch_baseline(jax)
     tk = _time_once(fk, *args)
-    return max((tk - t0) / k, 1e-9), k
+    # also report how far the TOTAL loop time sits above the fetch-noise
+    # baseline: per-op seconds are meaningless when tk ~ t0
+    snr = tk / max(t0, 1e-9)
+    return max((tk - t0) / k, 1e-9), k, snr
 
 
 def bench_combine(jax, sizes_bytes):
@@ -113,9 +116,9 @@ def bench_combine(jax, sizes_bytes):
         for name, op in variants:
             if name.endswith("_pallas") and nbytes < 256 * 1024 * 1024:
                 continue  # plugin variant measured in the streaming regime
-            sec, k = _timeit_loop(make_variant(op), (a, b), est, jax=jax)
+            sec, k, snr = _timeit_loop(make_variant(op), (a, b), est, jax=jax)
             gbps = nbytes / sec / 1e9
-            rows.append((name, nbytes, sec, gbps))
+            rows.append((name, nbytes, sec, gbps, snr))
             print(f"  {name:26s} {nbytes:>12d} B  {sec*1e6:10.1f} us  "
                   f"{gbps:8.2f} GB/s  (K={k})", file=sys.stderr)
     return rows
@@ -157,10 +160,11 @@ def bench_allreduce(jax, sizes_bytes, world):
             .astype(np.float32)
         xd = _j.device_put(x)
         est = 2 * nbytes / 20e9 + 1e-4
-        sec, _k = _timeit_loop(make_fn, (xd,), est, target=0.5, kmax=200, jax=_j)
+        sec, _k, snr = _timeit_loop(make_fn, (xd,), est, target=0.5,
+                                    kmax=200, jax=_j)
         # bus bandwidth convention: 2*(P-1)/P * payload per chip
         bus = 2 * (world - 1) / world * nbytes / sec / 1e9
-        rows.append(("allreduce_ring_fp32", nbytes, sec, bus))
+        rows.append(("allreduce_ring_fp32", nbytes, sec, bus, snr))
         print(f"  allreduce {nbytes:>10d} B  {sec*1e6:10.1f} us  "
               f"{bus:8.2f} GB/s bus", file=sys.stderr)
     return rows
@@ -224,14 +228,14 @@ def main():
               or jax.default_backend() == "cpu")
     csv_name = "profile_cpu.csv" if is_cpu else "profile.csv"
     # Regime column: only rows whose working set clearly exceeds VMEM and
-    # whose time is far above the timing-noise floor measure HBM
-    # throughput; smaller points measure dispatch latency / on-chip
-    # residency and their GBps must not be read as bandwidth.
-    noise_floor = _baseline_cache.get("t0", 0.0) * 0.5
+    # whose TOTAL measured loop time sits well above the fetch-noise
+    # baseline measure HBM throughput; smaller points measure dispatch
+    # latency / on-chip residency and their GBps must not be read as
+    # bandwidth.
     with open(outdir / csv_name, "w") as f:
         f.write("Test,Bytes,Seconds,GBps,Regime\n")
-        for t, b, s, g in rows:
-            regime = ("stream" if b >= 256 * 1024 * 1024 and s > noise_floor
+        for t, b, s, g, snr in rows:
+            regime = ("stream" if b >= 256 * 1024 * 1024 and snr >= 2.0
                       else "latency")
             f.write(f"{t},{b},{s:.6e},{g:.3f},{regime}\n")
 

@@ -26,12 +26,30 @@ extern "C" {
 
 typedef struct accl_rt accl_rt_t;
 
-/* Create a rank runtime. ports[world] lists each rank's TCP port on
- * 127.0.0.1. Establishes the full mesh (blocking) before returning. */
+/* Transport selection: the reference ships interchangeable POEs selected
+ * at build time (kernels/cclo/Makefile:20) — session-based TCP
+ * (EasyNet-class) and sessionless UDP (VNX). The datagram transport is
+ * eager-only (rendezvous message types exist only on the RDMA stack) and
+ * reassembles purely by (src, tag, seqn) — each segment is a standalone
+ * packet with a full header, the udp_depacketizer posture. */
+enum accl_rt_transport {
+  ACCL_RT_TRANSPORT_TCP = 0,
+  ACCL_RT_TRANSPORT_UDP = 1,
+};
+
+/* Create a rank runtime. ports[world] lists each rank's port on
+ * 127.0.0.1. Establishes the full mesh / datagram handshake (blocking)
+ * before returning. */
 accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
                           const uint16_t *ports, uint32_t n_rx_bufs,
                           uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
                           uint64_t max_rndzv_bytes);
+
+/* accl_rt_create with an explicit transport (accl_rt_transport). */
+accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
+                             const uint16_t *ports, uint32_t n_rx_bufs,
+                             uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
+                             uint64_t max_rndzv_bytes, uint32_t transport);
 
 void accl_rt_destroy(accl_rt_t *rt);
 

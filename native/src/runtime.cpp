@@ -82,6 +82,8 @@ enum MsgType : uint32_t {
   MSG_EGR_DATA = 0,    // eager segment into an rx slot
   MSG_RNDZV_ADDR = 1,  // receiver -> sender address notification
   MSG_RNDZV_WRITE = 2, // sender -> receiver one-sided write payload
+  MSG_HELLO = 3,       // datagram bring-up solicit (reply expected)
+  MSG_HELLO_ACK = 4,   // datagram bring-up reply (no further reply)
 };
 
 struct MsgHeader {
@@ -322,11 +324,20 @@ struct accl_rt {
   std::vector<uint8_t> exchmem = std::vector<uint8_t>(EXCHMEM_BYTES, 0);
   std::mutex exch_mu;
 
-  // transport
-  std::vector<int> peer_fd;          // per-rank socket (self = -1)
+  // transport — TCP full mesh (session-based, the EasyNet-class POE) or
+  // one shared datagram socket (sessionless, the VNX-UDP POE analog:
+  // every segment is a standalone packet carrying the full 64 B header,
+  // reassembled purely by (src, tag, seqn) — the udp_depacketizer role)
+  std::vector<int> peer_fd;          // per-rank socket (self = -1), TCP mode
   std::vector<std::mutex> tx_mu;     // serialize frames per link
   std::vector<std::thread> rx_threads;
   int listen_fd = -1;
+  bool udp_mode = false;
+  int udp_fd = -1;
+  std::vector<sockaddr_in> peer_sa;  // datagram peer addresses
+  std::vector<bool> hello_seen;      // bring-up handshake state
+  std::mutex hello_mu;
+  std::condition_variable hello_cv;
   std::atomic<bool> stop{false};
 
   // eager rx ring + notifications (rxbuf_offload analog)
@@ -441,6 +452,16 @@ struct accl_rt {
     h.host = host;
     h.bytes = bytes;
     h.vaddr = vaddr;
+    if (udp_mode) {
+      // sessionless: header + payload in one datagram (udp_packetizer
+      // analog — segment == packet)
+      std::vector<uint8_t> pkt(sizeof h + payload_len);
+      std::memcpy(pkt.data(), &h, sizeof h);
+      if (payload_len) std::memcpy(pkt.data() + sizeof h, payload, payload_len);
+      ssize_t n = sendto(udp_fd, pkt.data(), pkt.size(), 0,
+                         (const sockaddr *)&peer_sa[dst], sizeof(sockaddr_in));
+      return n == (ssize_t)pkt.size();
+    }
     std::lock_guard<std::mutex> g(tx_mu[dst]);
     if (getenv("ACCL_RT_DEBUG"))
       fprintf(stderr, "[r%u] tx mt=%u dst=%u fd=%d bytes=%llu\n", rank,
@@ -453,6 +474,74 @@ struct accl_rt {
     if (payload_len && !send_all(peer_fd[dst], payload, payload_len))
       return false;
     return true;
+  }
+
+  // depacketizer -> rxbuf enqueue/dequeue: land a segment in an IDLE slot
+  // and publish the notification. Returns false on shutdown.
+  bool land_eager(const MsgHeader &h, const std::vector<uint8_t> &payload) {
+    std::unique_lock<std::mutex> lk(rx_mu);
+    rx_cv.wait(lk, [&] {
+      if (stop.load()) return true;
+      for (auto &s : rx_slots)
+        if (s.status == RxSlot::IDLE) return true;
+      return false;
+    });
+    if (stop.load()) return false;
+    for (auto &s : rx_slots) {
+      if (s.status == RxSlot::IDLE) {
+        s.status = RxSlot::VALID;
+        s.src = h.src;
+        s.tag = h.tag;
+        s.seqn = h.seqn;
+        s.data = payload;
+        break;
+      }
+    }
+    rx_cv.notify_all();
+    return true;
+  }
+
+  // Sessionless datagram receive loop: ONE socket carries every peer;
+  // the header identifies the sender (the udp_depacketizer role —
+  // per-packet routing with no connection state).
+  void udp_rx_loop() {
+    std::vector<uint8_t> pkt(sizeof(MsgHeader) + 65536);
+    std::vector<uint8_t> payload;
+    while (!stop.load()) {
+      ssize_t n = recvfrom(udp_fd, pkt.data(), pkt.size(), 0, nullptr, nullptr);
+      if (n < (ssize_t)sizeof(MsgHeader)) {
+        if (stop.load()) return;
+        continue;  // runt/interrupted
+      }
+      MsgHeader h;
+      std::memcpy(&h, pkt.data(), sizeof h);
+      if (h.magic != MSG_MAGIC || h.src >= world) continue;
+      switch (h.msg_type) {
+        case MSG_HELLO:
+          frame_out(h.src, MSG_HELLO_ACK, 0, 0, 0, 0, nullptr, 0);
+          [[fallthrough]];
+        case MSG_HELLO_ACK: {
+          std::lock_guard<std::mutex> g(hello_mu);
+          hello_seen[h.src] = true;
+          hello_cv.notify_all();
+          break;
+        }
+        case MSG_EGR_DATA: {
+          size_t plen = (size_t)h.bytes;
+          if ((ssize_t)(sizeof h + plen) != n) continue;  // truncated
+          payload.assign(pkt.data() + sizeof h, pkt.data() + sizeof h + plen);
+          if (!land_eager(h, payload)) return;
+          break;
+        }
+        default:
+          // rendezvous needs one-sided writes: not offered on the lossy
+          // sessionless POE (reference: RDMA-only message types)
+          if (getenv("ACCL_RT_DEBUG"))
+            fprintf(stderr, "[r%u] drop mt=%u on datagram transport\n", rank,
+                    h.msg_type);
+          break;
+      }
+    }
   }
 
   void rx_loop(uint32_t peer) {
@@ -478,27 +567,7 @@ struct accl_rt {
       if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
       switch (h.msg_type) {
         case MSG_EGR_DATA: {
-          // depacketizer -> rxbuf enqueue/dequeue: land the segment in an
-          // IDLE slot and publish the notification.
-          std::unique_lock<std::mutex> lk(rx_mu);
-          rx_cv.wait(lk, [&] {
-            if (stop.load()) return true;
-            for (auto &s : rx_slots)
-              if (s.status == RxSlot::IDLE) return true;
-            return false;
-          });
-          if (stop.load()) return;
-          for (auto &s : rx_slots) {
-            if (s.status == RxSlot::IDLE) {
-              s.status = RxSlot::VALID;
-              s.src = h.src;
-              s.tag = h.tag;
-              s.seqn = h.seqn;
-              s.data = payload;
-              break;
-            }
-          }
-          rx_cv.notify_all();
+          if (!land_eager(h, payload)) return;
           break;
         }
         case MSG_RNDZV_ADDR: {
@@ -549,6 +618,11 @@ struct accl_rt {
 
   uint32_t egr_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
                     uint32_t tag) {
+    // the datagram POE has no rendezvous path, so the configured message
+    // ceiling applies to eager transfers there (without it, a huge send
+    // would overflow the receiver's datagram buffer and surface as a
+    // misleading sequencing error)
+    if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
     uint64_t off = 0;
     while (off < bytes || bytes == 0) {
       uint64_t seg = std::min<uint64_t>(rx_buf_bytes, bytes - off);
@@ -613,6 +687,7 @@ struct accl_rt {
   // Blocking variant with the housekeeping timeout; seek and wait happen
   // under one held lock so a segment landing between them cannot be missed.
   uint32_t egr_recv(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t bytes) {
+    if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
     uint64_t off = 0;
     auto deadline =
         std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
@@ -789,7 +864,12 @@ struct accl_rt {
 
   // ----- point-to-point over both protocols (send .c:573-649) -----
 
-  bool is_rndzv(uint64_t bytes) const { return bytes > max_eager; }
+  bool is_rndzv(uint64_t bytes) const {
+    // the datagram POE is eager-only (reference: rendezvous message types
+    // exist only on the RDMA stack, eth_intf.h:42-45); large messages
+    // segment through the rx ring instead
+    return !udp_mode && bytes > max_eager;
+  }
 
   uint32_t p2p_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
                     uint32_t tag) {
@@ -1454,10 +1534,10 @@ struct accl_rt {
 
 extern "C" {
 
-accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
-                          const uint16_t *ports, uint32_t n_rx_bufs,
-                          uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
-                          uint64_t max_rndzv_bytes) {
+accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
+                             const uint16_t *ports, uint32_t n_rx_bufs,
+                             uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
+                             uint64_t max_rndzv_bytes, uint32_t transport) {
   auto *rt = new accl_rt();
   rt->world = world;
   rt->rank = rank;
@@ -1470,6 +1550,58 @@ accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
   rt->peer_fd.assign(world, -1);
   rt->tx_mu = std::vector<std::mutex>(world);
   rt->wr(IDCODE, 0xACC17B00u);
+
+  if (transport == ACCL_RT_TRANSPORT_UDP) {
+    // sessionless datagram POE: one SOCK_DGRAM socket, no connections.
+    // Segment must fit one datagram with its header.
+    if (rt->rx_buf_bytes > 60000) rt->rx_buf_bytes = 60000;
+    rt->udp_mode = true;
+    rt->udp_fd = socket(AF_INET, SOCK_DGRAM, 0);
+    int buf = 8 * 1024 * 1024;  // absorb bursts: the POE has no sessions
+    setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
+    setsockopt(rt->udp_fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons(ports[rank]);
+    if (bind(rt->udp_fd, (sockaddr *)&sa, sizeof sa) != 0) {
+      close(rt->udp_fd);
+      delete rt;
+      return nullptr;
+    }
+    rt->peer_sa.resize(world);
+    for (uint32_t i = 0; i < world; i++) {
+      rt->peer_sa[i] = sockaddr_in{};
+      rt->peer_sa[i].sin_family = AF_INET;
+      rt->peer_sa[i].sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      rt->peer_sa[i].sin_port = htons(ports[i]);
+    }
+    rt->hello_seen.assign(world, false);
+    rt->hello_seen[rank] = true;
+    rt->rx_threads.emplace_back([rt] { rt->udp_rx_loop(); });
+    // bring-up handshake: solicit hellos until every peer answered
+    // (datagrams sent before a peer binds are simply lost, so re-solicit)
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
+    for (;;) {
+      std::vector<uint32_t> missing;
+      {
+        std::lock_guard<std::mutex> g(rt->hello_mu);
+        for (uint32_t i = 0; i < world; i++)
+          if (!rt->hello_seen[i]) missing.push_back(i);
+      }
+      if (missing.empty()) break;
+      if (std::chrono::steady_clock::now() > deadline) {
+        accl_rt_destroy(rt);
+        return nullptr;
+      }
+      for (uint32_t i : missing)
+        rt->frame_out(i, MSG_HELLO, 0, 0, 0, 0, nullptr, 0);
+      std::unique_lock<std::mutex> lk(rt->hello_mu);
+      rt->hello_cv.wait_for(lk, std::chrono::milliseconds(50));
+    }
+    rt->seq_thread = std::thread([rt] { rt->sequencer(); });
+    return rt;
+  }
 
   // listen
   rt->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -1549,13 +1681,31 @@ accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
   return rt;
 }
 
+accl_rt_t *accl_rt_create(uint32_t world, uint32_t rank,
+                          const uint16_t *ports, uint32_t n_rx_bufs,
+                          uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
+                          uint64_t max_rndzv_bytes) {
+  return accl_rt_create_ex(world, rank, ports, n_rx_bufs, rx_buf_bytes,
+                           max_eager_bytes, max_rndzv_bytes,
+                           ACCL_RT_TRANSPORT_TCP);
+}
+
 void accl_rt_destroy(accl_rt_t *rt) {
   rt->stop.store(true);
   rt->call_cv.notify_all();
   rt->rx_cv.notify_all();
   rt->rndzv_cv.notify_all();
+  rt->hello_cv.notify_all();
   for (int fd : rt->peer_fd)
     if (fd >= 0) { shutdown(fd, SHUT_RDWR); close(fd); }
+  if (rt->udp_fd >= 0) {
+    // wake the datagram rx thread: shutdown() is a no-op on unconnected
+    // UDP sockets, so poke ourselves with a runt datagram (the rx loop
+    // re-checks `stop` on any short read), then close
+    sendto(rt->udp_fd, "", 0, 0, (const sockaddr *)&rt->peer_sa[rt->rank],
+           sizeof(sockaddr_in));
+    close(rt->udp_fd);
+  }
   if (rt->listen_fd >= 0) close(rt->listen_fd);
   for (auto &t : rt->rx_threads)
     if (t.joinable()) t.join();
