@@ -340,8 +340,11 @@ struct accl_rt {
   std::condition_variable hello_cv;
   std::atomic<bool> stop{false};
 
-  // eager rx ring + notifications (rxbuf_offload analog)
+  // eager rx ring + notifications (rxbuf_offload analog). idle_q is the
+  // IDLE free-list (indices into rx_slots) so landing a segment is O(1)
+  // even when the datagram transport grows the ring into the thousands.
   std::vector<RxSlot> rx_slots;
+  std::vector<size_t> idle_q;
   std::mutex rx_mu;
   std::condition_variable rx_cv;
 
@@ -478,25 +481,34 @@ struct accl_rt {
 
   // depacketizer -> rxbuf enqueue/dequeue: land a segment in an IDLE slot
   // and publish the notification. Returns false on shutdown.
-  bool land_eager(const MsgHeader &h, const std::vector<uint8_t> &payload) {
+  //
+  // allow_grow (datagram transport): the single rx thread must NEVER
+  // block — a full ring would overflow the kernel socket buffer (silent
+  // datagram loss surfacing as timeouts) and would starve bring-up
+  // hello processing. The ring grows on demand up to a generous bound,
+  // past which the blocking backpressure applies as a last resort.
+  bool land_eager(const MsgHeader &h, std::vector<uint8_t> payload,
+                  bool allow_grow = false) {
     std::unique_lock<std::mutex> lk(rx_mu);
-    rx_cv.wait(lk, [&] {
-      if (stop.load()) return true;
-      for (auto &s : rx_slots)
-        if (s.status == RxSlot::IDLE) return true;
-      return false;
-    });
-    if (stop.load()) return false;
-    for (auto &s : rx_slots) {
-      if (s.status == RxSlot::IDLE) {
-        s.status = RxSlot::VALID;
-        s.src = h.src;
-        s.tag = h.tag;
-        s.seqn = h.seqn;
-        s.data = payload;
-        break;
-      }
+    size_t idx;
+    if (!idle_q.empty()) {
+      idx = idle_q.back();
+      idle_q.pop_back();
+    } else if (allow_grow && rx_slots.size() < (1u << 20)) {
+      rx_slots.emplace_back();
+      idx = rx_slots.size() - 1;
+    } else {
+      rx_cv.wait(lk, [&] { return stop.load() || !idle_q.empty(); });
+      if (stop.load()) return false;
+      idx = idle_q.back();
+      idle_q.pop_back();
     }
+    RxSlot &slot = rx_slots[idx];
+    slot.status = RxSlot::VALID;
+    slot.src = h.src;
+    slot.tag = h.tag;
+    slot.seqn = h.seqn;
+    slot.data = std::move(payload);
     rx_cv.notify_all();
     return true;
   }
@@ -530,7 +542,8 @@ struct accl_rt {
           size_t plen = (size_t)h.bytes;
           if ((ssize_t)(sizeof h + plen) != n) continue;  // truncated
           payload.assign(pkt.data() + sizeof h, pkt.data() + sizeof h + plen);
-          if (!land_eager(h, payload)) return;
+          if (!land_eager(h, std::move(payload), /*allow_grow=*/true))
+            return;
           break;
         }
         default:
@@ -567,7 +580,7 @@ struct accl_rt {
       if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
       switch (h.msg_type) {
         case MSG_EGR_DATA: {
-          if (!land_eager(h, payload)) return;
+          if (!land_eager(h, std::move(payload))) return;
           break;
         }
         case MSG_RNDZV_ADDR: {
@@ -653,7 +666,8 @@ struct accl_rt {
                        uint64_t *got, bool strict_tag = false) {
     uint32_t want = inbound_seq[src];
     bool head_tag_mismatch = false, stray_seqn = false;
-    for (auto &s : rx_slots) {
+    for (size_t i = 0; i < rx_slots.size(); i++) {
+      RxSlot &s = rx_slots[i];
       if (s.status != RxSlot::VALID || s.src != src) continue;
       if (s.seqn == want) {
         if (tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY) {
@@ -662,6 +676,7 @@ struct accl_rt {
           if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
           s.status = RxSlot::IDLE;
           s.data.clear();
+          idle_q.push_back(i);
           inbound_seq[src] = want + 1;
           rx_cv.notify_all();
           return NO_ERROR;
@@ -1545,6 +1560,7 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   rt->max_eager = max_eager_bytes;
   rt->max_rndzv = max_rndzv_bytes;
   rt->rx_slots.resize(n_rx_bufs);
+  for (size_t i = 0; i < rt->rx_slots.size(); i++) rt->idle_q.push_back(i);
   rt->inbound_seq.assign(world, 0);
   rt->outbound_seq.assign(world, 0);
   rt->peer_fd.assign(world, -1);
@@ -1557,8 +1573,10 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     if (rt->rx_buf_bytes > 60000) rt->rx_buf_bytes = 60000;
     rt->udp_mode = true;
     rt->udp_fd = socket(AF_INET, SOCK_DGRAM, 0);
-    int buf = 8 * 1024 * 1024;  // absorb bursts: the POE has no sessions
-    setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
+    int buf = 64 * 1024 * 1024;  // absorb bursts: the POE has no sessions
+    // FORCE ignores net.core.rmem_max when privileged; fall back otherwise
+    if (setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUFFORCE, &buf, sizeof buf))
+      setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
     setsockopt(rt->udp_fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
     sockaddr_in sa{};
     sa.sin_family = AF_INET;

@@ -18,8 +18,12 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# ACCL_TPU_HW=1 opts OUT of the CPU forcing so the hardware-only suite
+# (tests/test_tpu_hw.py) can reach the real chip:
+#   ACCL_TPU_HW=1 python -m pytest tests/test_tpu_hw.py -v
+if os.environ.get("ACCL_TPU_HW") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 
 

@@ -716,3 +716,30 @@ def test_udp_sub_communicators(udp4):
     res = w.run(body)
     np.testing.assert_allclose(res[1], x[[1, 3]].sum(0), rtol=1e-5)
     np.testing.assert_allclose(res[3], x[[1, 3]].sum(0), rtol=1e-5)
+
+
+def test_udp_burst_with_late_receiver(udp4):
+    """A large valid-size eager burst must not be lost when the receiver
+    posts its recv late: the datagram rx path drains the socket into a
+    growable ring instead of blocking (which would overflow the kernel
+    buffer and surface as a misleading timeout)."""
+    import time
+
+    w = udp4
+    n = 4_000_000  # 16 MB: far past the kernel socket buffer, under max_rndzv
+
+    y = RNG.standard_normal(n).astype(np.float32)
+
+    def body(rank, i):
+        if i == 0:
+            rank.send(y.copy(), n, dst=1, tag=44)
+            return None
+        if i == 1:
+            time.sleep(1.0)  # receiver late: the burst already arrived
+            out = np.zeros(n, np.float32)
+            rank.recv(out, n, src=0, tag=44)
+            return out
+        return None
+
+    res = w.run(body)
+    np.testing.assert_allclose(res[1], y, rtol=0)
