@@ -14,3 +14,9 @@ from .transformer import (  # noqa: F401
     make_train_step,
     make_forward,
 )
+from .moe import (  # noqa: F401
+    MoEConfig,
+    init_moe_params,
+    make_moe_forward,
+    make_moe_train_step,
+)

@@ -1,0 +1,256 @@
+"""Expert-parallel Mixture-of-Experts on the framework's alltoall.
+
+Second model family beside the dense TP x SP x DP transformer
+(transformer.py): the FFN is replaced by a top-1-routed MoE whose experts
+shard over an `ep` mesh axis, with BOTH the token dispatch and the
+return combine moving through the framework's own pairwise-rotation
+alltoall schedule (sequencer/schedules.py:alltoall_schedule — the ACCL
+alltoall, ccl_offload_control.c:2123-2218). This is the vadd_put pattern
+again at a different scale point: device compute feeding straight into a
+collective inside one compiled program, no host in the loop.
+
+Routing is capacity-based (fixed shapes, XLA-friendly): each expert
+accepts at most C = ceil(T / E * capacity_factor) tokens per rank;
+overflow tokens pass through on the residual stream (standard dropped-
+token semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..sequencer import schedules
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 4       # total experts == ep axis size x experts_per_rank
+    experts_per_rank: int = 1
+    capacity_factor: float = 1.25
+    vocab: int = 64
+    seq: int = 32
+    dtype: str = "float32"
+
+
+def init_moe_params(cfg: MoEConfig, key) -> dict:
+    """Global parameter pytree: router replicated, experts stacked on the
+    leading axis (sharded over ep)."""
+    kr, ke1, ke2, kemb, kun = jax.random.split(key, 5)
+    dt = jnp.dtype(cfg.dtype)
+    E = cfg.n_experts
+    s = 0.02
+    return {
+        "embed": (jax.random.normal(kemb, (cfg.vocab, cfg.d_model)) * s).astype(dt),
+        "router": (jax.random.normal(kr, (cfg.d_model, E)) * s).astype(dt),
+        "w_up": (jax.random.normal(ke1, (E, cfg.d_model, cfg.d_ff)) * s).astype(dt),
+        "w_down": (jax.random.normal(ke2, (E, cfg.d_ff, cfg.d_model)) * s).astype(dt),
+        "unembed": (jax.random.normal(kun, (cfg.d_model, cfg.vocab)) * s).astype(dt),
+    }
+
+
+def moe_param_specs(cfg: MoEConfig) -> dict:
+    return {
+        "embed": P(),
+        "router": P(),
+        "w_up": P("ep"),
+        "w_down": P("ep"),
+        "unembed": P(),
+    }
+
+
+def _capacity(cfg: MoEConfig, tokens: int) -> int:
+    return max(1, int(np.ceil(tokens / cfg.n_experts * cfg.capacity_factor)))
+
+
+def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
+    """Per-rank MoE FFN body (runs inside shard_map): routes the local
+    (T, D) tokens to experts across the ep axis through the framework
+    alltoall, applies the rank's local experts, and alltoalls results
+    back. Returns (T, D) expert outputs weighted by router probability
+    (zeros for capacity-dropped tokens)."""
+    T, D = x.shape
+    ep_world = lax.axis_size(ep_axis)
+    n_local = cfg.experts_per_rank
+    E = ep_world * n_local
+    assert E == cfg.n_experts, (E, cfg.n_experts)
+    C = _capacity(cfg, T)
+
+    # top-1 routing (router weights are replicated)
+    logits = x @ params["router"]                      # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    assign = jnp.argmax(probs, axis=-1)                # (T,)
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=-1)[:, 0]
+
+    # capacity assignment: position of each token within its expert
+    onehot = jax.nn.one_hot(assign, E, dtype=jnp.int32)          # (T, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot              # (T, E)
+    pos_in_e = pos.sum(axis=-1)                                  # (T,)
+    keep = pos_in_e < C
+
+    # dispatch buffer (E, C, D): slot [e, c] = the c-th token routed to e
+    safe_e = jnp.where(keep, assign, 0)
+    safe_c = jnp.where(keep, pos_in_e, 0)
+    dispatch = jnp.zeros((E, C, D), x.dtype)
+    dispatch = dispatch.at[safe_e, safe_c].add(
+        jnp.where(keep[:, None], x, 0.0)
+    )
+
+    # dispatch alltoall: destination rank r gets experts [r*n_local, ...)
+    flat = dispatch.reshape(-1)                        # (ep_world * n_local*C*D)
+    routed = schedules.alltoall_schedule(
+        flat, axis=ep_axis, world=ep_world, wire=wire
+    )
+    # (ep_world, n_local, C, D): source-rank-major blocks for MY experts
+    recv = routed.reshape(ep_world, n_local, C, D)
+
+    # local expert FFN: my n_local experts over all source ranks' tokens
+    me = lax.axis_index(ep_axis)
+    w_up = lax.dynamic_slice_in_dim(params["w_up"], me * n_local, n_local, 0)
+    w_down = lax.dynamic_slice_in_dim(params["w_down"], me * n_local, n_local, 0)
+    h = jnp.einsum("slcd,ldf->slcf", recv, w_up)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("slcf,lfd->slcd", h, w_down)
+
+    # return alltoall: send block s back to source rank s
+    back = schedules.alltoall_schedule(
+        out.reshape(-1), axis=ep_axis, world=ep_world, wire=wire
+    ).reshape(E, C, D)
+
+    # combine: gather each token's slot, weight by the router gate
+    token_out = back[safe_e, safe_c]                   # (T, D)
+    return jnp.where(keep[:, None], token_out * gate[:, None].astype(x.dtype),
+                     0.0)
+
+
+def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
+    """Jitted SPMD forward: tokens (B, T) -> logits; batch over dp,
+    experts over ep. One compiled program per call signature."""
+    wire = schedules.Wire(None)
+    pspecs = moe_param_specs(cfg)
+
+    def body(params, tokens):
+        x = params["embed"][tokens]                    # (Blocal, T, D)
+
+        def per_seq(xi):
+            return xi + moe_ffn_local(xi, params, cfg, ep_axis="ep",
+                                      wire=wire)
+
+        x = jax.vmap(per_seq)(x)
+        return jnp.einsum("btd,dv->btv", x, params["unembed"])
+
+    # tokens shard over BOTH axes (true expert parallelism: every rank
+    # routes a distinct batch shard); routing is per-sequence, so the
+    # sharded program equals the single-device oracle exactly
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, P(("dp", "ep"))),
+            out_specs=P(("dp", "ep")),
+            check_vma=False,
+        )
+    )
+
+
+def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
+    """SGD step with dp-mean + ep-aware gradient sync: expert-sharded
+    grads stay local to their ep shard; replicated params (embed, router,
+    unembed) mean-allreduce over BOTH axes through the framework ring."""
+    from ..constants import ReduceFunction
+
+    wire = schedules.Wire(None)
+    pspecs = moe_param_specs(cfg)
+
+    def loss_fn(params, tokens, targets):
+        x = params["embed"][tokens]
+
+        def per_seq(xi):
+            return xi + moe_ffn_local(xi, params, cfg, ep_axis="ep",
+                                      wire=wire)
+
+        x = jax.vmap(per_seq)(x)
+        logits = jnp.einsum("btd,dv->btv", x, params["unembed"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        return nll.mean()
+
+    def _allreduce_mean(g, axis):
+        world = lax.axis_size(axis)
+        if world == 1:
+            return g
+        out = schedules.allreduce_ring_schedule(
+            g.reshape(-1), func=ReduceFunction.SUM, axis=axis, world=world,
+            wire=wire, seg_count=g.size,
+        )
+        return out.reshape(g.shape) / world
+
+    def body(params, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        ep_world = lax.axis_size("ep")
+
+        def sync(g, spec):
+            g = _allreduce_mean(g, "dp")
+            if "ep" in tuple(spec):
+                # the alltoall transpose already accumulated every ep
+                # shard's cotangent on the owning rank (one term per
+                # shard-local loss), so after the dp mean the expert grad
+                # is ep_world x the global-mean gradient: rescale
+                return g / ep_world
+            # replicated params: each rank's grad covers only its own
+            # token shard — mean over ep completes the batch mean
+            return _allreduce_mean(g, "ep")
+
+        grads = jax.tree.map(sync, grads, pspecs)
+        new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                                  params, grads)
+        for ax in ("dp", "ep"):
+            loss = schedules.allreduce_ring_schedule(
+                loss[None], func=ReduceFunction.SUM, axis=ax,
+                world=lax.axis_size(ax), wire=wire, seg_count=1,
+            )[0] / lax.axis_size(ax)
+        return new_params, loss
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(pspecs, P(("dp", "ep")), P(("dp", "ep"))),
+            out_specs=(pspecs, P()),
+            check_vma=False,
+        )
+    )
+
+
+def moe_reference_forward(params, tokens, cfg: MoEConfig):
+    """Single-device oracle: identical routing/capacity math, no mesh."""
+    x = params["embed"][tokens]
+
+    def per_seq(xi):
+        T, D = xi.shape
+        E, C = cfg.n_experts, _capacity(cfg, T)
+        logits = xi @ params["router"]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        assign = jnp.argmax(probs, -1)
+        gate = jnp.take_along_axis(probs, assign[:, None], -1)[:, 0]
+        onehot = jax.nn.one_hot(assign, E, dtype=jnp.int32)
+        pos_in_e = ((jnp.cumsum(onehot, 0) - 1) * onehot).sum(-1)
+        keep = pos_in_e < C
+        safe_e = jnp.where(keep, assign, 0)
+        safe_c = jnp.where(keep, pos_in_e, 0)
+        disp = jnp.zeros((E, C, D), xi.dtype).at[safe_e, safe_c].add(
+            jnp.where(keep[:, None], xi, 0.0))
+        h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", disp, params["w_up"]))
+        out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])
+        tok = out[safe_e, safe_c]
+        moe = jnp.where(keep[:, None], tok * gate[:, None].astype(xi.dtype),
+                        0.0)
+        return xi + moe
+
+    x = jax.vmap(per_seq)(x)
+    return jnp.einsum("btd,dv->btv", x, params["unembed"])

@@ -345,6 +345,8 @@ struct accl_rt {
   // even when the datagram transport grows the ring into the thousands.
   std::vector<RxSlot> rx_slots;
   std::vector<size_t> idle_q;
+  size_t base_rx_slots = 0;  // configured ring size; growth beyond it is
+                             // burst absorption and compacts when drained
   std::mutex rx_mu;
   std::condition_variable rx_cv;
 
@@ -675,8 +677,20 @@ struct accl_rt {
           *got = s.data.size();
           if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
           s.status = RxSlot::IDLE;
-          s.data.clear();
+          if (i >= base_rx_slots)
+            std::vector<uint8_t>().swap(s.data);  // free burst capacity
+          else
+            s.data.clear();
           idle_q.push_back(i);
+          // compact a grown ring back to the configured size once fully
+          // drained, so one burst does not permanently tax every later
+          // seek scan or retain its payload memory
+          if (rx_slots.size() > base_rx_slots &&
+              idle_q.size() == rx_slots.size()) {
+            rx_slots.resize(base_rx_slots);
+            idle_q.clear();
+            for (size_t j = 0; j < base_rx_slots; j++) idle_q.push_back(j);
+          }
           inbound_seq[src] = want + 1;
           rx_cv.notify_all();
           return NO_ERROR;
@@ -1560,6 +1574,7 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   rt->max_eager = max_eager_bytes;
   rt->max_rndzv = max_rndzv_bytes;
   rt->rx_slots.resize(n_rx_bufs);
+  rt->base_rx_slots = n_rx_bufs;
   for (size_t i = 0; i < rt->rx_slots.size(); i++) rt->idle_q.push_back(i);
   rt->inbound_seq.assign(world, 0);
   rt->outbound_seq.assign(world, 0);

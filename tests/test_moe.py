@@ -1,0 +1,99 @@
+"""Expert-parallel MoE tests: the second model family, routed through the
+framework's alltoall schedule (ccl_offload_control.c:2123-2218 analog)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accl_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    make_moe_forward,
+    make_moe_train_step,
+    moe_param_specs,
+    moe_reference_forward,
+)
+
+RNG = np.random.default_rng(44)
+
+
+def _mesh(dp, ep):
+    devs = np.array(jax.devices()[: dp * ep]).reshape(dp, ep)
+    return Mesh(devs, ("dp", "ep"))
+
+
+def _place(params, cfg, mesh):
+    specs = moe_param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def _batch(cfg, batch):
+    tokens = RNG.integers(0, cfg.vocab, (batch, cfg.seq)).astype(np.int32)
+    return tokens, np.roll(tokens, -1, axis=1)
+
+
+@pytest.mark.parametrize("dp,ep,epr", [(2, 4, 1), (1, 4, 1), (2, 2, 2)])
+def test_moe_forward_matches_reference(dp, ep, epr):
+    """The expert-parallel forward (dispatch alltoall -> sharded experts
+    -> return alltoall) must equal the single-device oracle exactly —
+    routing is per-sequence, so sharding cannot change the math."""
+    cfg = MoEConfig(d_model=32, d_ff=64, n_experts=ep * epr,
+                    experts_per_rank=epr, vocab=32, seq=24)
+    params = init_moe_params(cfg, jax.random.key(0))
+    tokens, _ = _batch(cfg, batch=8)
+
+    ref = np.asarray(moe_reference_forward(params, tokens, cfg))
+
+    mesh = _mesh(dp, ep)
+    fwd = make_moe_forward(cfg, mesh)
+    out = np.asarray(fwd(_place(params, cfg, mesh), tokens))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_train_step_matches_single_device():
+    """One SGD step on a dp2 x ep4 mesh equals the identical step with
+    all experts on one device (validates the ep gradient scaling: expert
+    grads rescaled by 1/ep, replicated grads mean-allreduced)."""
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=4, experts_per_rank=1,
+                    vocab=32, seq=16)
+    params = init_moe_params(cfg, jax.random.key(1))
+    tokens, targets = _batch(cfg, batch=8)
+    lr = 0.1
+
+    # single-device form: ep=1 with all experts local
+    cfg1 = MoEConfig(d_model=16, d_ff=32, n_experts=4, experts_per_rank=4,
+                     vocab=32, seq=16)
+    mesh1 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "ep"))
+    step1 = make_moe_train_step(cfg1, mesh1, lr=lr)
+    ref_params, ref_loss = step1(_place(params, cfg1, mesh1), tokens, targets)
+
+    mesh = _mesh(2, 4)
+    step = make_moe_train_step(cfg, mesh, lr=lr)
+    new_params, loss = step(_place(params, cfg, mesh), tokens, targets)
+
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+    for (path, r), nw in zip(
+        jax.tree_util.tree_flatten_with_path(ref_params)[0],
+        jax.tree.leaves(new_params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(nw), np.asarray(r), rtol=2e-4, atol=2e-5,
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged")
+
+
+def test_moe_training_decreases_loss():
+    cfg = MoEConfig(d_model=32, d_ff=64, n_experts=4, experts_per_rank=1,
+                    vocab=16, seq=16)
+    mesh = _mesh(2, 4)
+    params = _place(init_moe_params(cfg, jax.random.key(2)), cfg, mesh)
+    tokens, targets = _batch(cfg, batch=8)
+    step = make_moe_train_step(cfg, mesh, lr=5e-2)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
