@@ -11,5 +11,6 @@ alongside the sequencer's collective schedule bodies.
 """
 
 from .mesh import factorize_devices, make_mesh  # noqa: F401
+from .pipeline import gpipe_schedule  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a `pp` mesh axis.
+
+Completes the parallelism set (dp/sp/tp/ep/pp). Each rank owns one stage
+of a depth-sharded model; microbatches flow rank -> rank+1 through the
+framework's wire ppermute (the same hop primitive every ring schedule
+uses), M + P - 1 steps fill and drain the pipeline, and the last stage's
+outputs broadcast back through the framework bcast. The whole schedule
+is a `lax.scan`, so reverse-mode AD yields the pipelined backward (the
+transposed ppermutes run the bubble in reverse) without hand-written
+backward plumbing — the functional-transform payoff of building on jax.
+
+Reference framing: ACCL has no model parallelism (SURVEY.md §2.7) — this
+is TPU-native capability on top of the collective substrate, like ring
+attention and Ulysses (parallel/ring_attention.py, ulysses.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..sequencer import schedules
+
+
+def gpipe_schedule(x_mb, stage_fn, *, axis: str, world: int, wire):
+    """Run `stage_fn` as a P-stage pipeline over the named axis.
+
+    x_mb: (M, ...) microbatches (replicated across the axis; rank 0
+    injects them). stage_fn: rank-local stage body (closed over the
+    rank's stage parameters), shape-preserving. Returns the (M, ...)
+    pipeline outputs, replicated on every rank.
+    """
+    M = x_mb.shape[0]
+    me = lax.axis_index(axis)
+    steps = M + world - 1
+    # no wrap edge: rank 0 always injects fresh microbatches, so the
+    # (P-1 -> 0) hop would be a dead full-tensor transfer every step
+    perm = [(i, i + 1) for i in range(world - 1)]
+
+    def step(carry, t):
+        buf, outs = carry
+        # rank 0 injects microbatch t; downstream ranks consume the hop
+        inject = x_mb[jnp.clip(t, 0, M - 1)]
+        x_in = jnp.where(me == 0, inject, buf)
+        active = (t - me >= 0) & (t - me < M)
+        y = stage_fn(x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # the last stage retires microbatch t - (P-1)
+        idx = jnp.clip(t - (world - 1), 0, M - 1)
+        retire = active & (me == world - 1)
+        outs = outs.at[idx].set(jnp.where(retire, y, outs[idx]))
+        buf = wire.ppermute(y, axis, perm)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+    (_, outs), _ = lax.scan(step, (buf0, outs0), jnp.arange(steps))
+    # replicate the last stage's outputs (framework bcast). The bcast
+    # transpose SUMS the per-rank cotangents, and SPMD losses are computed
+    # identically on every rank (the codebase-wide convention), so the
+    # output carries an identity-forward / divide-by-P-backward descale:
+    # P replicated cotangents then sum to exactly one contribution.
+    flat = schedules.bcast_bin_tree_schedule(
+        outs.reshape(-1), root=world - 1, axis=axis, world=world, wire=wire
+    )
+    return _replica_grad_descale(flat.reshape(outs.shape), world)
+
+
+def _replica_grad_descale(x, k: int):
+    """Identity in the forward pass; scales the cotangent by 1/k (so k
+    identical replicated cotangents account for one logical loss)."""
+    if k == 1:
+        return x
+    inv = 1.0 / k
+    return x * inv + lax.stop_gradient(x * (1.0 - inv))
+
+
+def make_gpipe_mlp_forward(mesh, *, n_microbatches: int, pp_axis: str = "pp"):
+    """Demo pipelined model: a stack of pp_world identical MLP blocks,
+    block i living on pp rank i. Returns a jitted fn
+    (stacked_params, x) -> y where stacked_params leaves have a leading
+    (pp_world, ...) stage dim sharded over the axis and x is (B, D)."""
+    from jax.sharding import PartitionSpec as P
+
+    world = mesh.shape[pp_axis]
+    wire = schedules.Wire(None)
+
+    def body(params, x):
+        # params leaves arrive as (1, ...) local stage slices
+        local = jax.tree.map(lambda p: p[0], params)
+
+        def stage(h):
+            z = jnp.tanh(h @ local["w1"] + local["b1"])
+            return h + z @ local["w2"]
+
+        mb = x.reshape((n_microbatches, -1) + x.shape[1:])
+        out = gpipe_schedule(mb, stage, axis=pp_axis, world=world, wire=wire)
+        return out.reshape(x.shape)
+
+    pspec = {"w1": P(pp_axis), "b1": P(pp_axis), "w2": P(pp_axis)}
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(pspec, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def init_gpipe_mlp(key, *, n_stages: int, d_model: int, d_hidden: int):
+    """Stacked stage parameters: leading dim = pipeline stage."""
+    k1, k2 = jax.random.split(key)
+    s = 0.1
+    return {
+        "w1": (jax.random.normal(k1, (n_stages, d_model, d_hidden)) * s
+               ).astype(jnp.float32),
+        "b1": jnp.zeros((n_stages, d_hidden), jnp.float32),
+        "w2": (jax.random.normal(k2, (n_stages, d_hidden, d_model)) * s
+               ).astype(jnp.float32),
+    }

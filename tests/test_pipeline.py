@@ -1,0 +1,99 @@
+"""Pipeline-parallel (GPipe) tests: depth-sharded stages over a pp axis,
+microbatches hopping through the framework wire."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accl_tpu.parallel.pipeline import (
+    gpipe_schedule,
+    init_gpipe_mlp,
+    make_gpipe_mlp_forward,
+)
+
+RNG = np.random.default_rng(66)
+
+
+def _reference(params, x):
+    """Sequential application of all stages on one device."""
+    h = x
+    for i in range(params["w1"].shape[0]):
+        z = np.tanh(h @ np.asarray(params["w1"][i]) + np.asarray(params["b1"][i]))
+        h = h + z @ np.asarray(params["w2"][i])
+    return h
+
+
+def _mesh(pp):
+    return Mesh(np.array(jax.devices()[:pp]).reshape(pp), ("pp",))
+
+
+@pytest.mark.parametrize("pp,mb", [(4, 4), (4, 8), (8, 4), (2, 2)])
+def test_gpipe_matches_sequential(pp, mb):
+    """The P-stage pipeline must equal sequential stage application —
+    fill/drain bubbles and the retire/broadcast bookkeeping cancel out."""
+    d = 16
+    params = init_gpipe_mlp(jax.random.key(0), n_stages=pp, d_model=d,
+                            d_hidden=32)
+    batch = mb * 3
+    x = RNG.standard_normal((batch, d)).astype(np.float32)
+
+    mesh = _mesh(pp)
+    sharded = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P("pp"))), params)
+    fwd = make_gpipe_mlp_forward(mesh, n_microbatches=mb)
+    out = np.asarray(fwd(sharded, x))
+    np.testing.assert_allclose(out, _reference(params, x), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_gpipe_differentiable():
+    """Reverse-mode AD through the scanned pipeline: grads of a scalar
+    loss w.r.t. every stage's weights match the sequential model's."""
+    pp, mb, d = 4, 4, 8
+    params = init_gpipe_mlp(jax.random.key(1), n_stages=pp, d_model=d,
+                            d_hidden=16)
+    x = RNG.standard_normal((mb * 2, d)).astype(np.float32)
+
+    # sequential reference grads on one device
+    def seq_loss(p):
+        h = jnp.asarray(x)
+        for i in range(pp):
+            z = jnp.tanh(h @ p["w1"][i] + p["b1"][i])
+            h = h + z @ p["w2"][i]
+        return jnp.sum(h ** 2)
+
+    ref_grads = jax.grad(seq_loss)(params)
+
+    mesh = _mesh(pp)
+    from accl_tpu.sequencer import schedules
+    wire = schedules.Wire(None)
+
+    def body(p, xv):
+        def loss_fn(pl):
+            loc = jax.tree.map(lambda q: q[0], pl)
+
+            def st(h):
+                z = jnp.tanh(h @ loc["w1"] + loc["b1"])
+                return h + z @ loc["w2"]
+
+            mbx = xv.reshape((mb, -1, xv.shape[-1]))
+            out = gpipe_schedule(mbx, st, axis="pp", world=pp, wire=wire)
+            return jnp.sum(out ** 2)
+
+        return jax.grad(loss_fn)(p)
+
+    gfn = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=({k: P("pp") for k in params}, P()),
+        out_specs={k: P("pp") for k in params},
+        check_vma=False,
+    ))
+    grads = gfn(jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P("pp"))), params), x)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]),
+                                   rtol=5e-4, atol=5e-5,
+                                   err_msg=f"stage grads for {k}")
