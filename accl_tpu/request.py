@@ -96,8 +96,12 @@ class TPURequest(BaseRequest):
                 for o in self.outputs:
                     _fetch_probe(o)
             self.complete(0)
-        except Exception:
-            self.complete(-1)
+        except Exception as e:
+            # surface runtime failures through the sticky-error-word
+            # contract (reference: every engine ORs its bits into the
+            # retcode, ccl_offload_control.h:139-167) instead of an
+            # unclassified -1; the original exception still propagates
+            self.complete(_classify_runtime_error(e))
             raise
         if self._on_complete is not None:
             self._on_complete(self)
@@ -196,6 +200,20 @@ class ParkedRecvRequest(BaseRequest):
         if time.monotonic() >= self._deadline and self.claim():
             return self._timeout_fire()
         return False
+
+
+def _classify_runtime_error(e: Exception) -> int:
+    """Map an XLA/runtime exception onto the closest sticky error bits
+    (the TPU path cannot set bits from inside a compiled program the way
+    the firmware engines do, so host-visible failures are classified at
+    completion time)."""
+    msg = str(e).lower()
+    if "resource_exhausted" in msg or "out of memory" in msg or "oom" in msg:
+        return int(ErrorCode.DMA_SIZE_ERROR)
+    if "deadline" in msg or "timeout" in msg or "timed out" in msg:
+        return int(ErrorCode.DMA_TIMEOUT_ERROR
+                   | ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    return int(ErrorCode.DMA_INTERNAL_ERROR)
 
 
 _fetch_probe_needed: bool | None = None

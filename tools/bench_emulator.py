@@ -5,7 +5,8 @@ The Coyote benchmark app analog (reference test/host/Coyote/test.cpp:
 per-collective latency/throughput logging with eager/rendezvous and
 buffer-placement switches, results to accl_log/*.log): sweeps message
 sizes across both protocols over N emulator ranks and writes
-accl_log/emu_bench.csv (Collective,Protocol,Bytes,Seconds,GBps).
+accl_log/emu_bench.csv — or emu_bench_udp.csv with --transport udp —
+(Collective,Protocol,Bytes,Seconds,GBps).
 """
 
 import argparse
@@ -23,17 +24,22 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--world", type=int, default=4)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--transport", choices=("tcp", "udp"), default="tcp",
+                    help="session TCP mesh or sessionless datagram POE")
     args = ap.parse_args()
 
     from accl_tpu import ReduceFunction
     from accl_tpu.device.emu_device import EmuWorld
 
-    w = EmuWorld(args.world, max_eager=4096, rx_buf_bytes=4096)
+    w = EmuWorld(args.world, max_eager=4096, rx_buf_bytes=4096,
+                 transport=args.transport)
     rows = []
     try:
         for nbytes in (1024, 4096, 65536, 1 << 20, 4 << 20):
             count = nbytes // 4
-            proto = "eager" if nbytes <= 4096 else "rndzv"
+            # the datagram POE is eager-only (no rendezvous message types)
+            proto = ("eager" if nbytes <= 4096 or args.transport == "udp"
+                     else "rndzv")
             for name in ("allreduce", "bcast", "allgather"):
                 def body(rank, i, _name=name, _n=count):
                     x = np.ones(_n, np.float32)
@@ -61,11 +67,13 @@ def main():
 
     outdir = REPO / "accl_log"
     outdir.mkdir(exist_ok=True)
-    with open(outdir / "emu_bench.csv", "w") as f:
+    csv = outdir / ("emu_bench.csv" if args.transport == "tcp"
+                    else "emu_bench_udp.csv")
+    with open(csv, "w") as f:
         f.write("Collective,Protocol,Bytes,Seconds,GBps\n")
         for r in rows:
             f.write(f"{r[0]},{r[1]},{r[2]},{r[3]:.6e},{r[4]:.3f}\n")
-    print(f"wrote {outdir/'emu_bench.csv'} ({len(rows)} rows)")
+    print(f"wrote {csv} ({len(rows)} rows)")
 
 
 if __name__ == "__main__":
