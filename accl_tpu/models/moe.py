@@ -66,6 +66,14 @@ def moe_param_specs(cfg: MoEConfig) -> dict:
     }
 
 
+def place_moe_params(params, cfg: MoEConfig, mesh: Mesh):
+    """Place a global MoE parameter pytree according to moe_param_specs."""
+    specs = moe_param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
 def _capacity(cfg: MoEConfig, tokens: int) -> int:
     return max(1, int(np.ceil(tokens / cfg.n_experts * cfg.capacity_factor)))
 
@@ -144,6 +152,7 @@ def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
                                       wire=wire)
 
         x = jax.vmap(per_seq)(x)
+        x = x * lax.rsqrt(jnp.mean(jnp.square(x), -1, keepdims=True) + 1e-6)
         return jnp.einsum("btd,dv->btv", x, params["unembed"])
 
     # tokens shard over BOTH axes (true expert parallelism: every rank
@@ -176,6 +185,7 @@ def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
                                       wire=wire)
 
         x = jax.vmap(per_seq)(x)
+        x = x * lax.rsqrt(jnp.mean(jnp.square(x), -1, keepdims=True) + 1e-6)
         logits = jnp.einsum("btd,dv->btv", x, params["unembed"])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
         nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
@@ -253,4 +263,5 @@ def moe_reference_forward(params, tokens, cfg: MoEConfig):
         return xi + moe
 
     x = jax.vmap(per_seq)(x)
+    x = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), -1, keepdims=True) + 1e-6)
     return jnp.einsum("btd,dv->btv", x, params["unembed"])

@@ -1,15 +1,17 @@
 #!/usr/bin/env python3
-"""Runnable demo: train the flagship transformer LM with dp x sp x tp
-parallelism routed entirely through accl-tpu schedules, with
-checkpoint/resume.
+"""Runnable demo: train a model with its parallelism routed entirely
+through accl-tpu schedules, with checkpoint/resume.
 
-Checkpointing is a TPU-first extension past the reference (which, as a
-collectives library, has none — SURVEY.md §5): parameters save/restore
-via orbax so an interrupted run resumes exactly.
+Two model families: the dense dp x sp x tp transformer (default) and the
+expert-parallel MoE (--model moe, dp x ep with dispatch/combine through
+the framework alltoall). Checkpointing is a TPU-first extension past the
+reference (which, as a collectives library, has none — SURVEY.md §5):
+parameters save/restore via orbax so an interrupted run resumes exactly.
 
 Usage:
   python examples/train_lm.py --steps 20 --ckpt /tmp/accl_ckpt
   python examples/train_lm.py --steps 20 --ckpt /tmp/accl_ckpt  # resumes
+  python examples/train_lm.py --model moe --steps 20
 """
 
 import argparse
@@ -25,6 +27,7 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--ckpt", default=None, help="checkpoint directory")
     ap.add_argument("--cpu-devices", type=int, default=8)
+    ap.add_argument("--model", choices=("dense", "moe"), default="dense")
     args = ap.parse_args()
 
     import jax
@@ -38,18 +41,55 @@ def main():
 
     import numpy as np
 
-    from accl_tpu.models import TransformerConfig, init_params, make_train_step
-    from accl_tpu.models.transformer import demo_batch, shard_params
     from accl_tpu.parallel import factorize_devices, make_mesh
 
-    axes = factorize_devices(len(jax.devices()))
-    mesh = make_mesh(axes)
-    heads = max(4, axes["tp"] * 2)
-    cfg = TransformerConfig(vocab=128, d_model=heads * 8, n_heads=heads,
-                            n_layers=2, d_ff=heads * 16)
-    print(f"mesh {axes}; model d={cfg.d_model} heads={cfg.n_heads}")
+    n_dev = len(jax.devices())
+    if args.model == "moe":
+        from accl_tpu.models import (MoEConfig, init_moe_params,
+                                     make_moe_train_step)
+        from accl_tpu.models.moe import place_moe_params
 
-    params = init_params(cfg, jax.random.key(0))
+        ep = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+        dp = n_dev // ep
+        axes = {"dp": dp, "ep": ep}
+        mesh = make_mesh(axes)
+        cfg = MoEConfig(d_model=64, d_ff=128, n_experts=ep,
+                        experts_per_rank=1, vocab=128, seq=32)
+        print(f"mesh {axes}; MoE with {cfg.n_experts} experts")
+        params = init_moe_params(cfg, jax.random.key(0))
+
+        def place(p):
+            return place_moe_params(p, cfg, mesh)
+
+        def make_batch():
+            rng = np.random.default_rng(0)
+            b = 2 * n_dev
+            tokens = rng.integers(0, cfg.vocab, (b, cfg.seq)).astype(np.int32)
+            return tokens, np.roll(tokens, -1, 1)
+
+        step = make_moe_train_step(cfg, mesh, lr=3e-2)
+    else:
+        from accl_tpu.models import (TransformerConfig, init_params,
+                                     make_train_step)
+        from accl_tpu.models.transformer import demo_batch, shard_params
+
+        axes = factorize_devices(n_dev)
+        mesh = make_mesh(axes)
+        heads = max(4, axes["tp"] * 2)
+        cfg = TransformerConfig(vocab=128, d_model=heads * 8, n_heads=heads,
+                                n_layers=2, d_ff=heads * 16)
+        print(f"mesh {axes}; model d={cfg.d_model} heads={cfg.n_heads}")
+        params = init_params(cfg, jax.random.key(0))
+
+        def place(p):
+            return shard_params(p, cfg, mesh)
+
+        def make_batch():
+            return demo_batch(cfg, mesh, batch=max(2, axes["dp"] * 2),
+                              seq=max(32, axes["sp"] * 16))
+
+        step = make_train_step(cfg, mesh, lr=3e-2)
+
     start_step = 0
 
     ckptr = None
@@ -67,10 +107,8 @@ def main():
             params = ckptr.restore(latest[-1], params)
             print(f"resumed from {latest[-1]}")
 
-    params = shard_params(params, cfg, mesh)
-    tokens, targets = demo_batch(cfg, mesh, batch=max(2, axes["dp"] * 2),
-                                 seq=max(32, axes["sp"] * 16))
-    step = make_train_step(cfg, mesh, lr=3e-2)
+    params = place(params)
+    tokens, targets = make_batch()
 
     for s in range(start_step, start_step + args.steps):
         params, loss = step(params, tokens, targets)

@@ -11,8 +11,8 @@ from accl_tpu.models.moe import (
     init_moe_params,
     make_moe_forward,
     make_moe_train_step,
-    moe_param_specs,
     moe_reference_forward,
+    place_moe_params,
 )
 
 RNG = np.random.default_rng(44)
@@ -24,10 +24,7 @@ def _mesh(dp, ep):
 
 
 def _place(params, cfg, mesh):
-    specs = moe_param_specs(cfg)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs, is_leaf=lambda x: isinstance(x, P))
+    return place_moe_params(params, cfg, mesh)
 
 
 def _batch(cfg, batch):
