@@ -31,6 +31,8 @@ def gpipe_schedule(x_mb, stage_fn, *, axis: str, world: int, wire):
     rank's stage parameters), shape-preserving. Returns the (M, ...)
     pipeline outputs, replicated on every rank.
     """
+    if world == 1:  # single stage: no hops, no bubbles
+        return jax.vmap(stage_fn)(x_mb)
     M = x_mb.shape[0]
     me = lax.axis_index(axis)
     steps = M + world - 1
