@@ -13,8 +13,9 @@ Topology: a two-tier mesh (outer axis = processes/hosts over DCN, inner
 axis = local devices over ICI), global rank = process * local + device
 (process-major, so each process's buffer rows are contiguous). Collectives
 with a bandwidth-optimal two-tier decomposition (allreduce,
-reduce_scatter, allgather, bcast — sequencer/hierarchical.py) lower to it
-so the slow tier carries 1/inner_world of the traffic; everything else
+reduce_scatter, allgather, bcast, alltoall — sequencer/hierarchical.py)
+lower to it so the slow tier carries 1/inner_world of the traffic (or,
+for alltoall, one aggregated transfer per host pair); everything else
 lowers flat over the combined (outer, inner) axis, which JAX treats as one
 named ring in process-major order.
 
@@ -34,6 +35,7 @@ from ..constants import Operation, ReduceFunction
 from ..sequencer.hierarchical import (
     hierarchical_allgather_schedule,
     hierarchical_allreduce_schedule,
+    hierarchical_alltoall_schedule,
     hierarchical_bcast_schedule,
     hierarchical_reduce_scatter_schedule,
 )
@@ -44,14 +46,14 @@ from .tpu_device import TPUDevice
 
 class DCNCompiler(ScheduleCompiler):
     """Two-tier lowering over (outer, inner): hierarchical compositions
-    for the four ops that have one whenever both tiers are wider than 1,
+    for the ops that have one whenever both tiers are wider than 1,
     flat combined-axis schedules otherwise. Outputs are adapted from the
     compositions' inner-major chunk order to the device's process-major
     rank numbering with local (on-device) transposes."""
 
     HIER_OPS = frozenset(
         {Operation.allreduce, Operation.reduce_scatter,
-         Operation.allgather, Operation.bcast}
+         Operation.allgather, Operation.bcast, Operation.alltoall}
     )
 
     def __init__(self, mesh, outer_axis: str, inner_axis: str,
@@ -84,6 +86,9 @@ class DCNCompiler(ScheduleCompiler):
         if op == Operation.allreduce:
             body = functools.partial(
                 hierarchical_allreduce_schedule, func=func, **common)
+        elif op == Operation.alltoall:
+            # already process-major on both ends — no reorder needed
+            body = functools.partial(hierarchical_alltoall_schedule, **common)
         elif op == Operation.bcast:
             root = options.root_src_dst
             body = functools.partial(

@@ -92,6 +92,32 @@ def hierarchical_allgather_schedule(
     )
 
 
+def hierarchical_alltoall_schedule(
+    x, *, inner_axis, outer_axis, inner_world, outer_world, wire,
+):
+    """Two-tier alltoall under OUTER-MAJOR global ranks (g = outer_pos *
+    inner_world + inner_pos, the DCN backend's process-major numbering):
+    stage 1 redistributes over the fast tier so each device holds every
+    local source's chunks for its own inner position; stage 2 crosses the
+    slow tier once per remote host with an aggregated inner_world*c block
+    instead of inner_world separate messages. Bytes moved are inherent to
+    alltoall; the win is (P-1) aggregated DCN transfers instead of
+    (P-1)*L small ones. Input chunks are destination-ordered outer-major;
+    output chunks are source-ordered outer-major (the flat alltoall
+    contract)."""
+    L, P = inner_world, outer_world
+    c = x.shape[-1] // (L * P)
+    # group by inner destination: block l' carries my chunks for every
+    # host's device l' -> inner alltoall lands them on local device l'
+    s1 = x.reshape(P, L, c).transpose(1, 0, 2).reshape(-1)
+    r1 = schedules.alltoall_schedule(s1, axis=inner_axis, world=L, wire=wire)
+    # r1 = (l_src, p_dst, c); regroup by destination host and cross DCN
+    s2 = r1.reshape(L, P, c).transpose(1, 0, 2).reshape(-1)
+    r2 = schedules.alltoall_schedule(s2, axis=outer_axis, world=P, wire=wire)
+    # r2 = (p_src, l_src, c) == source-ordered outer-major
+    return r2
+
+
 def hierarchical_bcast_schedule(
     x, *, root_inner: int, root_outer: int, inner_axis, outer_axis,
     inner_world, outer_world, wire,

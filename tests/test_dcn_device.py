@@ -64,15 +64,25 @@ def test_dcn_allgather_reduce_scatter_order(dcn_accl):
                                    rtol=1e-4, atol=1e-4)
 
 
-def test_dcn_flat_fallback_and_p2p(dcn_accl):
-    """Ops without a two-tier form run flat over the combined axis in
-    process-major rank order."""
+def test_dcn_hierarchical_alltoall(dcn_accl):
+    """Two-tier alltoall: DCN crosses once per host pair with aggregated
+    blocks; semantics must equal the flat alltoall exactly."""
     a = dcn_accl
     x = RNG.standard_normal((8, 32)).astype(np.float32)
     ts, tr = a.create_buffer(32, data=x), a.create_buffer(32)
     a.alltoall(ts, tr, 4)
     exp = x.reshape(8, 8, 4).transpose(1, 0, 2).reshape(8, 32)
     np.testing.assert_allclose(tr.host, exp, rtol=0)
+
+
+def test_dcn_flat_fallback_and_p2p(dcn_accl):
+    """Ops without a two-tier form run flat over the combined axis in
+    process-major rank order."""
+    a = dcn_accl
+    x = RNG.standard_normal((8, 32)).astype(np.float32)
+    gs, gb = a.create_buffer(32, data=x), a.create_buffer(32 * 8)
+    a.gather(gs, gb, 32, root=3)
+    np.testing.assert_allclose(gb.host[3], x.reshape(-1), rtol=0)
 
     sb = a.create_buffer(32, data=x)
     rv = a.create_buffer(32)
@@ -91,7 +101,8 @@ def test_dcn_split_rejected_and_selection(dcn_accl):
     from accl_tpu.constants import Operation
 
     assert Operation.allreduce in DCNCompiler.HIER_OPS
-    assert Operation.alltoall not in DCNCompiler.HIER_OPS
+    assert Operation.alltoall in DCNCompiler.HIER_OPS
+    assert Operation.gather not in DCNCompiler.HIER_OPS
 
 
 def test_dcn_single_tier_degenerates_flat():
