@@ -447,6 +447,11 @@ class ACCL:
         import dataclasses
 
         parent = self.communicators[0].ranks
+        # backend topology constraints fail HERE, before any exchange
+        # memory is allocated for the group
+        validate = getattr(self.cclo, "validate_split", None)
+        if validate is not None:
+            validate(tuple(parent[r].device_index for r in rank_indices))
         ranks = [
             dataclasses.replace(parent[r], inbound_seq=0, outbound_seq=0)
             for r in rank_indices

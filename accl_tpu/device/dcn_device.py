@@ -165,7 +165,13 @@ class DCNBuffer(TPUBuffer):
 class DCNDevice(TPUDevice):
     """Multi-process/multi-host device backend over a (dcn, ici) mesh."""
 
-    supports_split = False  # sub-communicators over DCN: future round
+    # sub-communicators are supported for OUTER-ALIGNED groups: members
+    # must be the full inner (ici) groups of a subset of hosts, because a
+    # cross-host program involves exactly the processes owning its
+    # devices. A within-one-host group therefore selects the flat
+    # ICI-only path while the world communicator selects the hierarchical
+    # compositions — communicator-driven flat-vs-hierarchical selection.
+    supports_split = True
     buffer_class = DCNBuffer
 
     def __init__(
@@ -232,10 +238,54 @@ class DCNDevice(TPUDevice):
         me = jax.process_index()
         return [i for i, d in enumerate(flat) if d.process_index == me]
 
-    def _comm_ctx(self, comm_addr: int):
-        ctx = super()._comm_ctx(comm_addr)
-        if ctx.rows is not None:
+    def validate_split(self, rows: tuple) -> None:
+        """Members must be outer-aligned (whole inner groups of a host
+        subset): a compiled program involves exactly the processes owning
+        its devices, and partial hosts would strand devices. Checked at
+        split() time so a bad group never allocates exchange memory."""
+        L = self.mesh.shape[self.inner_axis]
+        if len(rows) % L or any(
+            rows[i * L + j] != rows[i * L] + j or rows[i * L] % L
+            for i in range(len(rows) // L)
+            for j in range(L)
+        ):
             raise NotImplementedError(
-                "sub-communicators on the DCN backend are not supported yet; "
-                "use the default world communicator")
-        return ctx
+                f"DCN sub-communicators must be whole-host groups "
+                f"(members aligned to inner groups of {L}); got {rows}")
+
+    def _make_group_ctx(self, rows: tuple):
+        """Sub-communicator context as a two-tier sub-mesh."""
+        from .tpu_device import _CommCtx
+
+        self.validate_split(rows)
+        L = self.mesh.shape[self.inner_axis]
+        devices = self.mesh.devices.reshape(-1)
+        sub_mesh = Mesh(
+            np.array([devices[r] for r in rows]).reshape(len(rows) // L, L),
+            (self.outer_axis, self.inner_axis))
+        compiler = DCNCompiler(sub_mesh, self.outer_axis, self.inner_axis,
+                               arith_table=self.compiler.arith_table)
+        return _CommCtx(len(rows), sub_mesh, compiler, rows)
+
+    def _member_process(self, ctx) -> bool:
+        """Does this process own any device of the communicator?"""
+        if ctx.rows is None:
+            return True
+        me = jax.process_index()
+        flat = self.mesh.devices.reshape(-1)
+        return any(flat[r].process_index == me for r in ctx.rows)
+
+    def start(self, options):
+        if options.scenario != Operation.config:
+            ctx = self._comm_ctx(options.comm_addr)
+            if not self._member_process(ctx):
+                # MPI semantics: a collective on a communicator this host
+                # is not part of is a no-op here (the member hosts run it)
+                from ..request import BaseRequest
+
+                req = BaseRequest(options.scenario.name)
+                req.running()
+                req.complete(0)
+                return req
+        return super().start(options)
+

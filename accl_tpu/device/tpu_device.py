@@ -147,22 +147,27 @@ class TPUDevice(CCLODevice):
             # context, so re-splits reuse the compiled schedules
             ctx = self._group_cache.get(rows)
             if ctx is None:
-                from jax.sharding import Mesh
-
-                devices = self.mesh.devices.reshape(-1)
-                sub_mesh = Mesh(np.array([devices[r] for r in rows]),
-                                (self.axis_name,))
-                compiler = ScheduleCompiler(
-                    sub_mesh, self.axis_name,
-                    arith_table=self.compiler.arith_table,
-                    use_pallas_ring=self.compiler.use_pallas_ring,
-                )
-                ctx = _CommCtx(len(rows), sub_mesh, compiler, rows)
+                ctx = self._make_group_ctx(rows)
                 self._group_cache[rows] = ctx
         self._comm_cache[comm_addr] = ctx
         if table_words:
             self._comm_extents[comm_addr] = comm_addr + 4 * table_words
         return ctx
+
+    def _make_group_ctx(self, rows: tuple) -> "_CommCtx":
+        """Build the execution context for a sub-communicator (overridden
+        by backends with a different mesh topology)."""
+        from jax.sharding import Mesh
+
+        devices = self.mesh.devices.reshape(-1)
+        sub_mesh = Mesh(np.array([devices[r] for r in rows]),
+                        (self.axis_name,))
+        compiler = ScheduleCompiler(
+            sub_mesh, self.axis_name,
+            arith_table=self.compiler.arith_table,
+            use_pallas_ring=self.compiler.use_pallas_ring,
+        )
+        return _CommCtx(len(rows), sub_mesh, compiler, rows)
 
     def write(self, addr: int, value: int) -> None:
         # a write into a cached communicator table invalidates that cache
@@ -174,28 +179,38 @@ class TPUDevice(CCLODevice):
                 self._comm_extents.pop(start, None)
         super().write(addr, value)
 
+    def validate_split(self, rows: tuple) -> None:
+        """Reject an unsupported rank group BEFORE the facade allocates
+        exchange memory for it (backends with topology constraints
+        override; the base single-controller mesh accepts any subset)."""
+
     def _rows_to_submesh(self, arr, ctx: "_CommCtx", n: int):
         """View the member rows of a full-world stacked buffer as a
         (group, n) array on the sub-mesh. Each row already lives on its
-        member device, so this is shard re-labelling, not data movement."""
+        member device, so this is shard re-labelling, not data movement.
+        Non-addressable devices (remote hosts on a multi-process backend)
+        contribute their own shards from their own processes."""
         from jax.sharding import NamedSharding, PartitionSpec
 
         by_dev = {s.device: s.data for s in arr.addressable_shards}
-        shards = [by_dev[d][..., :n] for d in ctx.mesh.devices.reshape(-1)]
+        shards = [by_dev[d][..., :n]
+                  for d in ctx.mesh.devices.reshape(-1) if d in by_dev]
         sharding = NamedSharding(ctx.mesh, PartitionSpec(self.axis_name))
         return jax.make_array_from_single_device_arrays(
             (ctx.world, n), sharding, shards)
 
     def _scatter_rows(self, full, ctx: "_CommCtx", out):
         """Write a sub-communicator result back into the member rows of a
-        full-world buffer, leaving non-member rows untouched."""
+        full-world buffer, leaving non-member rows (and remote hosts'
+        rows, which their own processes assemble) untouched."""
         by_dev = {s.device: s.data for s in full.addressable_shards}
         out_by_dev = {s.device: s.data for s in out.addressable_shards}
         shards = []
-        member_devs = set(out_by_dev)
         for d in self.mesh.devices.reshape(-1):
+            if d not in by_dev:
+                continue  # remote device on a multi-process backend
             cur = by_dev[d]
-            if d in member_devs:
+            if d in out_by_dev:
                 new = out_by_dev[d].astype(cur.dtype)
                 if new.shape[-1] != cur.shape[-1]:
                     new = cur.at[..., : new.shape[-1]].set(new)

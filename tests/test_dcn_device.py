@@ -92,12 +92,32 @@ def test_dcn_flat_fallback_and_p2p(dcn_accl):
     a.barrier()
 
 
-def test_dcn_split_rejected_and_selection(dcn_accl):
-    with pytest.raises(NotImplementedError):
-        dcn_accl.split([0, 1])
-    # selection: two-tier ops compile hierarchical, others flat
-    comp = dcn_accl.cclo.compiler
-    assert isinstance(comp, DCNCompiler)
+def test_dcn_sub_communicators_and_selection(dcn_accl):
+    """Outer-aligned sub-communicators work (a within-one-host group runs
+    the flat ICI-only path — communicator-driven flat-vs-hierarchical
+    selection); misaligned groups are rejected loudly."""
+    a = dcn_accl
+    host0 = a.split([0, 1, 2, 3])  # dcn row 0: whole inner group
+    x = RNG.standard_normal((8, 24)).astype(np.float32)
+    sb, rb = a.create_buffer(24, data=x), a.create_buffer(24)
+    a.allreduce(sb, rb, 24, ReduceFunction.SUM, comm=host0)
+    np.testing.assert_allclose(rb.host[:4], np.tile(x[:4].sum(0), (4, 1)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(rb.host[4:], 0.0)  # non-members untouched
+
+    # the group's context degenerates to outer=1: flat ICI-only selection
+    ctx = a.cclo._comm_ctx(host0.exchmem_addr)
+    assert dict(ctx.mesh.shape) == {"dcn": 1, "ici": 4}
+    assert isinstance(ctx.compiler, DCNCompiler)
+
+    # misaligned group (partial host): rejected AT split() time, before
+    # any exchange memory is allocated
+    n_comms = len(a.communicators)
+    with pytest.raises(NotImplementedError, match="whole-host"):
+        a.split([0, 1])
+    assert len(a.communicators) == n_comms  # nothing leaked
+
+    # world-communicator selection stays hierarchical
     from accl_tpu.constants import Operation
 
     assert Operation.allreduce in DCNCompiler.HIER_OPS

@@ -90,6 +90,22 @@ def main() -> int:
     if dst in rows:
         np.testing.assert_allclose(pv.host[dst], x[src, :16], rtol=0)
 
+    # outer-aligned sub-communicator: host 0's whole inner group. Every
+    # process issues the same call; non-member hosts no-op (MPI
+    # semantics), member hosts run the flat ICI-only path.
+    stage("subcomm")
+    local = world // args.procs
+    host0 = a.split(list(range(local)))
+    cb, cr = a.create_buffer(24, data=x[:, :24]), a.create_buffer(24)
+    a.allreduce(cb, cr, 24, ReduceFunction.SUM, comm=host0)
+    if args.proc_id == 0:
+        for r in rows:
+            np.testing.assert_allclose(cr.host[r], x[:local, :24].sum(0),
+                                       rtol=1e-4, atol=1e-4)
+    else:
+        for r in rows:
+            np.testing.assert_allclose(cr.host[r], 0.0)
+
     stage("barrier")
     a.barrier()
     print(f"RANKS {rows} proc {args.proc_id}/{args.procs} OK", flush=True)
