@@ -268,12 +268,18 @@ class DCNDevice(TPUDevice):
         return _CommCtx(len(rows), sub_mesh, compiler, rows)
 
     def _member_process(self, ctx) -> bool:
-        """Does this process own any device of the communicator?"""
+        """Does this process own any device of the communicator?
+        Membership is immutable per context, so it is computed once and
+        cached on the ctx (start() is the dispatch hot path)."""
         if ctx.rows is None:
             return True
-        me = jax.process_index()
-        flat = self.mesh.devices.reshape(-1)
-        return any(flat[r].process_index == me for r in ctx.rows)
+        member = getattr(ctx, "_member_here", None)
+        if member is None:
+            me = jax.process_index()
+            flat = self.mesh.devices.reshape(-1)
+            member = any(flat[r].process_index == me for r in ctx.rows)
+            ctx._member_here = member
+        return member
 
     def start(self, options):
         if options.scenario != Operation.config:

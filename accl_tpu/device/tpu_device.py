@@ -490,13 +490,14 @@ class _CommCtx:
     schedule compiler, and the member rows of full-world buffers (None for
     the default full-axis communicator)."""
 
-    __slots__ = ("world", "mesh", "compiler", "rows")
+    __slots__ = ("world", "mesh", "compiler", "rows", "_member_here")
 
     def __init__(self, world, mesh, compiler, rows):
         self.world = world
         self.mesh = mesh
         self.compiler = compiler
         self.rows = rows
+        self._member_here = None  # lazy per-process membership cache
 
 
 def _slice_to(arr, n: int):

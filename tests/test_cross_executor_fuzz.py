@@ -1,0 +1,161 @@
+"""Cross-executor differential fuzz: one rule set, two executors.
+
+The load-bearing design claim (docs/architecture.md) is that plan.py's
+selection rules drive BOTH the XLA schedule path and the native C++
+runtime to the same semantics. This suite samples randomized call
+configurations — collective, world size, count, reduce function, eager
+threshold, tuning registers, wire compression — and checks both
+executors against a numpy oracle. Seeded, so failures reproduce.
+
+The reference has nothing comparable (its two targets share one source);
+here the executors are independent implementations, which is exactly why
+the differential harness earns its keep.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from accl_tpu import (
+    CallOptions,
+    CompressionFlags,
+    DataType,
+    Operation,
+    ReduceFunction,
+    TuningParams,
+)
+from accl_tpu.device.base import CCLOAddr
+from accl_tpu.device.emu_device import EmuWorld
+from accl_tpu.sequencer import select_algorithm
+from accl_tpu.sequencer.lowering import ScheduleCompiler
+
+OPS = [Operation.bcast, Operation.scatter, Operation.gather,
+       Operation.allgather, Operation.reduce, Operation.allreduce,
+       Operation.reduce_scatter, Operation.alltoall]
+
+N_CONFIGS = 32
+SEED = 1234
+
+
+def _sample_configs():
+    rng = np.random.default_rng(SEED)
+    configs = []
+    for i in range(N_CONFIGS):
+        op = OPS[int(rng.integers(len(OPS)))]
+        world = int(rng.integers(2, 6))
+        count = int(rng.integers(1, 2500))
+        func = ReduceFunction(int(rng.integers(2)))
+        max_eager = int(rng.choice([256, 1024, 4096]))
+        gather_cnt = int(rng.choice([1024, 32 * 1024]))
+        compressed = bool(rng.integers(2)) and op in (
+            Operation.allreduce, Operation.bcast, Operation.reduce)
+        root = int(rng.integers(world))
+        configs.append((i, op, world, count, func, max_eager, gather_cnt,
+                        compressed, root))
+    return configs
+
+
+def _oracle(op, x, func, world, root, compressed):
+    """numpy truth; compressed collectives computed in the fp16 domain."""
+    work = x.astype(np.float16).astype(np.float32) if compressed else x
+    if op == Operation.bcast:
+        return np.tile(work[root], (world, 1))
+    if op == Operation.scatter:
+        n = x.shape[1] // world
+        return np.stack([work[root, r * n:(r + 1) * n] for r in range(world)])
+    if op == Operation.gather:  # only root's row is defined
+        return work.reshape(1, -1)
+    if op == Operation.allgather:
+        return np.tile(work.reshape(-1), (world, 1))
+    red = work.sum(0) if func == ReduceFunction.SUM else work.max(0)
+    if compressed:
+        # reductions accumulate in the fp16 domain on both executors
+        h = x.astype(np.float16)
+        red = (h.sum(0) if func == ReduceFunction.SUM else h.max(0)
+               ).astype(np.float32)
+    if op == Operation.reduce:
+        return red.reshape(1, -1)
+    if op == Operation.allreduce:
+        return np.tile(red, (world, 1))
+    if op == Operation.reduce_scatter:
+        n = x.shape[1] // world
+        return red.reshape(world, n)
+    if op == Operation.alltoall:
+        n = x.shape[1] // world
+        return work.reshape(world, world, n).transpose(1, 0, 2).reshape(
+            world, -1)
+    raise AssertionError(op)
+
+
+def _tolerance(compressed):
+    if compressed:
+        return dict(rtol=2e-2, atol=2e-1)
+    return dict(rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("cfg", _sample_configs(),
+                         ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}")
+def test_cross_executor_agreement(cfg):
+    i, op, world, count, func, max_eager, gather_cnt, compressed, root = cfg
+    rng = np.random.default_rng(SEED + i)
+    in_per_rank = count * world if op in (
+        Operation.scatter, Operation.reduce_scatter, Operation.alltoall
+    ) else count
+    out_elems = count * world if op in (
+        Operation.gather, Operation.allgather, Operation.alltoall
+    ) else count
+    x = rng.standard_normal((world, in_per_rank)).astype(np.float32)
+    comp_flags = (CompressionFlags.ETH_COMPRESSED if compressed
+                  else CompressionFlags.NO_COMPRESSION)
+    expected = _oracle(op, x, func, world, root, compressed)
+    tol = _tolerance(compressed)
+
+    # ---- XLA executor -------------------------------------------------
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    tuning = TuningParams(gather_flat_tree_max_count=gather_cnt)
+    plan = select_algorithm(op, count, 4, world, comp_flags,
+                            max_eager_size=max_eager,
+                            eager_rx_buf_size=max(max_eager, 256),
+                            tuning=tuning)
+    opts = CallOptions(scenario=op, count=count, root_src_dst=root,
+                       function=int(func), compression_flags=comp_flags,
+                       data_type=DataType.float32)
+    fn = ScheduleCompiler(mesh).lower(opts, plan)
+    xla_out = np.asarray(fn(x))
+    if op in (Operation.gather, Operation.reduce):
+        np.testing.assert_allclose(xla_out[root:root + 1], expected, **tol,
+                                   err_msg=f"XLA {op.name} cfg {cfg}")
+    else:
+        np.testing.assert_allclose(xla_out, expected, **tol,
+                                   err_msg=f"XLA {op.name} cfg {cfg}")
+
+    # ---- native executor ---------------------------------------------
+    w = EmuWorld(world, max_eager=max_eager,
+                 rx_buf_bytes=max(max_eager, 256))
+
+    try:
+        def body(rank, r):
+            rank.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, gather_cnt)
+            out = np.zeros(out_elems, np.float32)
+            o = CallOptions(scenario=op, count=count, root_src_dst=root,
+                            function=int(func), compression_flags=comp_flags,
+                            data_type=DataType.float32)
+            send = x[r].copy()
+            if op == Operation.bcast:
+                rank.call(o, op0=send)
+                return send
+            rank.call(o, op0=send, res=out)
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    if op in (Operation.gather, Operation.reduce):
+        native_out = np.asarray(res[root]).reshape(1, -1)
+    else:
+        native_out = np.stack(res)
+    np.testing.assert_allclose(native_out, expected, **tol,
+                               err_msg=f"native {op.name} cfg {cfg}")
