@@ -53,8 +53,9 @@ def _sample_configs():
         compressed = bool(rng.integers(2)) and op in (
             Operation.allreduce, Operation.bcast, Operation.reduce)
         root = int(rng.integers(world))
+        transport = str(rng.choice(["tcp", "udp"]))
         configs.append((i, op, world, count, func, max_eager, gather_cnt,
-                        compressed, root))
+                        compressed, root, transport))
     return configs
 
 
@@ -70,12 +71,13 @@ def _oracle(op, x, func, world, root, compressed):
         return work.reshape(1, -1)
     if op == Operation.allgather:
         return np.tile(work.reshape(-1), (world, 1))
-    red = work.sum(0) if func == ReduceFunction.SUM else work.max(0)
     if compressed:
         # reductions accumulate in the fp16 domain on both executors
         h = x.astype(np.float16)
         red = (h.sum(0) if func == ReduceFunction.SUM else h.max(0)
                ).astype(np.float32)
+    else:
+        red = work.sum(0) if func == ReduceFunction.SUM else work.max(0)
     if op == Operation.reduce:
         return red.reshape(1, -1)
     if op == Operation.allreduce:
@@ -96,10 +98,12 @@ def _tolerance(compressed):
     return dict(rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("cfg", _sample_configs(),
-                         ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}")
+@pytest.mark.parametrize(
+    "cfg", _sample_configs(),
+    ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[9]}")
 def test_cross_executor_agreement(cfg):
-    i, op, world, count, func, max_eager, gather_cnt, compressed, root = cfg
+    (i, op, world, count, func, max_eager, gather_cnt, compressed, root,
+     transport) = cfg
     rng = np.random.default_rng(SEED + i)
     in_per_rank = count * world if op in (
         Operation.scatter, Operation.reduce_scatter, Operation.alltoall
@@ -132,9 +136,10 @@ def test_cross_executor_agreement(cfg):
         np.testing.assert_allclose(xla_out, expected, **tol,
                                    err_msg=f"XLA {op.name} cfg {cfg}")
 
-    # ---- native executor ---------------------------------------------
+    # ---- native executor (transport is also fuzzed: the session TCP
+    # mesh and the sessionless datagram POE must agree too) -------------
     w = EmuWorld(world, max_eager=max_eager,
-                 rx_buf_bytes=max(max_eager, 256))
+                 rx_buf_bytes=max(max_eager, 256), transport=transport)
 
     try:
         def body(rank, r):

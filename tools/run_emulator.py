@@ -38,11 +38,11 @@ def _demo(rank, idx, world):
     return ok
 
 
-def worker(world, idx, ports, script, q):
+def worker(world, idx, ports, script, q, transport="tcp"):
     sys.path.insert(0, str(REPO))
     from accl_tpu.device.emu_device import EmuRank
 
-    rank = EmuRank(world, idx, ports)
+    rank = EmuRank(world, idx, ports, transport=transport)
     try:
         if script:
             mod, fn = script.split(":")
@@ -61,6 +61,8 @@ def main():
     ap.add_argument("-n", "--world", type=int, default=2)
     ap.add_argument("--script", default=None,
                     help="module:function run per rank as fn(rank, idx, world)")
+    ap.add_argument("--transport", choices=("tcp", "udp"), default="tcp",
+                    help="session TCP mesh or sessionless datagram POE")
     args = ap.parse_args()
 
     sys.path.insert(0, str(REPO))
@@ -69,7 +71,9 @@ def main():
     ports = free_ports(args.world)
     q = mp.Queue()
     procs = [
-        mp.Process(target=worker, args=(args.world, i, ports, args.script, q),
+        mp.Process(target=worker,
+                   args=(args.world, i, ports, args.script, q,
+                         args.transport),
                    daemon=True)
         for i in range(args.world)
     ]
