@@ -136,31 +136,57 @@ def test_dcn_single_tier_degenerates_flat():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_dcn_two_process_end_to_end():
-    """THE multi-host test: two OS processes x 4 CPU devices, facade
-    collectives spanning the process boundary via jax.distributed."""
+def _run_dcn_procs(n_procs, extra_args=(), prefix="dcn_test"):
+    """Spawn n run_dcn.py processes, wait with cleanup, return (rcs, outs).
+    Children are killed on timeout so a deadlocked coordinator cannot
+    orphan processes into later tests."""
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    # children force the CPU platform themselves before any backend touch,
-    # so a wedged TPU tunnel cannot hang them
     env = dict(os.environ, PYTHONPATH=str(REPO))
-    procs = []
-    logs = []
-    for pid in range(2):
-        log = open(f"/tmp/dcn_test_p{pid}.log", "w")
-        logs.append(log)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(REPO / "tools" / "run_dcn.py"),
-             "--procs", "2", "--proc-id", str(pid), "--port", str(port)],
-            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=str(REPO),
-        ))
-    rcs = [p.wait(timeout=300) for p in procs]
-    for log in logs:
-        log.close()
-    outs = [pathlib.Path(f"/tmp/dcn_test_p{i}.log").read_text()
-            for i in range(2)]
+    procs, logs = [], []
+    try:
+        for pid in range(n_procs):
+            log = open(f"/tmp/{prefix}_p{pid}.log", "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(REPO / "tools" / "run_dcn.py"),
+                 "--procs", str(n_procs), "--proc-id", str(pid),
+                 "--port", str(port), *extra_args],
+                stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=str(REPO)))
+        rcs = [p.wait(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+    outs = [pathlib.Path(f"/tmp/{prefix}_p{i}.log").read_text()
+            for i in range(n_procs)]
+    return rcs, outs
+
+
+def test_dcn_two_process_end_to_end():
+    """THE multi-host test: two OS processes x 4 CPU devices, facade
+    collectives spanning the process boundary via jax.distributed.
+    (Children force the CPU platform themselves before any backend
+    touch, so a wedged TPU tunnel cannot hang them.)"""
+    rcs, outs = _run_dcn_procs(2)
     assert rcs == [0, 0], f"rc={rcs}\n--- p0:\n{outs[0]}\n--- p1:\n{outs[1]}"
     assert "RANKS [0, 1, 2, 3] proc 0/2 OK" in outs[0]
     assert "RANKS [4, 5, 6, 7] proc 1/2 OK" in outs[1]
+
+
+def test_dcn_three_process_cross_host_subgroup():
+    """A sub-communicator spanning 2 of 3 hosts: member hosts run the
+    hierarchical collective on the (2, local) sub-mesh, the third host
+    no-ops the same facade call — the full MPI communicator-subset
+    semantics across real OS processes."""
+    rcs, outs = _run_dcn_procs(
+        3, ("--local-devices", "2", "--subset-hosts", "2"),
+        prefix="dcn_test3")
+    assert rcs == [0, 0, 0], f"rc={rcs}\n" + "\n---\n".join(outs)
+    for i, want in enumerate(("[0, 1]", "[2, 3]", "[4, 5]")):
+        assert f"RANKS {want} proc {i}/3 OK" in outs[i]

@@ -25,6 +25,9 @@ def main() -> int:
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--local-devices", type=int, default=4)
     ap.add_argument("--count", type=int, default=96)
+    ap.add_argument("--subset-hosts", type=int, default=0,
+                    help="also run an allreduce on a sub-communicator of "
+                         "the first K hosts (0 = skip)")
     args = ap.parse_args()
 
     import numpy as np
@@ -105,6 +108,24 @@ def main() -> int:
     else:
         for r in rows:
             np.testing.assert_allclose(cr.host[r], 0.0)
+
+    if args.subset_hosts:
+        # cross-host sub-communicator: first K whole hosts. Member hosts
+        # run a hierarchical collective on the (K, local) sub-mesh; the
+        # rest no-op the same facade call.
+        stage(f"subset-{args.subset_hosts}-hosts")
+        k = args.subset_hosts
+        grp = a.split(list(range(k * local)))
+        kb, kr = a.create_buffer(16, data=x[:, :16]), a.create_buffer(16)
+        a.allreduce(kb, kr, 16, ReduceFunction.SUM, comm=grp)
+        if args.proc_id < k:
+            for r in rows:
+                np.testing.assert_allclose(
+                    kr.host[r], x[: k * local, :16].sum(0),
+                    rtol=1e-4, atol=1e-4)
+        else:
+            for r in rows:
+                np.testing.assert_allclose(kr.host[r], 0.0)
 
     stage("barrier")
     a.barrier()
