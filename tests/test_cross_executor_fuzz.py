@@ -12,8 +12,6 @@ here the executors are independent implementations, which is exactly why
 the differential harness earns its keep.
 """
 
-import dataclasses
-
 import jax
 import numpy as np
 import pytest
@@ -22,11 +20,11 @@ from jax.sharding import Mesh
 from accl_tpu import (
     CallOptions,
     CompressionFlags,
-    DataType,
     Operation,
     ReduceFunction,
     TuningParams,
 )
+from accl_tpu.constants import from_numpy_dtype
 from accl_tpu.device.base import CCLOAddr
 from accl_tpu.device.emu_device import EmuWorld
 from accl_tpu.sequencer import select_algorithm
@@ -54,8 +52,20 @@ def _sample_configs():
             Operation.allreduce, Operation.bcast, Operation.reduce)
         root = int(rng.integers(world))
         transport = str(rng.choice(["tcp", "udp"]))
+        # dtype lane coverage (reference reduce_ops: fp32/fp64/i32/...);
+        # wire compression is an fp32 feature
+        dtype = (np.float32 if compressed
+                 else [np.float32, np.int32, np.float64][int(rng.integers(3))])
         configs.append((i, op, world, count, func, max_eager, gather_cnt,
-                        compressed, root, transport))
+                        compressed, root, transport, dtype))
+    # pinned lane coverage: every (dtype, func) reduce lane is exercised
+    # at least once regardless of what the random draw happened to hit
+    for j, (dt, fn) in enumerate([(np.int32, ReduceFunction.MAX),
+                                  (np.int32, ReduceFunction.SUM),
+                                  (np.float64, ReduceFunction.MAX),
+                                  (np.float64, ReduceFunction.SUM)]):
+        configs.append((N_CONFIGS + j, Operation.allreduce, 4, 700, fn,
+                        1024, 32 * 1024, False, 0, "tcp", dt))
     return configs
 
 
@@ -100,10 +110,10 @@ def _tolerance(compressed):
 
 @pytest.mark.parametrize(
     "cfg", _sample_configs(),
-    ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[9]}")
+    ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[9]}-{c[10].__name__}")
 def test_cross_executor_agreement(cfg):
     (i, op, world, count, func, max_eager, gather_cnt, compressed, root,
-     transport) = cfg
+     transport, dtype) = cfg
     rng = np.random.default_rng(SEED + i)
     in_per_rank = count * world if op in (
         Operation.scatter, Operation.reduce_scatter, Operation.alltoall
@@ -111,22 +121,31 @@ def test_cross_executor_agreement(cfg):
     out_elems = count * world if op in (
         Operation.gather, Operation.allgather, Operation.alltoall
     ) else count
-    x = rng.standard_normal((world, in_per_rank)).astype(np.float32)
+    if np.issubdtype(dtype, np.integer):
+        x = rng.integers(-1000, 1000, (world, in_per_rank)).astype(dtype)
+    else:
+        x = rng.standard_normal((world, in_per_rank)).astype(dtype)
     comp_flags = (CompressionFlags.ETH_COMPRESSED if compressed
                   else CompressionFlags.NO_COMPRESSION)
     expected = _oracle(op, x, func, world, root, compressed)
     tol = _tolerance(compressed)
+    if np.issubdtype(dtype, np.integer):
+        tol = dict(rtol=0, atol=0)  # integer lanes are exact
+    elif dtype is np.float64:
+        # tight enough to catch a silent fp64 -> fp32 downcast in a lane
+        tol = dict(rtol=1e-12, atol=1e-12)
 
     # ---- XLA executor -------------------------------------------------
     mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
     tuning = TuningParams(gather_flat_tree_max_count=gather_cnt)
-    plan = select_algorithm(op, count, 4, world, comp_flags,
-                            max_eager_size=max_eager,
+    acc_dt = from_numpy_dtype(np.dtype(dtype))
+    plan = select_algorithm(op, count, np.dtype(dtype).itemsize, world,
+                            comp_flags, max_eager_size=max_eager,
                             eager_rx_buf_size=max(max_eager, 256),
                             tuning=tuning)
     opts = CallOptions(scenario=op, count=count, root_src_dst=root,
                        function=int(func), compression_flags=comp_flags,
-                       data_type=DataType.float32)
+                       data_type=acc_dt)
     fn = ScheduleCompiler(mesh).lower(opts, plan)
     xla_out = np.asarray(fn(x))
     if op in (Operation.gather, Operation.reduce):
@@ -144,10 +163,10 @@ def test_cross_executor_agreement(cfg):
     try:
         def body(rank, r):
             rank.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, gather_cnt)
-            out = np.zeros(out_elems, np.float32)
+            out = np.zeros(out_elems, dtype)
             o = CallOptions(scenario=op, count=count, root_src_dst=root,
                             function=int(func), compression_flags=comp_flags,
-                            data_type=DataType.float32)
+                            data_type=acc_dt)
             send = x[r].copy()
             if op == Operation.bcast:
                 rank.call(o, op0=send)
