@@ -170,30 +170,14 @@ def bench_allreduce(jax, sizes_bytes, world):
     return rows
 
 
-def _probe_devices(timeout_s=150):
-    """jax.devices() with a watchdog: the tunneled TPU can wedge (stale
-    relay lease after a killed client) and hang device init forever."""
-    import threading
-
-    box = {}
-
-    def probe():
-        try:
-            import jax
-
-            box["devices"] = jax.devices()
-        except Exception as e:  # pragma: no cover
-            box["err"] = repr(e)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return box.get("devices")
-
-
 def main():
     if os.environ.get("ACCL_BENCH_NO_FALLBACK") != "1":
-        if _probe_devices() is None:
+        # shared subprocess watchdog (see __graft_entry__._tpu_reachable):
+        # a wedged tunnel hangs jax.devices() forever, and probing in a
+        # subprocess keeps THIS process's backend un-touched
+        from __graft_entry__ import _tpu_reachable
+
+        if not _tpu_reachable(timeout_s=150):
             # TPU wedged: re-exec on the CPU backend so the driver still
             # gets a (clearly labeled) result instead of a hang
             import subprocess
