@@ -53,8 +53,9 @@ class TPUDevice(CCLODevice):
         # ccl_offload_control.c:2460-2479 — a recv with no matching
         # message is requeued, not failed, until the timeout).
         self._pending_sends: dict[tuple, CallOptions] = {}
-        # guarded by _recv_mu: mutated by the driver thread (park/pair)
-        # and by waiter threads firing timeouts (unpark)
+        # BOTH pending maps are guarded by _recv_mu: mutated by driver
+        # threads (match-or-enqueue on send, match-or-park on recv) and
+        # by waiter threads firing timeouts (unpark)
         self._recv_mu = threading.Lock()
         self._pending_recvs: dict[tuple, list[ParkedRecvRequest]] = {}
         # Kernel-stream endpoints (strm != 0 routing, SURVEY.md §3.4).
@@ -317,7 +318,11 @@ class TPUDevice(CCLODevice):
         queue plays per-rank in the reference (rxbuf_seek.cpp:20-79)."""
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
-        # a parked recv waiting for this send fires immediately
+        # match-or-enqueue is ATOMIC under _recv_mu (which guards BOTH
+        # pending maps): otherwise a concurrent recv could scan the send
+        # map before this insert while this scan misses its parking —
+        # both sides parked, lost wakeup. The claimed recv resolves
+        # outside the lock (launch may compile).
         parked = None
         with self._recv_mu:
             for key, queue in list(self._pending_recvs.items()):
@@ -333,11 +338,11 @@ class TPUDevice(CCLODevice):
                         self._pending_recvs.pop(key, None)
                     if parked is not None:
                         break
+            if parked is None:
+                self._pending_sends[
+                    (options.comm_addr, src, dst, options.tag)] = options
         if parked is not None:
             parked.resolve(self._launch(self._pair(parked.options, options)))
-        else:
-            self._pending_sends[
-                (options.comm_addr, src, dst, options.tag)] = options
         req = BaseRequest("send")
         req.running()
         req.complete(0)
@@ -362,36 +367,40 @@ class TPUDevice(CCLODevice):
     def _match_recv(self, options: CallOptions) -> BaseRequest:
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
-        match = None
-        for (ca, s, d, tag) in self._pending_sends:
-            if ca == options.comm_addr and s == src and d == dst and (
-                tag == options.tag or TAG_ANY in (tag, options.tag)
-            ):
-                match = (ca, s, d, tag)
-                break
-        if match is None:
-            # park until the send arrives or the configured timeout lapses
-            # (reference: unmatched recvs ride the retry queue until
-            # HOUSEKEEP_TIMEOUT, ccl_offload_control.c:2460-2479)
-            req = ParkedRecvRequest(options, self.timeout / 1e6)
-            key = (options.comm_addr, src, dst, options.tag)
-            with self._recv_mu:
+        # match-or-park is ATOMIC under _recv_mu, mirroring _enqueue_send:
+        # scanning the send map and parking must not interleave with a
+        # concurrent send's scan-and-insert (lost wakeup / mutation during
+        # iteration)
+        with self._recv_mu:
+            match = None
+            for (ca, s, d, tag) in self._pending_sends:
+                if ca == options.comm_addr and s == src and d == dst and (
+                    tag == options.tag or TAG_ANY in (tag, options.tag)
+                ):
+                    match = (ca, s, d, tag)
+                    break
+            if match is None:
+                # park until the send arrives or the configured timeout
+                # lapses (reference: unmatched recvs ride the retry queue
+                # until HOUSEKEEP_TIMEOUT, ccl_offload_control.c:2460-2479)
+                req = ParkedRecvRequest(options, self.timeout / 1e6)
+                key = (options.comm_addr, src, dst, options.tag)
                 self._pending_recvs.setdefault(key, []).append(req)
 
-            def unpark(_key=key, _req=req):
-                with self._recv_mu:
-                    queue = self._pending_recvs.get(_key)
-                    if queue is not None:
-                        try:
-                            queue.remove(_req)  # by identity of self
-                        except ValueError:
-                            pass
-                        if not queue:
-                            self._pending_recvs.pop(_key, None)
+                def unpark(_key=key, _req=req):
+                    with self._recv_mu:
+                        queue = self._pending_recvs.get(_key)
+                        if queue is not None:
+                            try:
+                                queue.remove(_req)  # by identity of self
+                            except ValueError:
+                                pass
+                            if not queue:
+                                self._pending_recvs.pop(_key, None)
 
-            req._unpark = unpark
-            return req
-        send_opts = self._pending_sends.pop(match)
+                req._unpark = unpark
+                return req
+            send_opts = self._pending_sends.pop(match)
         return self._launch(self._pair(options, send_opts))
 
     # -- kernel streams (stream_put flow, vadd_put analog) -----------------
@@ -457,8 +466,8 @@ class TPUDevice(CCLODevice):
         req.running()
         fn = CfgFunc(options.function)
         if fn == CfgFunc.reset_periph:
-            self._pending_sends.clear()
             with self._recv_mu:
+                self._pending_sends.clear()
                 queues = [q for q in self._pending_recvs.values()]
                 self._pending_recvs.clear()
             for queue in queues:

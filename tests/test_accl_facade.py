@@ -310,3 +310,44 @@ def test_send_recv_tag_any(accl):
         accl.recv(rb, 32, src=0, dst=4, tag=999)  # exact tag filters
     accl.recv(rb, 32, src=0, dst=4)  # TAG_ANY default drains the send
     np.testing.assert_allclose(rb.host[4], x[0], rtol=1e-6)
+
+
+def test_async_sendrecv_stress(accl):
+    """The reference's 2000-iteration async stress (stress.cpp:24-34)
+    on the TPU path: many interleaved recv-before-send / send-before-recv
+    pairs with per-iteration tags, async from two threads, exercising the
+    parked-recv claim machinery under concurrency."""
+    import threading
+
+    n, iters = 16, 60
+    x = RNG.standard_normal((WORLD, n)).astype(np.float32)
+    sb = accl.create_buffer(n, data=x)
+    bufs = [accl.create_buffer(n) for _ in range(iters)]
+    recv_reqs = [None] * iters
+    errs = []
+
+    def receiver():
+        try:
+            for t in range(iters):
+                recv_reqs[t] = accl.recv(bufs[t], n, src=1, dst=2,
+                                         tag=1000 + t, run_async=True)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def sender():
+        try:
+            for t in range(iters):
+                accl.send(sb, n, src=1, dst=2, tag=1000 + t)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    rt = threading.Thread(target=receiver)
+    st = threading.Thread(target=sender)
+    rt.start(); st.start()
+    rt.join(60); st.join(60)
+    assert not rt.is_alive() and not st.is_alive(), "worker thread hung"
+    assert not errs, errs
+    for t in range(iters):
+        accl.wait(recv_reqs[t])
+        np.testing.assert_allclose(bufs[t].host[2], x[1], rtol=1e-6,
+                                   err_msg=f"iteration {t}")
