@@ -43,7 +43,7 @@ def _sample_configs():
     configs = []
     for i in range(N_CONFIGS):
         op = OPS[int(rng.integers(len(OPS)))]
-        world = int(rng.integers(2, 6))
+        world = int(rng.integers(2, 9))
         count = int(rng.integers(1, 2500))
         func = ReduceFunction(int(rng.integers(2)))
         max_eager = int(rng.choice([256, 1024, 4096]))
@@ -132,6 +132,10 @@ def test_cross_executor_agreement(cfg):
     if np.issubdtype(dtype, np.integer):
         tol = dict(rtol=0, atol=0)  # integer lanes are exact
     elif dtype is np.float64:
+        # explicit, or a missing x64 flag surfaces as a baffling
+        # 100%-mismatch at 1e-12 instead of this message
+        assert jax.config.jax_enable_x64, \
+            "fp64 lane coverage requires jax_enable_x64 (conftest sets it)"
         # tight enough to catch a silent fp64 -> fp32 downcast in a lane
         tol = dict(rtol=1e-12, atol=1e-12)
 
