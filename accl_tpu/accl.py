@@ -95,6 +95,7 @@ class ACCL:
         # invalidates all prior communicator handles (their exchange-memory
         # addresses are reallocated), so the list starts fresh
         self.communicators.clear()
+        self._split_cache = {}
         world = dev.world
         ranks = [Rank(device_index=i, session_id=i) for i in range(world)]
         self.communicators.append(Communicator(ranks, 0, CCLOAddr.DYNAMIC_BASE))
@@ -444,6 +445,13 @@ class ACCL:
             raise ValueError("duplicate ranks in split")
         if not all(0 <= r < self.world for r in rank_indices):
             raise ValueError(f"split ranks outside world of {self.world}")
+        # repeated splits of the same member list reuse the existing table
+        # (the allocator only grows; the device-side group cache already
+        # dedups the execution context, so a fresh table would only burn
+        # exchange memory)
+        cached = self._split_cache.get(tuple(rank_indices))
+        if cached is not None and cached in self.communicators:
+            return cached
         import dataclasses
 
         parent = self.communicators[0].ranks
@@ -463,6 +471,7 @@ class ACCL:
         self._exchmem_alloc += 4 * nwords
         self.communicators.append(comm)
         self._write_communicator(comm)
+        self._split_cache[tuple(rank_indices)] = comm
         return comm
 
     def register_stream_producer(self, stream_id: int, fn):

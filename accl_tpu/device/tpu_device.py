@@ -52,7 +52,16 @@ class TPUDevice(CCLODevice):
         # their send arrives (the firmware retry-queue contract,
         # ccl_offload_control.c:2460-2479 — a recv with no matching
         # message is requeued, not failed, until the timeout).
-        self._pending_sends: dict[tuple, CallOptions] = {}
+        # Each signature keys a FIFO of (arrival_seq, options): every
+        # notification parks, none is dropped, and TAG_ANY matching picks
+        # the globally oldest across signatures — arrival order, like the
+        # reference's in-order notification queue scan (rxbuf_seek.cpp:20-79).
+        # Total parked sends are capped at the reference's 512-notification
+        # park limit (rxbuf_seek.cpp:47-50); beyond that the send errors.
+        self._pending_sends: dict[tuple, list[tuple[int, CallOptions]]] = {}
+        self._park_seq = 0
+        self._parked_send_count = 0
+        self.MAX_PARKED_SENDS = 512
         # BOTH pending maps are guarded by _recv_mu: mutated by driver
         # threads (match-or-enqueue on send, match-or-park on recv) and
         # by waiter threads firing timeouts (unpark)
@@ -325,22 +334,46 @@ class TPUDevice(CCLODevice):
         # outside the lock (launch may compile).
         parked = None
         with self._recv_mu:
-            for key, queue in list(self._pending_recvs.items()):
-                ca, s, d, tag = key
-                if ca == options.comm_addr and s == src and d == dst and (
-                    tag == options.tag or TAG_ANY in (tag, options.tag)
-                ):
-                    while queue and parked is None:
-                        candidate = queue.pop(0)
-                        if candidate.claim():  # FIFO; skip already-timed-out
-                            parked = candidate
-                    if not queue:
-                        self._pending_recvs.pop(key, None)
-                    if parked is not None:
-                        break
+            while parked is None:
+                # oldest-parked-first across ALL matching signatures: a
+                # TAG_ANY send must pair with the earliest-arrived recv
+                # even when several tag keys match (arrival-order scan,
+                # rxbuf_seek.cpp:20-79); per-queue heads are each queue's
+                # minimum, so comparing heads finds the global minimum
+                best_key = None
+                for key, queue in self._pending_recvs.items():
+                    ca, s, d, tag = key
+                    if ca == options.comm_addr and s == src and d == dst and (
+                        tag == options.tag or TAG_ANY in (tag, options.tag)
+                    ) and (
+                        best_key is None
+                        or queue[0]._park_seq
+                        < self._pending_recvs[best_key][0]._park_seq
+                    ):
+                        best_key = key
+                if best_key is None:
+                    break
+                queue = self._pending_recvs[best_key]
+                candidate = queue.pop(0)
+                if not queue:
+                    self._pending_recvs.pop(best_key, None)
+                if candidate.claim():  # skip already-timed-out
+                    parked = candidate
             if parked is None:
-                self._pending_sends[
-                    (options.comm_addr, src, dst, options.tag)] = options
+                if self._parked_send_count >= self.MAX_PARKED_SENDS:
+                    # park backlog full: fail loudly instead of growing
+                    # without bound (reference caps parked notifications
+                    # at 512, rxbuf_seek.cpp:47-50)
+                    req = BaseRequest("send")
+                    req.running()
+                    req.complete(int(
+                        ErrorCode.DEQUEUE_BUFFER_SPARE_BUFFER_STATUS_ERROR))
+                    return req
+                self._park_seq += 1
+                self._parked_send_count += 1
+                self._pending_sends.setdefault(
+                    (options.comm_addr, src, dst, options.tag), []
+                ).append((self._park_seq, options))
         if parked is not None:
             parked.resolve(self._launch(self._pair(parked.options, options)))
         req = BaseRequest("send")
@@ -372,18 +405,26 @@ class TPUDevice(CCLODevice):
         # concurrent send's scan-and-insert (lost wakeup / mutation during
         # iteration)
         with self._recv_mu:
+            # oldest-send-first across ALL matching signatures (see
+            # _enqueue_send): a TAG_ANY recv drains sends in arrival
+            # order even when they parked under different tag keys
             match = None
-            for (ca, s, d, tag) in self._pending_sends:
+            for key, queue in self._pending_sends.items():
+                ca, s, d, tag = key
                 if ca == options.comm_addr and s == src and d == dst and (
                     tag == options.tag or TAG_ANY in (tag, options.tag)
+                ) and (
+                    match is None
+                    or queue[0][0] < self._pending_sends[match][0][0]
                 ):
-                    match = (ca, s, d, tag)
-                    break
+                    match = key
             if match is None:
                 # park until the send arrives or the configured timeout
                 # lapses (reference: unmatched recvs ride the retry queue
                 # until HOUSEKEEP_TIMEOUT, ccl_offload_control.c:2460-2479)
                 req = ParkedRecvRequest(options, self.timeout / 1e6)
+                self._park_seq += 1
+                req._park_seq = self._park_seq
                 key = (options.comm_addr, src, dst, options.tag)
                 self._pending_recvs.setdefault(key, []).append(req)
 
@@ -400,7 +441,11 @@ class TPUDevice(CCLODevice):
 
                 req._unpark = unpark
                 return req
-            send_opts = self._pending_sends.pop(match)
+            queue = self._pending_sends[match]
+            _seq, send_opts = queue.pop(0)
+            self._parked_send_count -= 1
+            if not queue:
+                self._pending_sends.pop(match, None)
         return self._launch(self._pair(options, send_opts))
 
     # -- kernel streams (stream_put flow, vadd_put analog) -----------------
@@ -468,6 +513,7 @@ class TPUDevice(CCLODevice):
         if fn == CfgFunc.reset_periph:
             with self._recv_mu:
                 self._pending_sends.clear()
+                self._parked_send_count = 0
                 queues = [q for q in self._pending_recvs.values()]
                 self._pending_recvs.clear()
             for queue in queues:

@@ -119,10 +119,13 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
     # (ep_world, n_local, C, D): source-rank-major blocks for MY experts
     recv = routed.reshape(ep_world, n_local, C, D)
 
-    # local expert FFN: my n_local experts over all source ranks' tokens
-    me = lax.axis_index(ep_axis)
-    w_up = lax.dynamic_slice_in_dim(params["w_up"], me * n_local, n_local, 0)
-    w_down = lax.dynamic_slice_in_dim(params["w_down"], me * n_local, n_local, 0)
+    # local expert FFN: under in_specs P(ep) the expert stacks enter
+    # shard_map already sliced to this rank's (n_local, ...) block, so
+    # they are used directly — re-slicing by axis_index here would be a
+    # clamped no-op that silently misroutes if the param spec changed
+    w_up = params["w_up"]
+    w_down = params["w_down"]
+    assert w_up.shape[0] == n_local, (w_up.shape, n_local)
     h = jnp.einsum("slcd,ldf->slcf", recv, w_up)
     h = jax.nn.gelu(h)
     out = jnp.einsum("slcf,lfd->slcd", h, w_down)

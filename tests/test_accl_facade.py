@@ -104,6 +104,23 @@ def test_two_parked_recvs_same_signature(accl):
     np.testing.assert_allclose(r2.host[1], y[0], rtol=1e-6)
 
 
+def test_two_pending_sends_same_signature(accl):
+    """Two sends posted before ANY recv with an identical (src, dst, tag)
+    signature both park and pair FIFO with two later recvs — the second
+    send must not overwrite the first (reference parks every notification,
+    rxbuf_seek.cpp:47-50)."""
+    x = RNG.standard_normal((WORLD, 24)).astype(np.float32)
+    y = RNG.standard_normal((WORLD, 24)).astype(np.float32)
+    sx, sy = accl.create_buffer(24, data=x), accl.create_buffer(24, data=y)
+    r1, r2 = accl.create_buffer(24), accl.create_buffer(24)
+    accl.send(sx, 24, src=0, dst=2, tag=9)
+    accl.send(sy, 24, src=0, dst=2, tag=9)
+    accl.recv(r1, 24, src=0, dst=2, tag=9)
+    accl.recv(r2, 24, src=0, dst=2, tag=9)
+    np.testing.assert_allclose(r1.host[2], x[0], rtol=1e-6)
+    np.testing.assert_allclose(r2.host[2], y[0], rtol=1e-6)
+
+
 def test_recv_before_send_pairs(accl):
     """recv issued BEFORE send succeeds once the send arrives within the
     timeout (order-independence of the reference driver's p2p API)."""
@@ -299,6 +316,18 @@ def test_split_registers_and_persists(accl):
         accl.allreduce(sb, rb, 8, ReduceFunction.SUM, comm=foreign)
 
 
+def test_split_same_members_reuses_table(accl):
+    """Repeated split() of an identical member list returns the existing
+    handle instead of leaking exchange memory (the allocator only grows)."""
+    a = accl.split([2, 3])
+    alloc_after = accl._exchmem_alloc
+    b = accl.split([2, 3])
+    assert b is a
+    assert accl._exchmem_alloc == alloc_after
+    c = accl.split([3, 2])  # different order = different root mapping
+    assert c is not a
+
+
 def test_send_recv_tag_any(accl):
     """TAG_ANY recv matches a tagged pending send (rxbuf seek wildcard);
     a concrete non-matching tag must NOT match."""
@@ -310,6 +339,23 @@ def test_send_recv_tag_any(accl):
         accl.recv(rb, 32, src=0, dst=4, tag=999)  # exact tag filters
     accl.recv(rb, 32, src=0, dst=4)  # TAG_ANY default drains the send
     np.testing.assert_allclose(rb.host[4], x[0], rtol=1e-6)
+
+
+def test_tag_any_recv_drains_sends_in_arrival_order(accl):
+    """TAG_ANY recvs pair with pending sends in ARRIVAL order even when
+    the sends parked under different tags — a newer send on a different
+    tag must not overtake an older one (in-order notification scan,
+    rxbuf_seek.cpp:20-79)."""
+    bufs = []
+    for i, tag in enumerate((2, 1, 2)):
+        x = np.full((WORLD, 8), float(i), np.float32)
+        sb = accl.create_buffer(8, data=x)
+        bufs.append(sb)
+        accl.send(sb, 8, src=0, dst=3, tag=tag)
+    for i in range(3):
+        rb = accl.create_buffer(8)
+        accl.recv(rb, 8, src=0, dst=3)  # TAG_ANY
+        np.testing.assert_allclose(rb.host[3], np.full(8, float(i)))
 
 
 def test_async_sendrecv_stress(accl):
