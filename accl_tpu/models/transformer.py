@@ -94,6 +94,39 @@ def param_specs(cfg: TransformerConfig) -> dict:
     }
 
 
+def stack_layer_params(params) -> dict:
+    """Convert the per-layer parameter list into stacked (n_layers, ...)
+    leaves so the layer dim can shard over a `pp` mesh axis (stage i =
+    layers [i*L/P, (i+1)*L/P))."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    return {"embed": params["embed"], "unembed": params["unembed"],
+            "layers": stacked}
+
+
+def unstack_layer_params(params, n_layers: int) -> dict:
+    """Inverse of stack_layer_params: stacked (n_layers, ...) leaves back
+    to the per-layer list form (checkpoint interop across mesh shapes)."""
+    layers = [jax.tree.map(lambda x: x[i], params["layers"])
+              for i in range(n_layers)]
+    return {"embed": params["embed"], "unembed": params["unembed"],
+            "layers": layers}
+
+
+def pp_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs for the stacked form: layer dim over pp, head/ff
+    dims over tp as in param_specs, embeddings replicated."""
+    layer = param_specs(cfg)["layers"][0]
+    return {
+        "embed": P(),
+        "unembed": P(),
+        "layers": {k: P("pp", *s) for k, s in layer.items()},
+    }
+
+
+def _pp_world(mesh: Mesh) -> int:
+    return dict(mesh.shape).get("pp", 1)
+
+
 def _spec_has_axis(spec, axis: str) -> bool:
     """True if a PartitionSpec shards any dimension over `axis`."""
     for part in spec:
@@ -142,44 +175,83 @@ def _grad_allreduce(g, axis, wire):
     return out.reshape(shape) / world  # mean over replicas
 
 
+def _block(x, lyr, wire):
+    """One transformer block (ring attention over sp, tp partial-sum
+    reductions through the framework ring)."""
+    h = _rmsnorm(x, lyr["ln1"])
+    qkv = jnp.einsum("btd,dchk->btchk", h, lyr["wqkv"])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    o_partial = jnp.einsum("bthk,hkd->btd", attn, lyr["wo"])
+    # heads are sharded over tp: partial sums reduce on-device-ring
+    x = x + _tp_allreduce(o_partial, wire)
+    h = _rmsnorm(x, lyr["ln2"])
+    up = jnp.einsum("btd,df->btf", h, lyr["w_up"])
+    up = jax.nn.gelu(up)
+    down_partial = jnp.einsum("btf,fd->btd", up, lyr["w_down"])
+    return x + _tp_allreduce(down_partial, wire)
+
+
 def _forward_local(params, tokens, cfg: TransformerConfig, wire):
     """Per-device forward: tokens (B_local, T_local) -> logits. Runs inside
     shard_map; heads are the tp-local slice, sequence the sp-local shard."""
     x = params["embed"][tokens]  # (B, T, Dm)
     for lyr in params["layers"]:
-        h = _rmsnorm(x, lyr["ln1"])
-        qkv = jnp.einsum("btd,dchk->btchk", h, lyr["wqkv"])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = ring_attention(q, k, v, axis_name="sp", causal=True)
-        o_partial = jnp.einsum("bthk,hkd->btd", attn, lyr["wo"])
-        # heads are sharded over tp: partial sums reduce on-device-ring
-        o = _tp_allreduce(o_partial, wire)
-        x = x + o
-        h = _rmsnorm(x, lyr["ln2"])
-        up = jnp.einsum("btd,df->btf", h, lyr["w_up"])
-        up = jax.nn.gelu(up)
-        down_partial = jnp.einsum("btf,fd->btd", up, lyr["w_down"])
-        x = x + _tp_allreduce(down_partial, wire)
+        x = _block(x, lyr, wire)
     x = _rmsnorm(x, jnp.ones((cfg.d_model,), x.dtype))
     return jnp.einsum("btd,dv->btv", x, params["unembed"])
 
 
-def _loss_local(params, tokens, targets, cfg, wire):
-    logits = _forward_local(params, tokens, cfg, wire).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, -1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-    return nll.mean()
+def _forward_local_pp(params, tokens, cfg: TransformerConfig, wire,
+                      n_microbatches: int):
+    """Pipelined per-device forward: params["layers"] leaves arrive as the
+    pp-local (L_local, ...) stage slice; microbatches flow through the
+    GPipe schedule (parallel/pipeline.py) with each stage scanning its
+    local layers, and the last stage's activations come back replicated
+    for the (pp-replicated) unembed projection."""
+    from ..parallel.pipeline import gpipe_schedule
+
+    x = params["embed"][tokens]  # (B, T, Dm)
+    B = x.shape[0]
+    M = n_microbatches
+    assert B % M == 0, (B, M)
+    mb = x.reshape((M, B // M) + x.shape[1:])
+
+    def stage(h):
+        def one_layer(carry, lyr):
+            return _block(carry, lyr, wire), None
+
+        h, _ = lax.scan(one_layer, h, params["layers"])
+        return h
+
+    out = gpipe_schedule(mb, stage, axis="pp", world=lax.axis_size("pp"),
+                         wire=wire)
+    x = out.reshape(x.shape)
+    x = _rmsnorm(x, jnp.ones((cfg.d_model,), x.dtype))
+    return jnp.einsum("btd,dv->btv", x, params["unembed"])
 
 
-def make_forward(cfg: TransformerConfig, mesh: Mesh):
+def make_forward(cfg: TransformerConfig, mesh: Mesh,
+                 n_microbatches: int | None = None):
     """Jitted SPMD forward: tokens (B, T) -> logits, batch over dp,
-    sequence over sp, heads over tp."""
+    sequence over sp, heads over tp; with a `pp` mesh axis the layer
+    stack pipelines over it (params in the stacked form, see
+    stack_layer_params)."""
     wire = schedules.Wire(None)
+    pp = _pp_world(mesh)
 
-    def body(params, tokens):
-        return _forward_local(params, tokens, cfg, wire)
+    if pp > 1:
+        M = n_microbatches or pp
+        pspecs = pp_param_specs(cfg)
 
-    pspecs = param_specs(cfg)
+        def body(params, tokens):
+            return _forward_local_pp(params, tokens, cfg, wire, M)
+    else:
+        pspecs = param_specs(cfg)
+
+        def body(params, tokens):
+            return _forward_local(params, tokens, cfg, wire)
+
     return jax.jit(
         jax.shard_map(
             body,
@@ -191,16 +263,29 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh):
     )
 
 
-def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3,
+                    n_microbatches: int | None = None):
     """One compiled SGD step: forward + backward + grad sync + update, all
-    inside a single shard_map program (host-only-dispatches)."""
+    inside a single shard_map program (host-only-dispatches). With a `pp`
+    mesh axis the layers pipeline over it (GPipe microbatches) and params
+    take the stacked form from stack_layer_params/pp_param_specs."""
     wire = schedules.Wire(None)
-    pspecs = param_specs(cfg)
+    pp = _pp_world(mesh)
+    M = (n_microbatches or pp) if pp > 1 else 1
+    pspecs = pp_param_specs(cfg) if pp > 1 else param_specs(cfg)
+
+    def loss_fn(params, tokens, targets):
+        if pp > 1:
+            logits = _forward_local_pp(params, tokens, cfg, wire, M)
+        else:
+            logits = _forward_local(params, tokens, cfg, wire)
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        return nll.mean()
 
     def body(params, tokens, targets):
-        loss, grads = jax.value_and_grad(_loss_local)(
-            params, tokens, targets, cfg, wire
-        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
 
         tp_world = lax.axis_size("tp")
 
@@ -223,6 +308,19 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
             return g
 
         grads = jax.tree.map(sync, grads, pspecs)
+        if pp > 1:
+            # the pipeline injects microbatches only on pp rank 0, so the
+            # embed cotangent lands entirely on rank 0 (zeros elsewhere):
+            # SUM-allreduce over pp replicates the full gradient. unembed
+            # applies after the replicated pipeline output, so its grad is
+            # already identical on every pp rank; stage (pp-sharded)
+            # leaves are stage-local by construction.
+            e = grads["embed"]
+            esum = schedules.allreduce_ring_schedule(
+                e.reshape(-1), func=ReduceFunction.SUM, axis="pp",
+                world=lax.axis_size("pp"), wire=wire, seg_count=e.size,
+            )
+            grads = {**grads, "embed": esum.reshape(e.shape)}
         new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
         for ax in ("dp", "sp"):
@@ -243,8 +341,18 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
 
 
 def shard_params(params, cfg, mesh):
-    """Place a global parameter pytree according to param_specs."""
-    specs = param_specs(cfg)
+    """Place a global parameter pytree according to param_specs; on a mesh
+    with a pp axis the layer list is first stacked (stack_layer_params)
+    and the layer dim sharded over pp."""
+    if _pp_world(mesh) > 1:
+        if cfg.n_layers % _pp_world(mesh):
+            raise ValueError(
+                f"n_layers {cfg.n_layers} must divide over pp "
+                f"{_pp_world(mesh)}")
+        params = stack_layer_params(params)
+        specs = pp_param_specs(cfg)
+    else:
+        specs = param_specs(cfg)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params,

@@ -3,12 +3,14 @@
 The alternative long-context strategy to ring attention: instead of
 rotating K/V, one all-to-all re-shards activations from sequence-sharded
 to head-sharded, attention runs with full sequence visibility per head
-group, and a second all-to-all restores sequence sharding. The all-to-all
-is the rotation pairwise exchange of the sequencer's FLAT_ALLTOALL
-schedule (ccl_offload_control.c:2140-2211), here fused by XLA into one
-ICI collective. Communication is O(T*H*D/P) per device per direction —
-cheaper than the ring when heads divide evenly, at the cost of head-count
-divisibility by the axis size.
+group, and a second all-to-all restores sequence sharding. Both
+re-shardings run through the framework's own FLAT_ALLTOALL schedule
+(sequencer/schedules.py:alltoall_schedule — the pairwise rotation
+exchange of ccl_offload_control.c:2140-2211), the same program the MoE
+dispatch rides, so every cross-device byte moves on framework schedules.
+Communication is O(T*H*D/P) per device per direction — cheaper than the
+ring when heads divide evenly, at the cost of head-count divisibility by
+the axis size.
 """
 
 from __future__ import annotations
@@ -16,33 +18,45 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..sequencer import schedules
 
-def _seq_to_heads(x, axis_name, world):
+
+def _seq_to_heads(x, axis_name, world, wire):
     """(B, T_local, H, D) -> (B, T_global, H/P, D).
 
-    all_to_all(tiled=False) consumes the world-sized split axis and inserts
-    a new world-sized axis (indexed by origin rank) at concat_axis; origin
-    rank order IS sequence-block order here.
+    Peer block w of the alltoall = my sequence block's head group w; the
+    arrival from rank j is rank j's sequence block restricted to my head
+    group, concatenated in source-rank (= sequence-block) order.
     """
     B, T, H, D = x.shape
-    x = x.reshape(B, T, world, H // world, D)  # head-major groups: h = w*Hl+hl
-    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
-    return x.reshape(B, T * world, H // world, D)
+    Hl = H // world
+    blocks = x.reshape(B, T, world, Hl, D).transpose(2, 0, 1, 3, 4)
+    routed = schedules.alltoall_schedule(
+        blocks.reshape(-1), axis=axis_name, world=world, wire=wire
+    )
+    out = routed.reshape(world, B, T, Hl, D).transpose(1, 0, 2, 3, 4)
+    return out.reshape(B, T * world, Hl, D)
 
 
-def _heads_to_seq(x, axis_name, world):
-    """(B, T_global, H/P, D) -> (B, T_local, H, D)."""
+def _heads_to_seq(x, axis_name, world, wire):
+    """(B, T_global, H/P, D) -> (B, T_local, H, D).
+
+    Peer block w = sequence block w of my head group; the arrival from
+    rank j is my sequence block under head group j, so source rank order
+    restores h = j*Hl + hl.
+    """
     B, TG, Hl, D = x.shape
     T = TG // world
-    x = x.reshape(B, world, T, Hl, D)
-    # origin rank = head group index; insert it before the local-head axis
-    # so the reshape restores h = w*Hl + hl
-    x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
-    return x.reshape(B, T, world * Hl, D)
+    blocks = x.reshape(B, world, T, Hl, D).transpose(1, 0, 2, 3, 4)
+    routed = schedules.alltoall_schedule(
+        blocks.reshape(-1), axis=axis_name, world=world, wire=wire
+    )
+    out = routed.reshape(world, B, T, Hl, D).transpose(1, 2, 0, 3, 4)
+    return out.reshape(B, T, world * Hl, D)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
-                      sm_scale: float | None = None):
+                      sm_scale: float | None = None, wire=None):
     """Per-device body (call inside shard_map): sequence-sharded q/k/v of
     shape (B, T_local, H, D) with H divisible by the axis size."""
     world = lax.axis_size(axis_name)
@@ -51,7 +65,10 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
         raise ValueError(f"heads {H} must divide by axis size {world}")
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
-    qg, kg, vg = (_seq_to_heads(t, axis_name, world) for t in (q, k, v))
+    if wire is None:
+        wire = schedules.Wire(None)
+    qg, kg, vg = (_seq_to_heads(t, axis_name, world, wire)
+                  for t in (q, k, v))
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * sm_scale
     if causal:
         TG = qg.shape[1]
@@ -61,4 +78,4 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
     p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vg.dtype), vg)
-    return _heads_to_seq(out, axis_name, world)
+    return _heads_to_seq(out, axis_name, world, wire)

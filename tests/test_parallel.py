@@ -63,6 +63,39 @@ def test_ulysses_matches_full(world, causal):
                           causal=causal)
 
 
+@pytest.mark.parametrize("world", [2, 4])
+def test_ulysses_resharding_matches_lax_all_to_all(world):
+    """The framework-alltoall re-shardings must agree element-for-element
+    with XLA's builtin all_to_all on both directions of the Ulysses
+    exchange (seq-sharded <-> head-sharded)."""
+    from accl_tpu.parallel.ulysses import _heads_to_seq, _seq_to_heads
+    from accl_tpu.sequencer import schedules
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("sp",))
+    B, T, H, D = 2, 8, world * 2, 4
+    x = RNG.standard_normal((B, T * world, H, D)).astype(np.float32)
+    wire = schedules.Wire(None)
+
+    def xla_seq_to_heads(xi):
+        xi = xi.reshape(B, T, world, H // world, D)
+        xi = jax.lax.all_to_all(xi, "sp", split_axis=2, concat_axis=1,
+                                tiled=False)
+        return xi.reshape(B, T * world, H // world, D)
+
+    def body(xi):
+        ours = _seq_to_heads(xi, "sp", world, wire)
+        theirs = xla_seq_to_heads(xi)
+        back = _heads_to_seq(ours, "sp", world, wire)
+        return ours - theirs, back - xi
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(P(None, "sp"),),
+                              out_specs=(P(None, "sp"), P(None, "sp")),
+                              check_vma=False))
+    d_fwd, d_round = f(x)
+    np.testing.assert_array_equal(np.asarray(d_fwd), 0)
+    np.testing.assert_array_equal(np.asarray(d_round), 0)
+
+
 def test_ring_attention_differentiable():
     world = 4
     mesh = Mesh(np.array(jax.devices()[:world]), ("sp",))
@@ -123,7 +156,9 @@ def test_transformer_train_step_decreases_loss():
 
 
 @pytest.mark.parametrize("axes", [{"dp": 1, "sp": 1, "tp": 2},
-                                  {"dp": 2, "sp": 2, "tp": 2}])
+                                  {"dp": 2, "sp": 2, "tp": 2},
+                                  {"dp": 1, "sp": 1, "tp": 1, "pp": 2},
+                                  {"dp": 2, "sp": 1, "tp": 2, "pp": 2}])
 def test_transformer_train_step_matches_single_device(axes):
     """One SGD step on a tp-sharded mesh must produce the same updated
     params as the identical step on one device (the tp-aware gradient
@@ -149,6 +184,10 @@ def test_transformer_train_step_matches_single_device(axes):
     np.testing.assert_array_equal(np.asarray(tokens), np.asarray(tokens1))
     step = make_train_step(cfg, mesh, lr=lr)
     new_params, loss = step(shard_params(params, cfg, mesh), tokens, targets)
+    if axes.get("pp", 1) > 1:
+        from accl_tpu.models.transformer import unstack_layer_params
+
+        new_params = unstack_layer_params(new_params, cfg.n_layers)
 
     assert abs(float(loss) - float(ref_loss)) < 1e-5
     flat_ref = jax.tree_util.tree_flatten_with_path(ref_params)[0]

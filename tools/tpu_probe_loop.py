@@ -21,11 +21,11 @@ sitecustomize. Mirrors __graft_entry__._tpu_reachable.
 import argparse
 import datetime
 import pathlib
-import subprocess
 import sys
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # for __graft_entry__._probe_tpu
 LOG = REPO / "accl_log" / "tpu_probe.log"
 SENTINEL = REPO / "accl_log" / "TPU_ALIVE"
 
@@ -41,27 +41,11 @@ def log(msg: str) -> None:
 
 
 def probe(timeout_s: int) -> bool:
-    import tempfile
+    from __graft_entry__ import _probe_tpu  # the one shared watchdog
 
-    # stderr to a FILE, not a pipe: a grandchild of the platform plugin
-    # can hold a pipe open past the kill and block the drain forever
-    with tempfile.TemporaryFile(mode="w+b") as errf:
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices())"],
-                timeout=timeout_s, stdout=subprocess.PIPE, stderr=errf)
-            if r.returncode == 0:
-                log(f"ALIVE {r.stdout.decode().strip()}")
-                return True
-            errf.seek(0)
-            tail = errf.read()[-300:].decode(errors="replace")
-            log(f"probe rc={r.returncode}: {tail!r}")
-        except subprocess.TimeoutExpired:
-            log(f"probe hung past {timeout_s}s (wedged tunnel)")
-        except Exception as e:
-            log(f"probe error: {e!r}")
-    return False
+    ok, detail = _probe_tpu(timeout_s)
+    log(("ALIVE " if ok else "") + detail.replace("\n", " | "))
+    return ok
 
 
 def main() -> int:
@@ -71,6 +55,9 @@ def main() -> int:
     ap.add_argument("--max-hours", type=float, default=11.0)
     args = ap.parse_args()
 
+    # a sentinel from a PREVIOUS run must not make a caller launch the
+    # hardware suite against a currently-wedged tunnel
+    SENTINEL.unlink(missing_ok=True)
     deadline = time.time() + args.max_hours * 3600
     attempt = 0
     while time.time() < deadline:
