@@ -32,6 +32,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -292,11 +293,44 @@ struct CommView {
   uint32_t g(uint32_t r) const { return map.empty() ? r : map[r]; }
 };
 
+// Per-call persistent collective state across NOT_READY requeues: every
+// do_* below is a step-indexed state machine riding Call.current_step (the
+// firmware requeues ANY NOT_READY collective with current_step,
+// ccl_offload_control.c:2308-2483), and this carries the data a resumed
+// pass needs that does not live in caller memory.
+struct CollState {
+  uint64_t off = 0;  // current op's partial progress: eager bytes landed,
+                     // or the rendezvous posted-address marker
+  // Config/tuning SNAPSHOT taken on the call's first pass: the replayed
+  // op sequence must be deterministic, and a config call (or tuning
+  // register write) executing between requeue passes of a parked
+  // collective must not flip its protocol/algorithm branches mid-flight.
+  bool cfg = false;
+  uint32_t max_eager = 0;
+  uint64_t max_rndzv = 0;
+  uint32_t tun_bcast_ranks = 0, tun_gather_fanin = 0, tun_gather_count = 0,
+           tun_reduce_ranks = 0, tun_reduce_count = 0;
+  int wire_bf16 = -1;  // compressed wire dtype, snapshotted like the rest
+  // algorithm scratch that must survive requeues (reduce accumulators,
+  // ring relay buffers, rendezvous landing slots, the reduce_scatter
+  // composition's full-width intermediate)
+  std::vector<uint8_t> acc, tmp, full;
+  // addresses THIS call posted and has not yet seen complete: revoked on
+  // timeout so a late write cannot land in memory the caller reuses
+  std::deque<RndzvAddr> posted;
+  void unpost(uint64_t vaddr) {
+    for (auto it = posted.begin(); it != posted.end(); ++it)
+      if (it->vaddr == vaddr) { posted.erase(it); return; }
+  }
+};
+
 struct Call {
   int64_t handle;
   uint32_t desc[15];
   uint32_t dtype;
   void *op0, *op1, *res;
+  bool started = false;  // has executed at least one pass (holds its
+                         // communicator's in-flight serialization slot)
   uint32_t current_step = 0;  // resumption point across NOT_READY requeues
   // resolved communicator persists across requeues like current_step
   bool comm_resolved = false;
@@ -307,6 +341,8 @@ struct Call {
   // compressed-domain scratch: persists across retry requeues so partial
   // progress (already-landed segments) survives re-execution
   std::shared_ptr<std::vector<uint16_t>> c16_op0, c16_op1, c16_res;
+  // step-machine scratch (shared with the compressed-domain inner Call)
+  std::shared_ptr<CollState> cstate;
 };
 
 struct Completion {
@@ -347,6 +383,14 @@ struct accl_rt {
   std::vector<size_t> idle_q;
   size_t base_rx_slots = 0;  // configured ring size; growth beyond it is
                              // burst absorption and compacts when drained
+  // (src, seqn) -> slot index: seeks are O(1) even when a datagram burst
+  // grows the ring to 2^20 slots (a linear scan made draining a large
+  // burst quadratic). src_valid_count keeps stray-seqn detection O(1).
+  std::unordered_map<uint64_t, size_t> rx_index;
+  std::vector<uint32_t> src_valid_count;
+  static uint64_t rx_key(uint32_t src, uint32_t seqn) {
+    return ((uint64_t)src << 32) | seqn;
+  }
   std::mutex rx_mu;
   std::condition_variable rx_cv;
 
@@ -364,7 +408,15 @@ struct accl_rt {
   // per-peer sequence numbers (ccl_offload_control.h:297-310)
   std::vector<uint32_t> inbound_seq, outbound_seq;
 
-  // call + retry queues and sequencer thread (run() analog)
+  // call + retry queues and sequencer thread (run() analog). Calls on the
+  // SAME communicator execute FIFO, one in flight at a time: the eager
+  // wire carries no call identity (per-src seqn streams only), so letting
+  // a second same-comm collective start while the first is parked would
+  // let it consume the first's segments. Different comm_addrs interleave
+  // freely — that is the disjoint-communicator concurrency the retry
+  // queue exists for; OVERLAPPING groups at different table addresses
+  // need distinct tags, the documented eager-wire contract.
+  std::map<uint32_t, uint32_t> inflight_comms;  // comm_addr -> started calls
   std::deque<Call> call_q, retry_q;
   std::mutex call_mu;
   std::condition_variable call_cv;
@@ -505,12 +557,26 @@ struct accl_rt {
       idx = idle_q.back();
       idle_q.pop_back();
     }
+    if ((int32_t)(h.seqn - inbound_seq[h.src]) < 0) {
+      // seqn already consumed: a LATE datagram duplicate. Landing it
+      // would leave a VALID slot no seek ever requests (leaked slot,
+      // compaction disabled forever) — drop it.
+      idle_q.push_back(idx);
+      return true;
+    }
+    if (!rx_index.emplace(rx_key(h.src, h.seqn), idx).second) {
+      // duplicate (src, seqn): idempotent drop (a datagram duplicate, or
+      // a peer protocol violation) — the first arrival wins
+      idle_q.push_back(idx);
+      return true;
+    }
     RxSlot &slot = rx_slots[idx];
     slot.status = RxSlot::VALID;
     slot.src = h.src;
     slot.tag = h.tag;
     slot.seqn = h.seqn;
     slot.data = std::move(payload);
+    src_valid_count[h.src]++;
     rx_cv.notify_all();
     return true;
   }
@@ -650,15 +716,19 @@ struct accl_rt {
     return NO_ERROR;
   }
 
-  // Seek one segment matching (src, tag, expected seqn) with rx_mu HELD;
-  // copy out (clamped to `cap`) + release (rxbuf_seek semantics). Returns
-  // NOT_READY when absent, DMA_SIZE_ERROR on an oversized segment.
+  // Seek the segment matching (src, tag, expected seqn) with rx_mu HELD;
+  // copy out (clamped to `cap`) + release (rxbuf_seek semantics). O(1)
+  // via the (src, seqn) index. Returns NOT_READY when absent,
+  // DMA_SIZE_ERROR on an oversized segment.
   //
   // Ordering faults are detected instead of wedging the link (reference
   // seqn-mismatch detection, dma_mover.cpp:342-352):
-  //  - a slot from src whose seqn is out of order while the expected seqn
-  //    is absent can never legally occur on the ordered per-link
-  //    transport -> PACK_SEQ_NUMBER_ERROR;
+  //  - slots from src exist but the expected head seqn is absent: on the
+  //    ordered per-link TCP transport this can never legally occur ->
+  //    PACK_SEQ_NUMBER_ERROR; on the sessionless datagram POE the kernel
+  //    may reorder under buffer pressure, so the expected datagram may
+  //    still be in flight -> NOT_READY until the call deadline (loss
+  //    surfaces as RECEIVE_TIMEOUT, not a misleading sequencing error);
   //  - `strict_tag`: an exact-tag mismatch AT the expected seqn is a
   //    protocol violation inside a collective (the head segment can never
   //    match) -> DMA_TAG_MISMATCH_ERROR. The non-strict SC_RECV retry
@@ -667,85 +737,38 @@ struct accl_rt {
   uint32_t seek_locked(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
                        uint64_t *got, bool strict_tag = false) {
     uint32_t want = inbound_seq[src];
-    bool head_tag_mismatch = false, stray_seqn = false;
-    for (size_t i = 0; i < rx_slots.size(); i++) {
-      RxSlot &s = rx_slots[i];
-      if (s.status != RxSlot::VALID || s.src != src) continue;
-      if (s.seqn == want) {
-        if (tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY) {
-          if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
-          *got = s.data.size();
-          if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
-          s.status = RxSlot::IDLE;
-          if (i >= base_rx_slots)
-            std::vector<uint8_t>().swap(s.data);  // free burst capacity
-          else
-            s.data.clear();
-          idle_q.push_back(i);
-          // compact a grown ring back to the configured size once fully
-          // drained, so one burst does not permanently tax every later
-          // seek scan or retain its payload memory
-          if (rx_slots.size() > base_rx_slots &&
-              idle_q.size() == rx_slots.size()) {
-            rx_slots.resize(base_rx_slots);
-            idle_q.clear();
-            for (size_t j = 0; j < base_rx_slots; j++) idle_q.push_back(j);
-          }
-          inbound_seq[src] = want + 1;
-          rx_cv.notify_all();
-          return NO_ERROR;
-        }
-        head_tag_mismatch = true;
-      } else {
-        stray_seqn = true;
-      }
+    auto it = rx_index.find(rx_key(src, want));
+    if (it == rx_index.end()) {
+      if (src_valid_count[src] > 0 && !udp_mode)
+        return PACK_SEQ_NUMBER_ERROR;  // stray seqn on an ordered link
+      return NOT_READY;
     }
-    if (head_tag_mismatch)
+    size_t i = it->second;
+    RxSlot &s = rx_slots[i];
+    if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY))
       return strict_tag ? DMA_TAG_MISMATCH_ERROR : NOT_READY;
-    if (stray_seqn) return PACK_SEQ_NUMBER_ERROR;
-    return NOT_READY;
-  }
-
-  // Non-blocking single-segment receive (retry-queue path).
-  uint32_t egr_recv_seg(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
-                        uint64_t *got) {
-    std::lock_guard<std::mutex> lk(rx_mu);
-    return seek_locked(src, tag, ptr, cap, got);
-  }
-
-  // Blocking variant with the housekeeping timeout; seek and wait happen
-  // under one held lock so a segment landing between them cannot be missed.
-  uint32_t egr_recv(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t bytes) {
-    if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
-    uint64_t off = 0;
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock<std::mutex> lk(rx_mu);
-    while (off < bytes || bytes == 0) {
-      uint64_t got = 0;
-      uint32_t rc = seek_locked(src, tag, ptr ? ptr + off : nullptr,
-                                bytes - off, &got, /*strict_tag=*/true);
-      if (rc == NOT_READY) {
-        if (rx_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
-          // final re-check before declaring a timeout
-          rc = seek_locked(src, tag, ptr ? ptr + off : nullptr, bytes - off,
-                           &got, /*strict_tag=*/true);
-          if (rc == NO_ERROR) {
-            off += got;
-            if (bytes == 0) break;
-            continue;
-          }
-          if (getenv("ACCL_RT_DEBUG"))
-            fprintf(stderr, "[r%u] egr_recv timeout src=%u tag=%u off=%llu/%llu\n",
-                    rank, src, tag, (unsigned long long)off, (unsigned long long)bytes);
-          return RECEIVE_TIMEOUT_ERROR;
-        }
-        continue;
-      }
-      if (rc != NO_ERROR) return rc;
-      off += got;
-      if (bytes == 0) break;
+    if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
+    *got = s.data.size();
+    if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
+    s.status = RxSlot::IDLE;
+    if (i >= base_rx_slots)
+      std::vector<uint8_t>().swap(s.data);  // free burst capacity
+    else
+      s.data.clear();
+    idle_q.push_back(i);
+    rx_index.erase(it);
+    src_valid_count[src]--;
+    // compact a grown ring back to the configured size once fully
+    // drained, so one burst does not permanently retain payload memory
+    // (all slots idle implies the index is empty)
+    if (rx_slots.size() > base_rx_slots &&
+        idle_q.size() == rx_slots.size()) {
+      rx_slots.resize(base_rx_slots);
+      idle_q.clear();
+      for (size_t j = 0; j < base_rx_slots; j++) idle_q.push_back(j);
     }
+    inbound_seq[src] = want + 1;
+    rx_cv.notify_all();
     return NO_ERROR;
   }
 
@@ -761,47 +784,20 @@ struct accl_rt {
     frame_out(dst, MSG_RNDZV_ADDR, tag, 0, bytes, vaddr, nullptr, 0, host);
   }
 
+  // Non-blocking: waiting for a peer's address happens by NOT_READY
+  // requeue in the sequencer, never inside this call.
   uint32_t rendezvous_get_addr(uint32_t src, uint64_t bytes, uint32_t tag,
-                               uint64_t *vaddr, bool block = true) {
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock<std::mutex> lk(rndzv_mu);
-    for (;;) {
-      for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
-        if (it->src == src && it->bytes == bytes &&
-            (tag == TAG_ANY || it->tag == tag)) {
-          *vaddr = it->vaddr;
-          addr_q.erase(it);
-          return NO_ERROR;
-        }
-      }
-      if (!block) return NOT_READY;
-      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
-        if (getenv("ACCL_RT_DEBUG"))
-          fprintf(stderr, "[r%u] get_addr timeout src=%u bytes=%llu addr_q=%zu\n",
-                  rank, src, (unsigned long long)bytes, addr_q.size());
-        return RECEIVE_TIMEOUT_ERROR;
+                               uint64_t *vaddr) {
+    std::lock_guard<std::mutex> lk(rndzv_mu);
+    for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
+      if (it->src == src && it->bytes == bytes &&
+          (tag == TAG_ANY || it->tag == tag)) {
+        *vaddr = it->vaddr;
+        addr_q.erase(it);
+        return NO_ERROR;
       }
     }
-  }
-
-  uint32_t rendezvous_get_any_addr(uint64_t bytes, uint32_t tag,
-                                   uint32_t *src, uint64_t *vaddr) {
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock<std::mutex> lk(rndzv_mu);
-    for (;;) {
-      for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
-        if (it->bytes == bytes && (tag == TAG_ANY || it->tag == tag)) {
-          *src = it->src;
-          *vaddr = it->vaddr;
-          addr_q.erase(it);
-          return NO_ERROR;
-        }
-      }
-      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout)
-        return RECEIVE_TIMEOUT_ERROR;
-    }
+    return NOT_READY;
   }
 
   uint32_t rendezvous_write(uint32_t dst, uint64_t remote_vaddr,
@@ -812,197 +808,267 @@ struct accl_rt {
                : RECEIVE_TIMEOUT_ERROR;
   }
 
-  // Drop postings matching the filter (src == UINT32_MAX matches any
-  // peer, vaddr == 0 matches any address): called with rndzv_mu HELD when
-  // a completion wait times out, so a late write cannot land in a buffer
-  // the caller is about to free. An exact (src, vaddr) filter erases at
-  // most one entry so other in-flight recvs keep their postings.
+  // Drop the posting matching (src, vaddr, bytes, tag) — called with
+  // rndzv_mu HELD on timeout/error revocation, so a late write cannot
+  // land in a buffer the caller is about to free. Erases at most one
+  // entry so other in-flight recvs keep their postings.
   void revoke_posted_locked(uint32_t src, uint64_t vaddr, uint64_t bytes,
                             uint32_t tag) {
-    for (auto it = posted_addrs.begin(); it != posted_addrs.end();) {
-      if ((src == UINT32_MAX || it->src == src) &&
-          (vaddr == 0 || it->vaddr == vaddr) && it->bytes == bytes &&
+    for (auto it = posted_addrs.begin(); it != posted_addrs.end(); ++it) {
+      if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
           (tag == TAG_ANY || it->tag == tag)) {
-        it = posted_addrs.erase(it);
-        if (vaddr != 0) return;  // exact posting: done
-      } else {
-        ++it;
+        posted_addrs.erase(it);
+        return;
       }
     }
   }
 
-  uint32_t rendezvous_get_completion(uint32_t src, uint64_t vaddr,
-                                     uint64_t bytes, uint32_t tag) {
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock<std::mutex> lk(rndzv_mu);
-    auto match = [&]() -> bool {
-      for (auto it = done_q.begin(); it != done_q.end(); ++it) {
-        if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
-            (tag == TAG_ANY || it->tag == tag)) {
-          done_q.erase(it);
-          return true;
-        }
-      }
-      return false;
-    };
-    for (;;) {
-      if (match()) return NO_ERROR;
-      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
-        // a write may have landed exactly as the wait expired: re-check
-        // before revoking, or the completion would be orphaned and a
-        // future recv of the same signature falsely satisfied by it
-        if (match()) return NO_ERROR;
-        if (getenv("ACCL_RT_DEBUG"))
-          fprintf(stderr, "[r%u] get_completion timeout src=%u bytes=%llu done_q=%zu\n",
-                  rank, src, (unsigned long long)bytes, done_q.size());
-        revoke_posted_locked(src, vaddr, bytes, tag);
-        return RECEIVE_TIMEOUT_ERROR;
+  // Non-blocking completion checks (the blocking variants are gone: every
+  // receive dependency in the sequencer is NOT_READY-resumable, so waiting
+  // happens by requeue, never inside a collective).
+  uint32_t rndzv_completion_nb(uint32_t src, uint64_t vaddr, uint64_t bytes,
+                               uint32_t tag) {
+    std::lock_guard<std::mutex> lk(rndzv_mu);
+    for (auto it = done_q.begin(); it != done_q.end(); ++it) {
+      if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
+          (tag == TAG_ANY || it->tag == tag)) {
+        done_q.erase(it);
+        return NO_ERROR;
       }
     }
+    return NOT_READY;
   }
 
-  uint32_t rendezvous_get_any_completion(uint64_t bytes, uint32_t tag,
-                                         uint32_t *src, uint64_t *vaddr) {
-    auto deadline =
-        std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
-    std::unique_lock<std::mutex> lk(rndzv_mu);
-    auto match = [&]() -> bool {
-      for (auto it = done_q.begin(); it != done_q.end(); ++it) {
-        if (it->bytes == bytes && (tag == TAG_ANY || it->tag == tag)) {
+  // "Any" matching is scoped to the addresses THIS call posted: with
+  // resumable state machines, two rendezvous collectives on disjoint
+  // communicators can be in flight on one rank at once, and an unscoped
+  // (bytes, tag) match would let one call consume the other's completion
+  // and combine foreign data.
+  uint32_t rndzv_any_posted_completion_nb(const std::deque<RndzvAddr> &posted,
+                                          uint64_t bytes, uint32_t tag,
+                                          uint32_t *src, uint64_t *vaddr) {
+    std::lock_guard<std::mutex> lk(rndzv_mu);
+    for (auto it = done_q.begin(); it != done_q.end(); ++it) {
+      if (it->bytes != bytes || !(tag == TAG_ANY || it->tag == tag)) continue;
+      for (const auto &pa : posted) {
+        if (pa.vaddr == it->vaddr && pa.src == it->src) {
           *src = it->src;
           *vaddr = it->vaddr;
           done_q.erase(it);
-          return true;
+          return NO_ERROR;
         }
       }
-      return false;
-    };
-    for (;;) {
-      if (match()) return NO_ERROR;
-      if (rndzv_cv.wait_until(lk, deadline) == std::cv_status::timeout) {
-        if (match()) return NO_ERROR;  // landed at the deadline edge
-        if (getenv("ACCL_RT_DEBUG"))
-          fprintf(stderr, "[r%u] get_any_completion timeout bytes=%llu\n", rank,
-                  (unsigned long long)bytes);
-        revoke_posted_locked(UINT32_MAX, 0, bytes, tag);
-        return RECEIVE_TIMEOUT_ERROR;
-      }
     }
+    return NOT_READY;
   }
 
-  // ----- point-to-point over both protocols (send .c:573-649) -----
+  // (The eager/rendezvous split itself lives on Ops::rndzv, evaluated
+  // against the per-call config snapshot. The datagram POE is eager-only:
+  // rendezvous message types exist only on the RDMA stack in the
+  // reference, eth_intf.h:42-45.)
 
-  bool is_rndzv(uint64_t bytes) const {
-    // the datagram POE is eager-only (reference: rendezvous message types
-    // exist only on the RDMA stack, eth_intf.h:42-45); large messages
-    // segment through the rx ring instead
-    return !udp_mode && bytes > max_eager;
-  }
+  // ----- resumable op layer ----------------------------------------------
+  // Every do_* below is a DETERMINISTIC sequence of ops (sends, receives,
+  // rendezvous posts/completions, local mutations). Ops replays the
+  // sequence on each (re-)entry: ops with index < current_step are skipped
+  // (their side effects persist in caller memory or CollState), the op AT
+  // current_step executes, and the first NOT_READY aborts the pass so the
+  // sequencer requeues the call with current_step saved — the firmware
+  // retry contract (ccl_offload_control.c:2308-2483) for EVERY collective,
+  // not just SC_RECV. No receive dependency ever blocks the sequencer
+  // thread; eager sends can still backpressure on a full TCP socket, as
+  // the reference firmware does on a full TX FIFO.
+  struct Ops {
+    accl_rt &rt;
+    Call &c;
+    CollState &st;
+    uint32_t tag;
+    uint32_t idx = 0;
 
-  uint32_t p2p_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
-                    uint32_t tag) {
-    if (is_rndzv(bytes)) {
-      if (bytes > max_rndzv) return DMA_SIZE_ERROR;  // configured ceiling
-      uint64_t vaddr;
-      uint32_t rc = rendezvous_get_addr(dst, bytes, tag, &vaddr);
-      if (rc != NO_ERROR) return rc;
-      return rendezvous_write(dst, vaddr, ptr, bytes, tag);
+    template <class F> uint32_t op(F f) {
+      uint32_t i = idx++;
+      if (i < c.current_step) return NO_ERROR;  // replayed: already done
+      uint32_t rc = f();
+      if (rc == NO_ERROR) c.current_step = i + 1;
+      return rc;
     }
-    return egr_send(dst, ptr, bytes, tag);
-  }
-
-  uint32_t p2p_recv(uint32_t src, uint8_t *ptr, uint64_t bytes, uint32_t tag) {
-    if (is_rndzv(bytes)) {
-      if (bytes > max_rndzv) return DMA_SIZE_ERROR;
-      rendezvous_send_addr(src, (uint64_t)(uintptr_t)ptr, bytes, tag);
-      return rendezvous_get_completion(src, (uint64_t)(uintptr_t)ptr, bytes,
-                                       tag);
+    // protocol split from the per-call SNAPSHOT, not live config: a
+    // config call between requeue passes must not shift the op sequence
+    bool rndzv(uint64_t n) const { return !rt.udp_mode && n > st.max_eager; }
+    // one-shot local mutation (scratch init, result memcpy): gated so a
+    // resumed pass cannot clobber accumulated progress
+    template <class F> void local(F f) {
+      op([&] { f(); return (uint32_t)NO_ERROR; });
     }
-    return egr_recv(src, tag, ptr, bytes);
-  }
+    // eager or rendezvous send; the rendezvous address wait is NOT_READY
+    // instead of blocking
+    uint32_t send(uint32_t gdst, const uint8_t *p, uint64_t n) {
+      return op([&]() -> uint32_t {
+        if (rndzv(n)) {
+          if (n > st.max_rndzv) return DMA_SIZE_ERROR;  // configured ceiling
+          uint64_t va;
+          uint32_t rc = rt.rendezvous_get_addr(gdst, n, tag, &va);
+          if (rc != NO_ERROR) return rc;
+          return rt.rendezvous_write(gdst, va, p, n, tag);
+        }
+        return rt.egr_send(gdst, p, n, tag);
+      });
+    }
+    // post this rank's landing address (one-shot; tracked for timeout
+    // revocation)
+    uint32_t post(uint32_t gsrc, uint8_t *p, uint64_t n) {
+      return op([&]() -> uint32_t {
+        rt.rendezvous_send_addr(gsrc, (uint64_t)(uintptr_t)p, n, tag);
+        st.posted.push_back({gsrc, (uint64_t)(uintptr_t)p, n, tag, 0});
+        return NO_ERROR;
+      });
+    }
+    uint32_t completion(uint32_t gsrc, uint8_t *p, uint64_t n) {
+      return op([&]() -> uint32_t {
+        uint32_t rc =
+            rt.rndzv_completion_nb(gsrc, (uint64_t)(uintptr_t)p, n, tag);
+        if (rc == NO_ERROR) st.unpost((uint64_t)(uintptr_t)p);
+        return rc;
+      });
+    }
+    // consume ANY completion landing in one of THIS call's postings, then
+    // run fn(src, vaddr) inside the same op (reduce-root combines ride
+    // here)
+    template <class F> uint32_t any_completion_then(uint64_t n, F fn) {
+      return op([&]() -> uint32_t {
+        uint32_t s;
+        uint64_t va;
+        uint32_t rc =
+            rt.rndzv_any_posted_completion_nb(st.posted, n, tag, &s, &va);
+        if (rc != NO_ERROR) return rc;
+        st.unpost(va);
+        return fn(s, va);
+      });
+    }
+    // eager or rendezvous receive. Eager lands segment-by-segment with
+    // st.off tracking partial progress within the op; rendezvous posts
+    // once (st.off as the posted marker) then polls the completion.
+    // strict=false is the SC_RECV contract: a head-tag mismatch stays
+    // NOT_READY because another parked recv may legally consume it.
+    uint32_t recv(uint32_t gsrc, uint8_t *p, uint64_t n, bool strict = true) {
+      return op([&]() -> uint32_t {
+        if (rndzv(n)) {
+          if (n > st.max_rndzv) return DMA_SIZE_ERROR;
+          uint64_t va = (uint64_t)(uintptr_t)p;
+          if (st.off == 0) {
+            rt.rendezvous_send_addr(gsrc, va, n, tag);
+            st.posted.push_back({gsrc, va, n, tag, 0});
+            st.off = 1;  // posted marker
+          }
+          uint32_t rc = rt.rndzv_completion_nb(gsrc, va, n, tag);
+          if (rc == NO_ERROR) {
+            st.off = 0;
+            st.unpost(va);
+          }
+          return rc;
+        }
+        if (rt.udp_mode && n > st.max_rndzv) return DMA_SIZE_ERROR;
+        std::lock_guard<std::mutex> lk(rt.rx_mu);
+        for (;;) {
+          uint64_t got = 0;
+          uint32_t rc = rt.seek_locked(gsrc, tag, p ? p + st.off : nullptr,
+                                       n - st.off, &got, strict);
+          if (rc != NO_ERROR) return rc;  // NOT_READY keeps st.off progress
+          st.off += got;
+          if (st.off >= n) break;  // n == 0: one zero-length segment
+        }
+        st.off = 0;
+        return NO_ERROR;
+      });
+    }
+  };
 
   // ----- collective algorithms (firmware ports; cites in each) -----
+  // All are replayed op sequences over Ops (see above): any nonzero return
+  // aborts the pass — NOT_READY requeues with progress saved, real errors
+  // complete the call.
 
-  uint32_t do_bcast(const CommView &cm, uint8_t *buf, uint64_t bytes,
-                    uint32_t root, uint32_t tag) {
+  uint32_t do_bcast(Ops &o, const CommView &cm, uint8_t *buf, uint64_t bytes,
+                    uint32_t root) {
     if (cm.world == 1) return NO_ERROR;
-    if (is_rndzv(bytes) &&
-        cm.world > tuning(BCAST_FLAT_TREE_MAX_RANKS, 3)) {
-      // binary distance-doubling tree (.c:814-867)
+    uint32_t rc;
+    if (o.rndzv(bytes) && cm.world > o.st.tun_bcast_ranks) {
+      // binary distance-doubling tree (.c:814-867). `sender` flips on a
+      // completed-or-replayed recv, so resumed passes recompute it.
       uint32_t l = (cm.rank + cm.world - root) % cm.world;
       bool sender = (cm.rank == root);
       uint32_t d = 1;
       while ((d << 1) <= cm.world - 1) d <<= 1;
-      uint32_t err = NO_ERROR;
       while (d > 0) {
         if (sender && l % (2 * d) == 0 && l + d < cm.world) {
           uint32_t peer = (l + d + root) % cm.world;
-          err |= p2p_send(cm.g(peer), buf, bytes, tag);
+          if ((rc = o.send(cm.g(peer), buf, bytes))) return rc;
         } else if (!sender && l % d == 0 && l >= d && (l - d) % (2 * d) == 0) {
           uint32_t peer = (l - d + root) % cm.world;
-          err |= p2p_recv(cm.g(peer), buf, bytes, tag);
+          if ((rc = o.recv(cm.g(peer), buf, bytes))) return rc;
           sender = true;
         }
         d >>= 1;
       }
-      return err;
+      return NO_ERROR;
     }
     // flat fan-out, eager or rendezvous (.c:868-988)
-    uint32_t err = NO_ERROR;
     if (cm.rank == root) {
       for (uint32_t i = 0; i < cm.world; i++)
-        if (i != root) err |= p2p_send(cm.g(i), buf, bytes, tag);
+        if (i != root && (rc = o.send(cm.g(i), buf, bytes))) return rc;
     } else {
-      err |= p2p_recv(cm.g(root), buf, bytes, tag);
+      if ((rc = o.recv(cm.g(root), buf, bytes))) return rc;
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_scatter(const CommView &cm, const uint8_t *src, uint8_t *dst,
-                      uint64_t bytes, uint32_t root, uint32_t tag) {
-    uint32_t err = NO_ERROR;
+  uint32_t do_scatter(Ops &o, const CommView &cm, const uint8_t *src,
+                      uint8_t *dst, uint64_t bytes, uint32_t root) {
+    uint32_t rc;
     if (cm.rank == root) {
       for (uint32_t i = 0; i < cm.world; i++) {
         if (i == root) continue;
-        err |= p2p_send(cm.g(i), src + (uint64_t)i * bytes, bytes, tag);
+        if ((rc = o.send(cm.g(i), src + (uint64_t)i * bytes, bytes)))
+          return rc;
       }
-      std::memcpy(dst, src + (uint64_t)root * bytes, bytes);
+      o.local([&] { std::memcpy(dst, src + (uint64_t)root * bytes, bytes); });
     } else {
-      err |= p2p_recv(cm.g(root), dst, bytes, tag);
+      if ((rc = o.recv(cm.g(root), dst, bytes))) return rc;
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_gather(const CommView &cm, const uint8_t *src, uint8_t *dst,
-                     uint64_t bytes, uint32_t root, uint32_t tag) {
+  uint32_t do_gather(Ops &o, const CommView &cm, const uint8_t *src,
+                     uint8_t *dst, uint64_t bytes, uint32_t root) {
     // eager: ring daisy-chain (.c:1206-1293); rendezvous: flat to root
     // (.c:1142-1204). The ring keeps per-link traffic constant.
-    uint32_t err = NO_ERROR;
-    if (!is_rndzv(bytes)) {
+    uint32_t rc;
+    CollState &st = o.st;
+    if (!o.rndzv(bytes)) {
       uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
       uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
+      st.tmp.resize(bytes);  // relay buffer survives requeues
       if (cm.rank == root) {
-        std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
-        std::vector<uint8_t> tmp(bytes);
+        o.local([&] { std::memcpy(dst + (uint64_t)root * bytes, src, bytes); });
         for (uint32_t s = 0; s < cm.world - 1; s++) {
-          err |= egr_recv(prv, tag, tmp.data(), bytes);
+          if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
           uint32_t origin = (root + cm.world - 1 - s) % cm.world;
-          std::memcpy(dst + (uint64_t)origin * bytes, tmp.data(), bytes);
+          o.local([&] {
+            std::memcpy(dst + (uint64_t)origin * bytes, st.tmp.data(), bytes);
+          });
         }
       } else {
         // relay: own data first, then forward everything originating
         // farther from root than us — world-1-dist(rank) messages, where
         // dist is the +1-direction hop count to root.
-        err |= egr_send(nxt, src, bytes, tag);
+        if ((rc = o.send(nxt, src, bytes))) return rc;
         uint32_t dist = (root + cm.world - cm.rank) % cm.world;
-        std::vector<uint8_t> tmp(bytes);
         for (uint32_t s = 0; s + 1 + dist < cm.world; s++) {
-          err |= egr_recv(prv, tag, tmp.data(), bytes);
-          err |= egr_send(nxt, tmp.data(), bytes, tag);
+          if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
+          if ((rc = o.send(nxt, st.tmp.data(), bytes))) return rc;
         }
       }
-      return err;
+      return NO_ERROR;
     }
     // fan-in cap (accl.cpp:1200-1201 via the tuning registers, same rule
     // as plan.py gather selection): above the count threshold the flat
@@ -1010,8 +1076,8 @@ struct accl_rt {
     // world-1 selects the radix-2 binomial on BOTH executors (the XLA
     // gather_flat_schedule makes the identical binary choice), so the
     // register is a threshold switch, not a radix.
-    uint32_t fanin = bytes > tuning(GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024)
-                         ? std::max(tuning(GATHER_FLAT_TREE_MAX_FANIN, 2), 1u)
+    uint32_t fanin = bytes > st.tun_gather_count
+                         ? std::max(st.tun_gather_fanin, 1u)
                          : cm.world - 1;
     if (fanin < cm.world - 1) {
       // binomial: normalized rank l accumulates subtree chunks
@@ -1020,197 +1086,197 @@ struct accl_rt {
       // flat tree would send (the rendezvous ceiling applies per chunk).
       // The accumulation buffer holds only this rank's maximum subtree
       // (lowest set bit of l), not the full world, indexed relative to l.
+      // `have` is recomputed by the replay as recv ops report done.
       uint32_t l = (cm.rank + cm.world - root) % cm.world;
       uint32_t max_have =
           l == 0 ? cm.world : std::min(l & (~l + 1), cm.world - l);
-      std::vector<uint8_t> acc((uint64_t)max_have * bytes);
-      std::memcpy(acc.data(), src, bytes);  // relative chunk 0 == chunk l
+      st.acc.resize((uint64_t)max_have * bytes);
+      o.local([&] { std::memcpy(st.acc.data(), src, bytes); });
       uint32_t have = 1;  // chunks accumulated at [l, l + have)
       for (uint32_t d = 1; d < cm.world; d <<= 1) {
         if (l % (2 * d) == d) {
           uint32_t parent = (l - d + root) % cm.world;
-          for (uint32_t c = 0; c < have && err == NO_ERROR; c++)
-            err |= p2p_send(cm.g(parent), acc.data() + (uint64_t)c * bytes,
-                            bytes, tag);
-          return err;  // subtree delivered
+          for (uint32_t ci = 0; ci < have; ci++)
+            if ((rc = o.send(cm.g(parent),
+                             st.acc.data() + (uint64_t)ci * bytes, bytes)))
+              return rc;
+          return NO_ERROR;  // subtree delivered
         }
         if (l % (2 * d) == 0 && l + d < cm.world) {
           uint32_t child = (l + d + root) % cm.world;
           uint32_t n_ch = std::min(d, cm.world - (l + d));
-          for (uint32_t c = 0; c < n_ch; c++) {
-            err |= p2p_recv(cm.g(child),
-                            acc.data() + (uint64_t)(d + c) * bytes, bytes,
-                            tag);
-            if (err) return err;
-          }
+          for (uint32_t ci = 0; ci < n_ch; ci++)
+            if ((rc = o.recv(cm.g(child),
+                             st.acc.data() + (uint64_t)(d + ci) * bytes,
+                             bytes)))
+              return rc;
           have += n_ch;
         }
       }
       // root (l == 0) de-normalizes chunk order into dst
-      for (uint32_t ln = 0; ln < cm.world; ln++) {
-        uint32_t g = (ln + root) % cm.world;
-        std::memcpy(dst + (uint64_t)g * bytes,
-                    acc.data() + (uint64_t)ln * bytes, bytes);
-      }
-      return err;
+      o.local([&] {
+        for (uint32_t ln = 0; ln < cm.world; ln++) {
+          uint32_t g = (ln + root) % cm.world;
+          std::memcpy(dst + (uint64_t)g * bytes,
+                      st.acc.data() + (uint64_t)ln * bytes, bytes);
+        }
+      });
+      return NO_ERROR;
     }
     if (cm.rank == root) {
-      std::memcpy(dst + (uint64_t)root * bytes, src, bytes);
+      o.local([&] { std::memcpy(dst + (uint64_t)root * bytes, src, bytes); });
       for (uint32_t i = 0; i < cm.world; i++) {
         if (i == root) continue;
-        rendezvous_send_addr(cm.g(i),
-                             (uint64_t)(uintptr_t)(dst + (uint64_t)i * bytes),
-                             bytes, tag);
+        if ((rc = o.post(cm.g(i), dst + (uint64_t)i * bytes, bytes)))
+          return rc;
       }
-      for (uint32_t i = 0; i + 1 < cm.world; i++) {
-        uint32_t s;
-        uint64_t va;
-        err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
-      }
+      for (uint32_t i = 0; i + 1 < cm.world; i++)
+        if ((rc = o.any_completion_then(
+                 bytes, [](uint32_t, uint64_t) { return (uint32_t)NO_ERROR; })))
+          return rc;
     } else {
-      uint64_t vaddr;
-      err |= rendezvous_get_addr(cm.g(root), bytes, tag, &vaddr);
-      if (err == NO_ERROR)
-        err |= rendezvous_write(cm.g(root), vaddr, src, bytes, tag);
+      if ((rc = o.send(cm.g(root), src, bytes))) return rc;
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_allgather(const CommView &cm, const uint8_t *src, uint8_t *dst,
-                        uint64_t bytes, uint32_t tag) {
-    // ring allgather in both protocols (.c:1297-1499)
+  uint32_t do_allgather(Ops &o, const CommView &cm, const uint8_t *src,
+                        uint8_t *dst, uint64_t bytes) {
+    // ring allgather in both protocols (.c:1297-1499). send_ptr rotates
+    // deterministically through dst regions already final, so the replay
+    // recomputes it.
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    uint32_t err = NO_ERROR;
-    std::memcpy(dst + (uint64_t)cm.rank * bytes, src, bytes);
+    uint32_t rc;
+    o.local([&] { std::memcpy(dst + (uint64_t)cm.rank * bytes, src, bytes); });
     const uint8_t *send_ptr = src;
     for (uint32_t s = 0; s < cm.world - 1; s++) {
       uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
       uint8_t *recv_ptr = dst + (uint64_t)origin * bytes;
-      // send current, then receive from prev (socket buffering absorbs the
-      // send so the ring cannot deadlock at these sizes; rendezvous path
-      // posts the recv address first by construction of p2p_recv)
-      if (is_rndzv(bytes)) {
-        rendezvous_send_addr(prv, (uint64_t)(uintptr_t)recv_ptr, bytes, tag);
-        uint64_t vaddr;
-        err |= rendezvous_get_addr(nxt, bytes, tag, &vaddr);
-        if (err) return err;
-        err |= rendezvous_write(nxt, vaddr, send_ptr, bytes, tag);
-        err |= rendezvous_get_completion(prv, (uint64_t)(uintptr_t)recv_ptr,
-                                         bytes, tag);
+      // post our landing first, then send (the peer's address for our
+      // write arrives symmetrically); eager sends before receives, socket
+      // buffering absorbing the send so the ring cannot deadlock
+      if (o.rndzv(bytes)) {
+        if ((rc = o.post(prv, recv_ptr, bytes))) return rc;
+        if ((rc = o.send(nxt, send_ptr, bytes))) return rc;
+        if ((rc = o.completion(prv, recv_ptr, bytes))) return rc;
       } else {
-        err |= egr_send(nxt, send_ptr, bytes, tag);
-        err |= egr_recv(prv, tag, recv_ptr, bytes);
+        if ((rc = o.send(nxt, send_ptr, bytes))) return rc;
+        if ((rc = o.recv(prv, recv_ptr, bytes))) return rc;
       }
-      if (err) return err;
       send_ptr = recv_ptr;
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_reduce(const CommView &cm, uint32_t dt, uint32_t func,
+  uint32_t do_reduce(Ops &o, const CommView &cm, uint32_t dt, uint32_t func,
                      const uint8_t *src, uint8_t *dst, uint64_t count,
-                     uint32_t root, uint32_t tag) {
+                     uint32_t root) {
     uint64_t bytes = count * dtype_bytes(dt);
-    uint32_t err = NO_ERROR;
+    uint32_t rc;
+    CollState &st = o.st;
     if (cm.world == 1) {
-      std::memcpy(dst, src, bytes);
+      o.local([&] { std::memcpy(dst, src, bytes); });
       return NO_ERROR;
     }
-    if (!is_rndzv(bytes)) {
+    if (!o.rndzv(bytes)) {
       // eager ring relay with fused recv-reduce-send (.c:1730-1743)
       uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
       uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
       uint32_t l = (cm.rank + cm.world - root) % cm.world;  // root at 0
-      std::vector<uint8_t> acc(src, src + bytes);
+      st.acc.resize(bytes);
+      o.local([&] { std::memcpy(st.acc.data(), src, bytes); });
       if (l != 1) {  // everyone except the chain head receives a partial
-        err |= egr_recv(prv, tag, acc.data(), bytes);
-        if (err) return err;
-        err |= combine_buffers(dt, func, acc.data(), src, count);
+        if ((rc = o.recv(prv, st.acc.data(), bytes))) return rc;
+        if ((rc = o.op([&] {
+               return combine_buffers(dt, func, st.acc.data(), src, count);
+             })))
+          return rc;
       }
       if (cm.rank != root) {
-        err |= egr_send(nxt, acc.data(), bytes, tag);
+        if ((rc = o.send(nxt, st.acc.data(), bytes))) return rc;
       } else {
-        std::memcpy(dst, acc.data(), bytes);
+        o.local([&] { std::memcpy(dst, st.acc.data(), bytes); });
       }
-      return err;
+      return NO_ERROR;
     }
     // rendezvous: flat tree when small world/message, else binomial
     // (.c:1531-1727)
-    bool flat = cm.world <= tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4) ||
-                bytes <= tuning(REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024);
+    bool flat = cm.world <= st.tun_reduce_ranks ||
+                bytes <= st.tun_reduce_count;
     uint32_t l = (cm.rank + cm.world - root) % cm.world;
     if (flat) {
       if (cm.rank == root) {
-        std::vector<uint8_t> scratch((uint64_t)(cm.world - 1) * bytes);
+        // landing slots must stay allocated (and un-moved) until every
+        // posted write completes: st.acc persists across requeues
+        st.acc.resize((uint64_t)(cm.world - 1) * bytes);
         for (uint32_t i = 0, j = 0; i < cm.world; i++) {
           if (i == root) continue;
-          rendezvous_send_addr(
-              cm.g(i),
-              (uint64_t)(uintptr_t)(scratch.data() + (uint64_t)j * bytes),
-              bytes, tag);
+          if ((rc = o.post(cm.g(i), st.acc.data() + (uint64_t)j * bytes,
+                           bytes)))
+            return rc;
           j++;
         }
-        std::memcpy(dst, src, bytes);
-        for (uint32_t i = 0; i + 1 < cm.world; i++) {
-          uint32_t s;
-          uint64_t va;
-          err |= rendezvous_get_any_completion(bytes, tag, &s, &va);
-          if (err) return err;
-          err |= combine_buffers(dt, func, dst, (void *)(uintptr_t)va, count);
-        }
+        o.local([&] { std::memcpy(dst, src, bytes); });
+        for (uint32_t i = 0; i + 1 < cm.world; i++)
+          if ((rc = o.any_completion_then(bytes, [&](uint32_t, uint64_t va) {
+                 return combine_buffers(dt, func, dst,
+                                        (void *)(uintptr_t)va, count);
+               })))
+            return rc;
       } else {
-        uint64_t vaddr;
-        err |= rendezvous_get_addr(cm.g(root), bytes, tag, &vaddr);
-        if (err) return err;
-        err |= rendezvous_write(cm.g(root), vaddr, src, bytes, tag);
+        if ((rc = o.send(cm.g(root), src, bytes))) return rc;
       }
-      return err;
+      return NO_ERROR;
     }
     // binomial combining tree: children l%2d==d send to parent l-d
-    std::vector<uint8_t> acc(src, src + bytes);
-    std::vector<uint8_t> tmp(bytes);
+    st.acc.resize(bytes);
+    st.tmp.resize(bytes);
+    o.local([&] { std::memcpy(st.acc.data(), src, bytes); });
     for (uint32_t d = 1; d < cm.world; d <<= 1) {
       if (l % (2 * d) == d) {
         uint32_t peer = (l - d + root) % cm.world;
-        err |= p2p_send(cm.g(peer), acc.data(), bytes, tag);
-        return err;  // sent our subtree: done
+        return o.send(cm.g(peer), st.acc.data(), bytes);  // subtree done
       }
       if (l % (2 * d) == 0 && l + d < cm.world) {
         uint32_t peer = (l + d + root) % cm.world;
-        err |= p2p_recv(cm.g(peer), tmp.data(), bytes, tag);
-        if (err) return err;
-        err |= combine_buffers(dt, func, acc.data(), tmp.data(), count);
+        if ((rc = o.recv(cm.g(peer), st.tmp.data(), bytes))) return rc;
+        if ((rc = o.op([&] {
+               return combine_buffers(dt, func, st.acc.data(), st.tmp.data(),
+                                      count);
+             })))
+          return rc;
       }
     }
-    if (cm.rank == root) std::memcpy(dst, acc.data(), bytes);
-    return err;
+    if (cm.rank == root)
+      o.local([&] { std::memcpy(dst, st.acc.data(), bytes); });
+    return NO_ERROR;
   }
 
-  uint32_t do_allreduce(const CommView &cm, uint32_t dt, uint32_t func,
-                        const uint8_t *src, uint8_t *dst, uint64_t count,
-                        uint32_t tag) {
+  uint32_t do_allreduce(Ops &o, const CommView &cm, uint32_t dt,
+                        uint32_t func, const uint8_t *src, uint8_t *dst,
+                        uint64_t count) {
     uint64_t eb = dtype_bytes(dt);
     uint64_t bytes = count * eb;
+    uint32_t rc;
+    CollState &st = o.st;
     if (cm.world == 1) {
-      std::memcpy(dst, src, bytes);
+      o.local([&] { std::memcpy(dst, src, bytes); });
       return NO_ERROR;
     }
-    if (is_rndzv(bytes)) {
-      // reduce + bcast composition (.c:1878-1887)
-      uint32_t err = do_reduce(cm, dt, func, src, dst, count, 0, tag);
-      if (err) return err;
-      return do_bcast(cm, dst, bytes, 0, tag);
+    if (o.rndzv(bytes)) {
+      // reduce + bcast composition (.c:1878-1887): the nested calls share
+      // this call's op index space, so the replay walks straight through
+      if ((rc = do_reduce(o, cm, dt, func, src, dst, count, 0))) return rc;
+      return do_bcast(o, cm, dst, bytes, 0);
     }
     // segmented ring reduce-scatter + allgather (.c:1888-2071)
     uint64_t max_seg = rx_buf_bytes / eb;
     max_seg -= max_seg % cm.world;
     if (max_seg == 0) max_seg = cm.world;
-    std::vector<uint8_t> chunk_buf, tmp;
-    std::memcpy(dst, src, bytes);
+    o.local([&] { std::memcpy(dst, src, bytes); });
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    uint32_t err = NO_ERROR;
     for (uint64_t off = 0; off < count; off += max_seg) {
       uint64_t elems = std::min<uint64_t>(max_seg, count - off);
       uint64_t bulk = (elems + cm.world - 1) / cm.world;
@@ -1221,81 +1287,99 @@ struct accl_rt {
       };
       uint8_t *seg = dst + off * eb;
       // reduce-scatter: send chunk rank-1 first; hop-s arrival is chunk
-      // rank-2-s (same derivation as schedules.reduce_scatter_ring)
+      // rank-2-s (same derivation as schedules.reduce_scatter_ring).
+      // The send is one single-shot op: it reads the region exactly once
+      // at execution time, before the allgather phase mutates it, and a
+      // replayed (completed) op never re-reads.
       uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
       auto [clo, cn] = seg_chunk(cidx);
-      chunk_buf.assign(seg + clo * eb, seg + (clo + cn) * eb);
-      err |= egr_send(nxt, chunk_buf.data(), cn * eb, tag);
+      if ((rc = o.op([&, clo = clo, cn = cn] {
+             return egr_send(nxt, seg + clo * eb, cn * eb, o.tag);
+           })))
+        return rc;
       for (uint32_t s = 0; s < cm.world - 1; s++) {
         uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
         auto [lo, n] = seg_chunk(idx);
-        tmp.resize(n * eb);
-        err |= egr_recv(prv, tag, tmp.data(), n * eb);
-        if (err) return err;
-        err |= combine_buffers(dt, func, seg + lo * eb, tmp.data(), n);
-        if (s + 1 < cm.world - 1)
-          err |= egr_send(nxt, seg + lo * eb, n * eb, tag);
+        st.tmp.resize(n * eb);
+        if ((rc = o.recv(prv, st.tmp.data(), n * eb))) return rc;
+        if ((rc = o.op([&, lo = lo, n = n] {
+               return combine_buffers(dt, func, seg + lo * eb, st.tmp.data(),
+                                      n);
+             })))
+          return rc;
+        if (s + 1 < cm.world - 1 &&
+            (rc = o.send(nxt, seg + lo * eb, n * eb)))
+          return rc;
       }
       // ring allgather of reduced chunks (chunk `rank` now final)
       uint32_t gidx = cm.rank;
       for (uint32_t s = 0; s < cm.world - 1; s++) {
         auto [glo, gn] = seg_chunk(gidx);
-        err |= egr_send(nxt, seg + glo * eb, gn * eb, tag);
+        if ((rc = o.send(nxt, seg + glo * eb, gn * eb))) return rc;
         uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
         auto [olo, on] = seg_chunk(origin);
-        err |= egr_recv(prv, tag, seg + olo * eb, on * eb);
-        if (err) return err;
+        if ((rc = o.recv(prv, seg + olo * eb, on * eb))) return rc;
         gidx = origin;
       }
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_reduce_scatter(const CommView &cm, uint32_t dt, uint32_t func,
-                             const uint8_t *src, uint8_t *dst, uint64_t count,
-                             uint32_t tag) {
+  uint32_t do_reduce_scatter(Ops &o, const CommView &cm, uint32_t dt,
+                             uint32_t func, const uint8_t *src, uint8_t *dst,
+                             uint64_t count) {
     // count = per-rank output elements; input holds world*count.
     uint64_t eb = dtype_bytes(dt);
     uint64_t bytes = count * eb;
+    uint32_t rc;
+    CollState &st = o.st;
     if (cm.world == 1) {
-      std::memcpy(dst, src, bytes);
+      o.local([&] { std::memcpy(dst, src, bytes); });
       return NO_ERROR;
     }
-    if (is_rndzv(bytes)) {
-      // reduce(count*world) to 0 then scatter (.c:1768-1781)
-      std::vector<uint8_t> full((uint64_t)cm.world * bytes);
-      uint32_t err = do_reduce(cm, dt, func, src, full.data(),
-                               (uint64_t)count * cm.world, 0, tag);
-      if (err) return err;
-      return do_scatter(cm, full.data(), dst, bytes, 0, tag);
+    if (o.rndzv(bytes)) {
+      // reduce(count*world) to 0 then scatter (.c:1768-1781); st.full is
+      // the composition's intermediate (do_reduce owns st.acc/st.tmp)
+      st.full.resize((uint64_t)cm.world * bytes);
+      if ((rc = do_reduce(o, cm, dt, func, src, st.full.data(),
+                          (uint64_t)count * cm.world, 0)))
+        return rc;
+      return do_scatter(o, cm, st.full.data(), dst, bytes, 0);
     }
     // eager ring (.c:1782-1850)
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    uint32_t err = NO_ERROR;
-    std::vector<uint8_t> acc(bytes), tmp(bytes);
+    st.tmp.resize(bytes);
     uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
-    std::memcpy(acc.data(), src + (uint64_t)cidx * bytes, bytes);
-    err |= egr_send(nxt, acc.data(), bytes, tag);
+    // single-shot op: reads src exactly once at execution time
+    if ((rc = o.op([&] {
+           return egr_send(nxt, src + (uint64_t)cidx * bytes, bytes, o.tag);
+         })))
+      return rc;
     for (uint32_t s = 0; s < cm.world - 1; s++) {
       uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
-      err |= egr_recv(prv, tag, tmp.data(), bytes);
-      if (err) return err;
-      err |= combine_buffers(dt, func, tmp.data(),
-                             src + (uint64_t)idx * bytes, count);
-      if (s + 1 < cm.world - 1) err |= egr_send(nxt, tmp.data(), bytes, tag);
+      if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
+      if ((rc = o.op([&] {
+             return combine_buffers(dt, func, st.tmp.data(),
+                                    src + (uint64_t)idx * bytes, count);
+           })))
+        return rc;
+      if (s + 1 < cm.world - 1 && (rc = o.send(nxt, st.tmp.data(), bytes)))
+        return rc;
     }
-    std::memcpy(dst, tmp.data(), bytes);
-    return err;
+    o.local([&] { std::memcpy(dst, st.tmp.data(), bytes); });
+    return NO_ERROR;
   }
 
-  uint32_t do_alltoall(const CommView &cm, const uint8_t *src, uint8_t *dst,
-                       uint64_t bytes, uint32_t tag) {
+  uint32_t do_alltoall(Ops &o, const CommView &cm, const uint8_t *src,
+                       uint8_t *dst, uint64_t bytes) {
     // pairwise rotation exchange (.c:2140-2211)
-    uint32_t err = NO_ERROR;
-    std::memcpy(dst + (uint64_t)cm.rank * bytes,
-                src + (uint64_t)cm.rank * bytes, bytes);
-    bool rv = is_rndzv(bytes);
+    uint32_t rc;
+    o.local([&] {
+      std::memcpy(dst + (uint64_t)cm.rank * bytes,
+                  src + (uint64_t)cm.rank * bytes, bytes);
+    });
+    bool rv = o.rndzv(bytes);
     for (uint32_t k = 1; k < cm.world; k++) {
       uint32_t to = (cm.rank + k) % cm.world;
       uint32_t from = (cm.rank + cm.world - k) % cm.world;
@@ -1303,32 +1387,32 @@ struct accl_rt {
       if (rv) {
         // post our landing address before sending: every rank's step-k
         // target posted its own at step k, so no addr-wait cycle forms
-        rendezvous_send_addr(cm.g(from), (uint64_t)(uintptr_t)rptr, bytes, tag);
-        err |= p2p_send(cm.g(to), src + (uint64_t)to * bytes, bytes, tag);
-        err |= rendezvous_get_completion(cm.g(from), (uint64_t)(uintptr_t)rptr,
-                                         bytes, tag);
+        if ((rc = o.post(cm.g(from), rptr, bytes))) return rc;
+        if ((rc = o.send(cm.g(to), src + (uint64_t)to * bytes, bytes)))
+          return rc;
+        if ((rc = o.completion(cm.g(from), rptr, bytes))) return rc;
       } else {
-        err |= p2p_send(cm.g(to), src + (uint64_t)to * bytes, bytes, tag);
-        err |= p2p_recv(cm.g(from), rptr, bytes, tag);
+        if ((rc = o.send(cm.g(to), src + (uint64_t)to * bytes, bytes)))
+          return rc;
+        if ((rc = o.recv(cm.g(from), rptr, bytes))) return rc;
       }
-      if (err) return err;
     }
-    return err;
+    return NO_ERROR;
   }
 
-  uint32_t do_barrier(const CommView &cm, uint32_t tag) {
+  uint32_t do_barrier(Ops &o, const CommView &cm) {
     // zero-payload notification gather to 0 + fan-out (.c:2078-2120)
-    uint32_t err = NO_ERROR;
+    uint32_t rc;
     if (cm.rank == 0) {
       for (uint32_t i = 1; i < cm.world; i++)
-        err |= egr_recv(cm.g(i), tag, nullptr, 0);
+        if ((rc = o.recv(cm.g(i), nullptr, 0))) return rc;
       for (uint32_t i = 1; i < cm.world; i++)
-        err |= egr_send(cm.g(i), nullptr, 0, tag);
+        if ((rc = o.send(cm.g(i), nullptr, 0))) return rc;
     } else {
-      err |= egr_send(cm.g(0), nullptr, 0, tag);
-      err |= egr_recv(cm.g(0), tag, nullptr, 0);
+      if ((rc = o.send(cm.g(0), nullptr, 0))) return rc;
+      if ((rc = o.recv(cm.g(0), nullptr, 0))) return rc;
     }
-    return err;
+    return NO_ERROR;
   }
 
   // ----- sequencer main loop (run(), .c:2308-2483) -----
@@ -1346,6 +1430,72 @@ struct accl_rt {
       if (!resolve_comm(c.desc[2], c.comm)) return DMA_DECODE_ERROR;
       c.comm_resolved = true;
     }
+    if (!c.cstate) c.cstate = std::make_shared<CollState>();
+    if (!c.cstate->cfg) {
+      CollState &st = *c.cstate;
+      st.cfg = true;
+      st.max_eager = max_eager;
+      st.max_rndzv = max_rndzv;
+      st.tun_bcast_ranks = tuning(BCAST_FLAT_TREE_MAX_RANKS, 3);
+      st.tun_gather_fanin = tuning(GATHER_FLAT_TREE_MAX_FANIN, 2);
+      st.tun_gather_count = tuning(GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024);
+      st.tun_reduce_ranks = tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4);
+      st.tun_reduce_count = tuning(REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024);
+    }
+    if (!c.deadline_set) {
+      c.deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(timeout_ms);
+      c.deadline_set = true;
+    }
+    uint32_t step_before = c.current_step;
+    uint64_t off_before = c.cstate->off;
+    uint32_t rc = execute_guts(c);
+    if (rc == NOT_READY) {
+      // per-op timeout semantics (each blocking primitive used to get a
+      // fresh timeout_ms budget): any progress re-arms the deadline, so
+      // only a genuinely stalled op times the call out
+      if (c.current_step != step_before || c.cstate->off != off_before) {
+        c.deadline = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(timeout_ms);
+        return rc;
+      }
+      if (std::chrono::steady_clock::now() > c.deadline) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] call timeout scenario=%u step=%u\n", rank,
+                  c.desc[0], c.current_step);
+        revoke_call_postings(c);
+        return RECEIVE_TIMEOUT_ERROR;
+      }
+    } else if (rc != NO_ERROR) {
+      // terminal error mid-collective: also drop outstanding postings
+      revoke_call_postings(c);
+    }
+    return rc;
+  }
+
+  // Revoke the addresses THIS call posted and never saw complete, so a
+  // late write cannot land in memory the caller is about to reuse. A
+  // write that landed at the deadline edge (between the failing poll and
+  // this revocation) already consumed the posting: purge its completion
+  // too, or a future recv posting the same (src, vaddr, bytes, tag)
+  // would be falsely satisfied by stale data.
+  void revoke_call_postings(Call &c) {
+    std::lock_guard<std::mutex> g(rndzv_mu);
+    for (auto &pa : c.cstate->posted) {
+      revoke_posted_locked(pa.src, pa.vaddr, pa.bytes, pa.tag);
+      for (auto it = done_q.begin(); it != done_q.end();) {
+        if (it->src == pa.src && it->vaddr == pa.vaddr &&
+            it->bytes == pa.bytes &&
+            (pa.tag == TAG_ANY || it->tag == pa.tag))
+          it = done_q.erase(it);
+        else
+          ++it;
+      }
+    }
+    c.cstate->posted.clear();
+  }
+
+  uint32_t execute_guts(Call &c) {
     const CommView &cm = c.comm;
     constexpr uint32_t ETH_COMPRESSED = 8;
     uint32_t comp_flags = c.desc[7];
@@ -1361,9 +1511,31 @@ struct accl_rt {
         case SC_ALLGATHER: out_elems = count * cm.world; break;
         default: break;
       }
-      auto to_h = [](const float *src, std::vector<uint16_t> &dst, uint64_t n) {
+      // The wire dtype comes from the descriptor's arithconfig row (word
+      // 6; exchmem layout arithconfig.py: [unc_bytes, cmp_bytes,
+      // ratio_log, compressor, decompressor, is_compressed, lanes...]):
+      // compressor 2 = fp32->bf16 (TPU-native extension row), anything
+      // else the default fp16 pair — the dtype-pair-generic contract of
+      // the reference arithconfig (arithconfig.hpp:102-119).
+      if (c.cstate->wire_bf16 < 0) {
+        // snapshot on first pass, like the protocol/tuning snapshot: a
+        // row rewrite between requeue passes must not flip the wire
+        // dtype of a partially-executed call
+        uint32_t arcfg_addr = c.desc[6];
+        c.cstate->wire_bf16 =
+            (arcfg_addr != 0 && arcfg_addr + 16 < EXCHMEM_BYTES &&
+             rd(arcfg_addr + 4 * 3) == 2)
+                ? 1
+                : 0;
+      }
+      bool bf16_wire = c.cstate->wire_bf16 == 1;
+      uint16_t (*cast_to)(float) = bf16_wire ? float_to_bf16 : float_to_half;
+      float (*cast_from)(uint16_t) =
+          bf16_wire ? bf16_to_float : half_to_float;
+      auto to_h = [&](const float *src, std::vector<uint16_t> &dst,
+                      uint64_t n) {
         dst.resize(n);
-        for (uint64_t i = 0; i < n; i++) dst[i] = float_to_half(src[i]);
+        for (uint64_t i = 0; i < n; i++) dst[i] = cast_to(src[i]);
       };
       if (c.op0 && !c.c16_op0) {
         c.c16_op0 = std::make_shared<std::vector<uint16_t>>();
@@ -1378,7 +1550,7 @@ struct accl_rt {
             std::max(in_elems, out_elems));
       }
       Call inner = c;  // shares the scratch shared_ptrs
-      inner.dtype = ACCL_DT_FLOAT16;
+      inner.dtype = bf16_wire ? ACCL_DT_BFLOAT16 : ACCL_DT_FLOAT16;
       inner.desc[7] = comp_flags & ~ETH_COMPRESSED;
       if (c.c16_op0) inner.op0 = c.c16_op0->data();
       if (c.c16_op1) inner.op1 = c.c16_op1->data();
@@ -1399,7 +1571,7 @@ struct accl_rt {
       if (c.res && rc == NO_ERROR && owns_res) {
         float *dst = (float *)c.res;
         for (uint64_t i = 0; i < out_elems; i++)
-          dst[i] = half_to_float((*c.c16_res)[i]);
+          dst[i] = cast_from((*c.c16_res)[i]);
       }
       // bcast mutates op0 on receivers only: compression is wire-only, so
       // the root's full-precision source stays untouched (reference
@@ -1407,7 +1579,7 @@ struct accl_rt {
       if (scenario == SC_BCAST && c.op0 && rc == NO_ERROR && root != cm.rank) {
         float *dst = (float *)c.op0;
         for (uint64_t i = 0; i < in_elems; i++)
-          dst[i] = half_to_float((*c.c16_op0)[i]);
+          dst[i] = cast_from((*c.c16_op0)[i]);
       }
       return rc;
     }
@@ -1451,61 +1623,59 @@ struct accl_rt {
         std::memcpy(res, op0, bytes);
         return combine_buffers(c.dtype, func, res, op1, count);
       }
+      default:
+        break;
+    }
+    // Everything below is a resumable op sequence over the call's state
+    // machine (the firmware retry contract for every scenario,
+    // ccl_offload_control.c:2308-2483).
+    Ops o{*this, c, *c.cstate, tag};
+    switch (scenario) {
       case SC_SEND:
         // root_src_dst is the destination rank, communicator-relative
         // (reference send semantics)
         if (root >= cm.world) return DMA_DECODE_ERROR;
-        return p2p_send(cm.g(root), op0, bytes, tag);
-      case SC_RECV: {
+        return o.send(cm.g(root), op0, bytes);
+      case SC_RECV:
+        // root_src_dst is the source rank. Non-strict tag matching: a
+        // head-tag mismatch stays NOT_READY because another parked recv
+        // may legally consume the head segment first.
         if (root >= cm.world) return DMA_DECODE_ERROR;
-        uint32_t gsrc = cm.g(root);
-        // root_src_dst is the source rank. The eager path is resumable:
-        // current_step counts segments already landed, and a missing
-        // segment parks the call on the retry queue instead of blocking
-        // the sequencer (the firmware retry contract, .c:2336-2477).
-        if (is_rndzv(bytes)) return p2p_recv(gsrc, res, bytes, tag);
-        if (!c.deadline_set) {
-          c.deadline = std::chrono::steady_clock::now() +
-                       std::chrono::milliseconds(timeout_ms);
-          c.deadline_set = true;
-        }
-        for (;;) {
-          uint64_t off = (uint64_t)c.current_step * rx_buf_bytes;
-          if (off >= bytes && !(bytes == 0 && c.current_step == 0)) break;
-          uint64_t got = 0;
-          uint32_t rc = egr_recv_seg(gsrc, tag, res ? res + off : nullptr,
-                                     bytes - off, &got);
-          if (rc == NOT_READY) {
-            if (std::chrono::steady_clock::now() > c.deadline)
-              return RECEIVE_TIMEOUT_ERROR;
-            return NOT_READY;
-          }
-          if (rc != NO_ERROR) return rc;
-          c.current_step++;
-          if (bytes == 0) break;
-        }
-        return NO_ERROR;
-      }
+        return o.recv(cm.g(root), res, bytes, /*strict=*/false);
       case SC_BCAST:
-        return do_bcast(cm, (uint8_t *)op0, bytes, root, tag);
+        return do_bcast(o, cm, (uint8_t *)op0, bytes, root);
       case SC_SCATTER:
-        return do_scatter(cm, op0, res, bytes, root, tag);
+        return do_scatter(o, cm, op0, res, bytes, root);
       case SC_GATHER:
-        return do_gather(cm, op0, res, bytes, root, tag);
+        return do_gather(o, cm, op0, res, bytes, root);
       case SC_ALLGATHER:
-        return do_allgather(cm, op0, res, bytes, tag);
+        return do_allgather(o, cm, op0, res, bytes);
       case SC_REDUCE:
-        return do_reduce(cm, c.dtype, func, op0, res, count, root, tag);
+        return do_reduce(o, cm, c.dtype, func, op0, res, count, root);
       case SC_ALLREDUCE:
-        return do_allreduce(cm, c.dtype, func, op0, res, count, tag);
+        return do_allreduce(o, cm, c.dtype, func, op0, res, count);
       case SC_REDUCE_SCATTER:
-        return do_reduce_scatter(cm, c.dtype, func, op0, res, count, tag);
+        return do_reduce_scatter(o, cm, c.dtype, func, op0, res, count);
       case SC_ALLTOALL:
-        return do_alltoall(cm, op0, res, bytes, tag);
+        return do_alltoall(o, cm, op0, res, bytes);
       case SC_BARRIER:
-        return do_barrier(cm, tag);
+        return do_barrier(o, cm);
       default:
         return COLLECTIVE_NOT_IMPLEMENTED;
+    }
+  }
+
+  // Collectives serialize per communicator (see inflight_comms); p2p and
+  // local scenarios have call identity (tags / no wire) and stay freely
+  // concurrent — the round-2 parked-recv semantics.
+  static bool comm_serialized(uint32_t scenario) {
+    switch (scenario) {
+      case SC_BCAST: case SC_SCATTER: case SC_GATHER: case SC_REDUCE:
+      case SC_ALLGATHER: case SC_ALLREDUCE: case SC_REDUCE_SCATTER:
+      case SC_BARRIER: case SC_ALLTOALL:
+        return true;
+      default:
+        return false;
     }
   }
 
@@ -1514,17 +1684,30 @@ struct accl_rt {
       Call c;
       {
         std::unique_lock<std::mutex> lk(call_mu);
-        call_cv.wait(lk, [&] {
-          return stop.load() || !call_q.empty() || !retry_q.empty();
-        });
+        auto pick = [&]() -> bool {
+          // prefer fresh calls (run() order), skipping collectives whose
+          // communicator already has one in flight; then parked retries
+          for (auto it = call_q.begin(); it != call_q.end(); ++it) {
+            if (comm_serialized(it->desc[0])) {
+              auto f = inflight_comms.find(it->desc[2]);
+              if (f != inflight_comms.end() && f->second > 0) continue;
+            }
+            c = std::move(*it);
+            call_q.erase(it);
+            return true;
+          }
+          if (!retry_q.empty()) {
+            c = std::move(retry_q.front());
+            retry_q.pop_front();
+            return true;
+          }
+          return false;
+        };
+        call_cv.wait(lk, [&] { return stop.load() || pick(); });
         if (stop.load()) return;
-        // round-robin: prefer the call queue, then retries (run() order)
-        if (!call_q.empty()) {
-          c = std::move(call_q.front());
-          call_q.pop_front();
-        } else {
-          c = std::move(retry_q.front());
-          retry_q.pop_front();
+        if (!c.started) {
+          c.started = true;
+          if (comm_serialized(c.desc[0])) inflight_comms[c.desc[2]]++;
         }
       }
       if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
@@ -1543,6 +1726,14 @@ struct accl_rt {
         continue;
       }
       auto dur = std::chrono::steady_clock::now() - c.t_start;
+      if (comm_serialized(c.desc[0])) {
+        // release the communicator's serialization slot: a deferred
+        // same-comm call becomes runnable on the next pick()
+        std::lock_guard<std::mutex> lk(call_mu);
+        auto f = inflight_comms.find(c.desc[2]);
+        if (f != inflight_comms.end() && --f->second == 0)
+          inflight_comms.erase(f);
+      }
       {
         std::lock_guard<std::mutex> lk(comp_mu);
         auto &comp = completions[c.handle];
@@ -1578,6 +1769,7 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   for (size_t i = 0; i < rt->rx_slots.size(); i++) rt->idle_q.push_back(i);
   rt->inbound_seq.assign(world, 0);
   rt->outbound_seq.assign(world, 0);
+  rt->src_valid_count.assign(world, 0);
   rt->peer_fd.assign(world, -1);
   rt->tx_mu = std::vector<std::mutex>(world);
   rt->wr(IDCODE, 0xACC17B00u);

@@ -52,26 +52,42 @@ def _sample_configs():
             Operation.allreduce, Operation.bcast, Operation.reduce)
         root = int(rng.integers(world))
         transport = str(rng.choice(["tcp", "udp"]))
+        # wire dtype for compressed calls: the default fp16 pair or the
+        # TPU-native bf16 row (arithconfig is dtype-pair generic,
+        # reference arithconfig.hpp:102-119)
+        wire = str(rng.choice(["fp16", "bf16"])) if compressed else ""
         # dtype lane coverage (reference reduce_ops: fp32/fp64/i32/...);
         # wire compression is an fp32 feature
         dtype = (np.float32 if compressed
                  else [np.float32, np.int32, np.float64][int(rng.integers(3))])
         configs.append((i, op, world, count, func, max_eager, gather_cnt,
-                        compressed, root, transport, dtype))
-    # pinned lane coverage: every (dtype, func) reduce lane is exercised
-    # at least once regardless of what the random draw happened to hit
+                        compressed, root, transport, dtype, wire))
+    # pinned lane coverage: every (dtype, func) reduce lane and both
+    # compressed wire dtypes are exercised at least once regardless of
+    # what the random draw happened to hit
     for j, (dt, fn) in enumerate([(np.int32, ReduceFunction.MAX),
                                   (np.int32, ReduceFunction.SUM),
                                   (np.float64, ReduceFunction.MAX),
                                   (np.float64, ReduceFunction.SUM)]):
         configs.append((N_CONFIGS + j, Operation.allreduce, 4, 700, fn,
-                        1024, 32 * 1024, False, 0, "tcp", dt))
+                        1024, 32 * 1024, False, 0, "tcp", dt, ""))
+    for j, wire in enumerate(["fp16", "bf16"]):
+        configs.append((N_CONFIGS + 4 + j, Operation.allreduce, 4, 900,
+                        ReduceFunction.SUM, 1024, 32 * 1024, True, 0, "tcp",
+                        np.float32, wire))
     return configs
 
 
-def _oracle(op, x, func, world, root, compressed):
-    """numpy truth; compressed collectives computed in the fp16 domain."""
-    work = x.astype(np.float16).astype(np.float32) if compressed else x
+def _wire_np(wire):
+    import ml_dtypes
+
+    return np.float16 if wire == "fp16" else ml_dtypes.bfloat16
+
+
+def _oracle(op, x, func, world, root, compressed, wire="fp16"):
+    """numpy truth; compressed collectives computed in the wire domain."""
+    wd = _wire_np(wire) if compressed else None
+    work = x.astype(wd).astype(np.float32) if compressed else x
     if op == Operation.bcast:
         return np.tile(work[root], (world, 1))
     if op == Operation.scatter:
@@ -82,8 +98,8 @@ def _oracle(op, x, func, world, root, compressed):
     if op == Operation.allgather:
         return np.tile(work.reshape(-1), (world, 1))
     if compressed:
-        # reductions accumulate in the fp16 domain on both executors
-        h = x.astype(np.float16)
+        # reductions accumulate in the wire domain on both executors
+        h = x.astype(wd)
         red = (h.sum(0) if func == ReduceFunction.SUM else h.max(0)
                ).astype(np.float32)
     else:
@@ -102,18 +118,23 @@ def _oracle(op, x, func, world, root, compressed):
     raise AssertionError(op)
 
 
-def _tolerance(compressed):
+def _tolerance(compressed, wire="fp16"):
     if compressed:
+        # bf16 keeps 8 mantissa bits: coarser than fp16's 11 at these
+        # magnitudes, and accumulation order differs between executors
+        if wire == "bf16":
+            return dict(rtol=6e-2, atol=6e-1)
         return dict(rtol=2e-2, atol=2e-1)
     return dict(rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize(
     "cfg", _sample_configs(),
-    ids=lambda c: f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[9]}-{c[10].__name__}")
+    ids=lambda c: (f"{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[9]}"
+                   f"-{c[10].__name__}{'-' + c[11] if c[11] else ''}"))
 def test_cross_executor_agreement(cfg):
     (i, op, world, count, func, max_eager, gather_cnt, compressed, root,
-     transport, dtype) = cfg
+     transport, dtype, wire) = cfg
     rng = np.random.default_rng(SEED + i)
     in_per_rank = count * world if op in (
         Operation.scatter, Operation.reduce_scatter, Operation.alltoall
@@ -127,8 +148,8 @@ def test_cross_executor_agreement(cfg):
         x = rng.standard_normal((world, in_per_rank)).astype(dtype)
     comp_flags = (CompressionFlags.ETH_COMPRESSED if compressed
                   else CompressionFlags.NO_COMPRESSION)
-    expected = _oracle(op, x, func, world, root, compressed)
-    tol = _tolerance(compressed)
+    expected = _oracle(op, x, func, world, root, compressed, wire)
+    tol = _tolerance(compressed, wire)
     if np.issubdtype(dtype, np.integer):
         tol = dict(rtol=0, atol=0)  # integer lanes are exact
     elif dtype is np.float64:
@@ -147,9 +168,12 @@ def test_cross_executor_agreement(cfg):
                             comp_flags, max_eager_size=max_eager,
                             eager_rx_buf_size=max(max_eager, 256),
                             tuning=tuning)
+    from accl_tpu import DataType
+
+    compress_dt = (DataType.bfloat16 if wire == "bf16" else DataType.none)
     opts = CallOptions(scenario=op, count=count, root_src_dst=root,
                        function=int(func), compression_flags=comp_flags,
-                       data_type=acc_dt)
+                       data_type=acc_dt, compress_dtype=compress_dt)
     fn = ScheduleCompiler(mesh).lower(opts, plan)
     xla_out = np.asarray(fn(x))
     if op in (Operation.gather, Operation.reduce):
@@ -168,9 +192,21 @@ def test_cross_executor_agreement(cfg):
         def body(rank, r):
             rank.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, gather_cnt)
             out = np.zeros(out_elems, dtype)
+            arcfg_addr = 0
+            if wire == "bf16":
+                # write the (fp32 -> bf16) arithconfig row into exchange
+                # memory and address it from the descriptor, exactly how
+                # the facade names a wire dtype (accl.py prepare path)
+                from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
+
+                row = DEFAULT_ARITH_CONFIG[(DataType.float32,
+                                            DataType.bfloat16)]
+                arcfg_addr = 0x300
+                for k, wd in enumerate(row.exchmem_words()):
+                    rank.write(arcfg_addr + 4 * k, wd)
             o = CallOptions(scenario=op, count=count, root_src_dst=root,
                             function=int(func), compression_flags=comp_flags,
-                            data_type=acc_dt)
+                            data_type=acc_dt, arithcfg_addr=arcfg_addr)
             send = x[r].copy()
             if op == Operation.bcast:
                 rank.call(o, op0=send)
