@@ -334,7 +334,8 @@ class ACCL:
     def _stream_opts(self, opts, op0_stream, res_stream):
         """Arm OP0_STREAM/RES_STREAM on a prepared descriptor (reference:
         streams route through any collective, ccl_offload_control.c:628-636).
-        Stream ids ride the tag: low byte producer, second byte consumer."""
+        Stream ids ride dedicated descriptor bytes (word 8), leaving the
+        tag free for matching."""
         if op0_stream is None and res_stream is None:
             return opts
         if not hasattr(self.cclo, "streams"):
@@ -344,15 +345,13 @@ class ACCL:
         from .ops.streams import check_stream_id
 
         flags = StreamFlags.NO_STREAM
-        tag = 0
         if op0_stream is not None:
             flags |= StreamFlags.OP0_STREAM
-            tag |= check_stream_id(op0_stream)
+            opts.op0_stream_id = check_stream_id(op0_stream)
         if res_stream is not None:
             flags |= StreamFlags.RES_STREAM
-            tag |= check_stream_id(res_stream) << 8
+            opts.res_stream_id = check_stream_id(res_stream)
         opts.stream_flags = flags
-        opts.tag = tag
         return opts
 
     def bcast(self, buf, count, root, *, from_device=False, to_device=False,
@@ -367,36 +366,41 @@ class ACCL:
 
     def scatter(self, sendbuf, recvbuf, count, root, *, from_device=False,
                 to_device=False, run_async=False, compress_dtype=None,
-                comm=None):
+                comm=None, op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.scatter, sendbuf, None, recvbuf, count,
                              root_src_dst=root, compress_dtype=compress_dtype,
                              comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def gather(self, sendbuf, recvbuf, count, root, *, from_device=False,
                to_device=False, run_async=False, compress_dtype=None,
-               comm=None):
+               comm=None, op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.gather, sendbuf, None, recvbuf, count,
                              root_src_dst=root, compress_dtype=compress_dtype,
                              comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def allgather(self, sendbuf, recvbuf, count, *, from_device=False,
                   to_device=False, run_async=False, compress_dtype=None,
-                  comm=None):
+                  comm=None, op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.allgather, sendbuf, None, recvbuf,
                              count, compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def reduce(self, sendbuf, recvbuf, count, root, function, *,
                from_device=False, to_device=False, run_async=False,
-               compress_dtype=None, comm=None):
+               compress_dtype=None, comm=None, op0_stream=None,
+               res_stream=None):
         opts = self._prepare(Operation.reduce, sendbuf, None, recvbuf, count,
                              root_src_dst=root, function=int(function),
                              compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
@@ -413,18 +417,21 @@ class ACCL:
 
     def reduce_scatter(self, sendbuf, recvbuf, count, function, *,
                        from_device=False, to_device=False, run_async=False,
-                       compress_dtype=None, comm=None):
+                       compress_dtype=None, comm=None, op0_stream=None,
+                       res_stream=None):
         opts = self._prepare(Operation.reduce_scatter, sendbuf, None, recvbuf,
                              count, function=int(function),
                              compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
     def alltoall(self, sendbuf, recvbuf, count, *, from_device=False,
                  to_device=False, run_async=False, compress_dtype=None,
-                 comm=None):
+                 comm=None, op0_stream=None, res_stream=None):
         opts = self._prepare(Operation.alltoall, sendbuf, None, recvbuf,
                              count, compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
@@ -492,7 +499,7 @@ class ACCL:
             scenario=Operation.send,
             count=count,
             root_src_dst=src | (dst << 16),
-            tag=stream_id,
+            op0_stream_id=stream_id,
             stream_flags=StreamFlags.OP0_STREAM,
             data_type=dtype,
             addr_2=recvbuf.address,

@@ -40,6 +40,11 @@ class CallOptions:
     compression_flags: CompressionFlags = CompressionFlags.NO_COMPRESSION
     stream_flags: StreamFlags = StreamFlags.NO_STREAM
     host_flags: HostFlags = HostFlags.NO_HOST
+    # Kernel-stream ids (strm routing, dma_mover.cpp:497): dedicated
+    # descriptor bytes (word 8 bytes 2-3), NOT the tag field, so a
+    # streamed collective can still tag-match independently.
+    op0_stream_id: int = 0
+    res_stream_id: int = 0
     addr_0: int = 0  # operand 0 (send buffer)
     addr_1: int = 0  # operand 1 (second reduction operand)
     addr_2: int = 0  # result buffer
@@ -64,7 +69,9 @@ class CallOptions:
             self.tag,
             self.arithcfg_addr,
             int(self.compression_flags),
-            int(self.stream_flags) | (int(self.host_flags) << 8),
+            int(self.stream_flags) | (int(self.host_flags) << 8)
+            | ((self.op0_stream_id & 0xFF) << 16)
+            | ((self.res_stream_id & 0xFF) << 24),
         ]
         for addr in (self.addr_0, self.addr_1, self.addr_2):
             words.append(addr & 0xFFFFFFFF)
@@ -87,6 +94,8 @@ class CallOptions:
             compression_flags=CompressionFlags(words[7]),
             stream_flags=StreamFlags(words[8] & 0xFF),
             host_flags=HostFlags((words[8] >> 8) & 0xFF),
+            op0_stream_id=(words[8] >> 16) & 0xFF,
+            res_stream_id=(words[8] >> 24) & 0xFF,
             addr_0=words[9] | (words[10] << 32),
             addr_1=words[11] | (words[12] << 32),
             addr_2=words[13] | (words[14] << 32),
@@ -111,4 +120,6 @@ class CallOptions:
             int(self.compression_flags),
             int(self.stream_flags),
             int(self.host_flags),
+            self.op0_stream_id,
+            self.res_stream_id,
         )

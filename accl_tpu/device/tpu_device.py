@@ -262,16 +262,15 @@ class TPUDevice(CCLODevice):
         if options.stream_flags and options.scenario not in (
             Operation.send, Operation.recv,
         ):
-            # streamed collective: stream ids ride the tag (low byte op0
-            # producer, second byte res consumer — the strm-in-tag
-            # convention stream_put already uses, dma_mover.cpp:497)
+            # streamed collective: stream ids ride dedicated descriptor
+            # bytes (word 8), so the tag stays available for matching
             from ..constants import StreamFlags
 
             producer = consumer = None
             if options.stream_flags & StreamFlags.OP0_STREAM:
-                producer = self.streams.producer(options.tag & 0xFF)
+                producer = self.streams.producer(options.op0_stream_id)
             if options.stream_flags & StreamFlags.RES_STREAM:
-                consumer = self.streams.consumer((options.tag >> 8) & 0xFF,
+                consumer = self.streams.consumer(options.res_stream_id,
                                                  strict=True)
             fn = ctx.compiler.lower_streamed(options, plan, producer, consumer)
         else:
@@ -452,14 +451,14 @@ class TPUDevice(CCLODevice):
 
     def stream_put(self, options: CallOptions) -> BaseRequest:
         """Producer -> collective fused in one program: the operand comes
-        from the stream producer registered under options.tag (the strm
-        field rides the tag, like the reference's strm=tag routing,
-        dma_mover.cpp:497) and the payload lands in the destination's
-        result buffer after its consumer kernel."""
+        from the stream producer registered under the descriptor's
+        op0_stream_id byte (the reference's strm routing, dma_mover.cpp:497)
+        and the payload lands in the destination's result buffer after its
+        consumer kernel."""
         from ..ops.streams import splice_consumer, splice_producer
         from ..sequencer import schedules
 
-        sid = options.tag
+        sid = options.op0_stream_id
         src = options.root_src_dst & 0xFFFF
         dst = (options.root_src_dst >> 16) & 0xFFFF
         res = self._buf(options.addr_2)

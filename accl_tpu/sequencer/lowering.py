@@ -162,7 +162,13 @@ class ScheduleCompiler:
                 if n_in != 1:
                     raise ValueError(
                         f"OP0_STREAM unsupported for {options.scenario.name}")
-                body = splice_producer(body, producer, options.count)
+                # scatter-class inputs hold world stacked blocks per rank
+                in_elems = options.count
+                if options.scenario in (Operation.scatter,
+                                        Operation.reduce_scatter,
+                                        Operation.alltoall):
+                    in_elems *= self.world
+                body = splice_producer(body, producer, in_elems)
             if consumer is not None:
                 body = splice_consumer(body, consumer)
             fn = self._finalize(body, n_in)

@@ -106,6 +106,91 @@ def test_streamed_bcast_res_stream(accl):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_streams_through_every_collective(accl):
+    """OP0/RES_STREAM route through scatter, gather, reduce,
+    reduce_scatter, allgather and alltoall (reference: streams route
+    through ANY collective, ccl_offload_control.c:628-636)."""
+    from accl_tpu import ReduceFunction
+
+    n = 16
+    x = RNG.standard_normal((WORLD, n * WORLD)).astype(np.float32)
+    big = accl.create_buffer(n * WORLD, data=x)
+    small = accl.create_buffer(n)
+    small2 = accl.create_buffer(n, data=x[:, :n])
+    accl.register_stream_consumer(31, lambda v: v + 10.0)
+
+    # scatter: result through the consumer on every rank
+    accl.scatter(big, small, n, root=3, res_stream=31)
+    for r in range(WORLD):
+        np.testing.assert_allclose(
+            small.host[r], x[3, r * n:(r + 1) * n] + 10.0, rtol=1e-5)
+
+    # gather: each rank's operand produced on-device
+    def producer(_b=small2):
+        from jax import lax
+
+        me = lax.axis_index("ccl")
+        return lax.dynamic_index_in_dim(_b.device, me, 0, keepdims=False) * 2.0
+
+    accl.register_stream_producer(32, producer)
+    gout = accl.create_buffer(n * WORLD)
+    accl.gather(small2, gout, n, root=5, op0_stream=32)
+    np.testing.assert_allclose(gout.host[5],
+                               (x[:, :n] * 2.0).reshape(-1), rtol=1e-5)
+
+    # reduce: streamed operand + consumer on the root's result
+    accl.register_stream_consumer(33, lambda v: v - 1.0)
+    rout = accl.create_buffer(n)
+    accl.reduce(small2, rout, n, 2, ReduceFunction.SUM,
+                op0_stream=32, res_stream=33)
+    np.testing.assert_allclose(rout.host[2], x[:, :n].sum(0) * 2.0 - 1.0,
+                               rtol=1e-4, atol=1e-4)
+
+    # reduce_scatter: world-stacked streamed operand
+    def producer_big(_b=big):
+        from jax import lax
+
+        me = lax.axis_index("ccl")
+        return lax.dynamic_index_in_dim(_b.device, me, 0, keepdims=False)
+
+    accl.register_stream_producer(34, producer_big)
+    rsout = accl.create_buffer(n)
+    accl.reduce_scatter(big, rsout, n, ReduceFunction.SUM, op0_stream=34,
+                        res_stream=31)
+    full = x.sum(0)
+    for r in range(WORLD):
+        np.testing.assert_allclose(rsout.host[r],
+                                   full[r * n:(r + 1) * n] + 10.0,
+                                   rtol=1e-4, atol=1e-4)
+
+    # allgather + alltoall through the consumer
+    agout = accl.create_buffer(n * WORLD)
+    accl.allgather(small2, agout, n, res_stream=31)
+    np.testing.assert_allclose(agout.host[0], x[:, :n].reshape(-1) + 10.0,
+                               rtol=1e-5)
+    a2aout = accl.create_buffer(n * WORLD)
+    accl.alltoall(big, a2aout, n, op0_stream=34, res_stream=31)
+    exp = x.reshape(WORLD, WORLD, n).transpose(1, 0, 2).reshape(WORLD, -1)
+    np.testing.assert_allclose(a2aout.host, exp + 10.0, rtol=1e-5)
+
+
+def test_stream_ids_do_not_ride_the_tag(accl):
+    """Stream ids live in dedicated descriptor bytes: arming streams must
+    leave the tag untouched (so streamed collectives can still tag-match)
+    and survive the 15-word round-trip."""
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.constants import Operation, StreamFlags
+
+    opts = CallOptions(scenario=Operation.allreduce, count=8, tag=42)
+    accl._stream_opts(opts, 21, 22)
+    assert opts.tag == 42
+    assert opts.op0_stream_id == 21 and opts.res_stream_id == 22
+    rt = CallOptions.from_words(opts.to_words())
+    assert rt.tag == 42
+    assert rt.op0_stream_id == 21 and rt.res_stream_id == 22
+    assert rt.stream_flags == (StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
+
+
 def test_streamed_bcast_op0_from_root(accl):
     """OP0_STREAM on bcast: the root's payload is produced on-device."""
     n = 16
