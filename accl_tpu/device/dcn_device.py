@@ -36,8 +36,12 @@ from ..sequencer.hierarchical import (
     hierarchical_allgather_schedule,
     hierarchical_allreduce_schedule,
     hierarchical_alltoall_schedule,
+    hierarchical_barrier_schedule,
     hierarchical_bcast_schedule,
+    hierarchical_gather_schedule,
+    hierarchical_reduce_schedule,
     hierarchical_reduce_scatter_schedule,
+    hierarchical_scatter_schedule,
 )
 from ..sequencer.lowering import ScheduleCompiler
 from ..buffers import TPUBuffer
@@ -53,7 +57,9 @@ class DCNCompiler(ScheduleCompiler):
 
     HIER_OPS = frozenset(
         {Operation.allreduce, Operation.reduce_scatter,
-         Operation.allgather, Operation.bcast, Operation.alltoall}
+         Operation.allgather, Operation.bcast, Operation.alltoall,
+         Operation.scatter, Operation.gather, Operation.reduce,
+         Operation.barrier}
     )
 
     def __init__(self, mesh, outer_axis: str, inner_axis: str,
@@ -78,7 +84,8 @@ class DCNCompiler(ScheduleCompiler):
             return super()._build(options, plan, arithcfg)
 
         func = ReduceFunction(options.function) if op in (
-            Operation.allreduce, Operation.reduce_scatter) else None
+            Operation.allreduce, Operation.reduce_scatter,
+            Operation.reduce) else None
         wire = self._wire(options, arithcfg, func, False)
         common = dict(inner_axis=self.inner_axis, outer_axis=self.outer_axis,
                       inner_world=L, outer_world=P, wire=wire)
@@ -86,6 +93,23 @@ class DCNCompiler(ScheduleCompiler):
         if op == Operation.allreduce:
             body = functools.partial(
                 hierarchical_allreduce_schedule, func=func, **common)
+        elif op == Operation.scatter:
+            root = options.root_src_dst
+            body = functools.partial(
+                hierarchical_scatter_schedule,
+                root_outer=root // L, root_inner=root % L, **common)
+        elif op == Operation.gather:
+            root = options.root_src_dst
+            body = functools.partial(
+                hierarchical_gather_schedule,
+                root_outer=root // L, root_inner=root % L, **common)
+        elif op == Operation.reduce:
+            root = options.root_src_dst
+            body = functools.partial(
+                hierarchical_reduce_schedule, func=func,
+                root_outer=root // L, root_inner=root % L, **common)
+        elif op == Operation.barrier:
+            body = functools.partial(hierarchical_barrier_schedule, **common)
         elif op == Operation.alltoall:
             # already process-major on both ends — no reorder needed
             body = functools.partial(hierarchical_alltoall_schedule, **common)

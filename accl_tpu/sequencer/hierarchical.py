@@ -12,7 +12,12 @@ uses (sequencer/schedules.py):
                    shard) -> allgather(inner)
   reduce_scatter = reduce_scatter(inner) -> reduce_scatter(outer)
   allgather      = allgather(outer) -> allgather(inner)
-  bcast          = bcast(outer from root's column) -> bcast(inner)
+  bcast          = bcast(inner on root host) -> shard bcast(outer)
+                   -> allgather(inner)
+  scatter        = regroup -> scatter(inner on root host) -> scatter(outer)
+  gather         = gather(outer per row) -> gather(inner) -> de-normalize
+  reduce         = reduce_scatter(inner) -> reduce(outer) -> gather(inner)
+  barrier        = barrier(inner) -> barrier(outer)
 
 Each runs inside one shard_map over BOTH axes — a single compiled
 program, the host-only-dispatches property preserved across tiers. On a
@@ -122,14 +127,103 @@ def hierarchical_bcast_schedule(
     x, *, root_inner: int, root_outer: int, inner_axis, outer_axis,
     inner_world, outer_world, wire,
 ):
-    """Root's slice broadcasts across the slow tier once, then every slice
-    fans out internally on ICI."""
-    # outer hop happens only usefully on the root's inner row; other rows
-    # relay garbage among themselves in the same SPMD program, and the
-    # inner bcast from root_inner then overwrites every row with real data.
+    """Scatter-bcast-allgather: the root's host fans the payload out on
+    ICI, each inner position carries ONE 1/L shard across the slow tier,
+    and an inner allgather rebuilds the buffer — so the payload crosses
+    DCN once in aggregate ((P-1) * n/L per inner row) instead of once per
+    inner row (the naive outer-bcast-everywhere costs L * that)."""
+    n = x.shape[-1]
+    padded = _pad_to(x, inner_world)
+    c = padded.shape[-1] // inner_world
+    # root's host distributes internally (other hosts relay garbage here;
+    # their shards are replaced by the outer hop next)
     y = schedules.bcast_flat_schedule(
-        x, root=root_outer, axis=outer_axis, world=outer_world, wire=wire
+        padded, root=root_inner, axis=inner_axis, world=inner_world, wire=wire
     )
-    return schedules.bcast_flat_schedule(
-        y, root=root_inner, axis=inner_axis, world=inner_world, wire=wire
+    me = lax.axis_index(inner_axis)
+    shard = lax.dynamic_slice_in_dim(y, me * c, c, axis=-1)
+    shard = schedules.bcast_flat_schedule(
+        shard, root=root_outer, axis=outer_axis, world=outer_world, wire=wire
+    )
+    full = schedules.allgather_ring_schedule(
+        shard, axis=inner_axis, world=inner_world, wire=wire
+    )
+    return full[:n]
+
+
+def hierarchical_scatter_schedule(
+    x, *, root_inner: int, root_outer: int, inner_axis, outer_axis,
+    inner_world, outer_world, wire,
+):
+    """Input: world*c per rank (real on the root device), PROCESS-MAJOR
+    chunks (chunk for global rank g = p*L + l at offset g*c). The root
+    regroups locally to (l, p, c), inner-scatters so its host's device l
+    holds every host's chunk for inner position l (ICI), then each inner
+    row outer-scatters its (P, c) block — every DCN byte is payload some
+    host needs ((P-1)*c per row, optimal)."""
+    L, P = inner_world, outer_world
+    c = x.shape[-1] // (L * P)
+    xt = x.reshape(P, L, c).transpose(1, 0, 2).reshape(-1)
+    blk = schedules.scatter_schedule(
+        xt, root=root_inner, axis=inner_axis, world=L, wire=wire
+    )  # (P*c): chunks for MY inner position, one per host
+    return schedules.scatter_schedule(
+        blk, root=root_outer, axis=outer_axis, world=P, wire=wire
+    )
+
+
+def hierarchical_gather_schedule(
+    x, *, root_inner: int, root_outer: int, inner_axis, outer_axis,
+    inner_world, outer_world, wire,
+):
+    """Mirror of hierarchical_scatter: each inner row ring-gathers across
+    the slow tier to the root host ((P-1)*c DCN per row), the root host
+    gathers its rows on ICI, and the root device de-normalizes to
+    process-major chunk order. Only the root's output is defined (the
+    flat gather contract)."""
+    L, P = inner_world, outer_world
+    c = x.shape[-1]
+    og = schedules.gather_ring_schedule(
+        x, root=root_outer, axis=outer_axis, world=P, wire=wire
+    )  # (P*c) valid on the root host's row
+    ig = schedules.gather_ring_schedule(
+        og, root=root_inner, axis=inner_axis, world=L, wire=wire
+    )  # (L*P*c) on the root device, layout (l, p, c)
+    return ig.reshape(L, P, c).transpose(1, 0, 2).reshape(-1)
+
+
+def hierarchical_reduce_schedule(
+    x, *, func, root_inner: int, root_outer: int, inner_axis, outer_axis,
+    inner_world, outer_world, wire,
+):
+    """RS(inner) -> reduce(outer) -> gather(inner to root): the slow tier
+    carries one 1/L shard per inner row (n/L per device, n aggregate)
+    instead of whole payloads. Only the root's output is defined."""
+    n = x.shape[-1]
+    padded = _pad_to(x, inner_world)
+    shard = schedules.reduce_scatter_ring_schedule(
+        padded, func=func, axis=inner_axis, world=inner_world, wire=wire
+    )
+    shard = schedules.reduce_ring_schedule(
+        shard, root=root_outer, func=func, axis=outer_axis,
+        world=outer_world, wire=wire,
+    )
+    full = schedules.gather_ring_schedule(
+        shard, root=root_inner, axis=inner_axis, world=inner_world, wire=wire
+    )  # chunks ordered by inner position == original contiguous layout
+    return full[:n]
+
+
+def hierarchical_barrier_schedule(
+    token, *, inner_axis, outer_axis, inner_world, outer_world, wire,
+):
+    """Inner barrier then outer barrier: a device passes the outer tier
+    only after every device on its host arrived, so outer completion on
+    any row implies global arrival — and the slow tier carries P tokens
+    per row instead of a P*L-rank flat fan-in."""
+    t = schedules.barrier_schedule(
+        token, axis=inner_axis, world=inner_world, wire=wire
+    )
+    return schedules.barrier_schedule(
+        t, axis=outer_axis, world=outer_world, wire=wire
     )

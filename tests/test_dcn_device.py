@@ -120,9 +120,14 @@ def test_dcn_sub_communicators_and_selection(dcn_accl):
     # world-communicator selection stays hierarchical
     from accl_tpu.constants import Operation
 
-    assert Operation.allreduce in DCNCompiler.HIER_OPS
-    assert Operation.alltoall in DCNCompiler.HIER_OPS
-    assert Operation.gather not in DCNCompiler.HIER_OPS
+    # every collective with a two-tier decomposition composes (scatter/
+    # gather/reduce/barrier joined in round 3); only p2p stays flat
+    for op in (Operation.allreduce, Operation.alltoall, Operation.gather,
+               Operation.scatter, Operation.reduce, Operation.barrier,
+               Operation.bcast, Operation.allgather,
+               Operation.reduce_scatter):
+        assert op in DCNCompiler.HIER_OPS
+    assert Operation.send not in DCNCompiler.HIER_OPS
 
 
 def test_dcn_single_tier_degenerates_flat():

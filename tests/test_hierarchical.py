@@ -121,6 +121,167 @@ def test_hier_bcast(root_g):
     np.testing.assert_allclose(out, np.tile(x[root_g], (world, 1)), rtol=0)
 
 
+class CountingWire(schedules.Wire):
+    """Wire that tallies per-device ppermute payload bytes by axis at
+    trace time (schedules are traced once with static shapes, so the
+    tally is exact)."""
+
+    def __init__(self):
+        super().__init__(None)
+        self.bytes_by_axis = {}
+
+    def ppermute(self, x, axis, perm):
+        key = axis if isinstance(axis, str) else tuple(axis)
+        self.bytes_by_axis[key] = (self.bytes_by_axis.get(key, 0)
+                                   + int(x.size) * x.dtype.itemsize)
+        return super().ppermute(x, axis, perm)
+
+
+def run2d_outer_major(body, mesh, *inputs):
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(("outer", "inner")),) * len(inputs),
+            out_specs=P(("outer", "inner")),
+            check_vma=False,
+        )
+    )
+    return np.asarray(f(*inputs))
+
+
+@pytest.mark.parametrize("root_g", [0, 6])
+def test_hier_scatter_gather_process_major(root_g):
+    """Two-tier scatter and gather under the DCN backend's process-major
+    numbering (g = p*L + l): every DCN byte is payload its destination
+    host needs."""
+    from accl_tpu.sequencer.hierarchical import (
+        hierarchical_gather_schedule, hierarchical_scatter_schedule)
+
+    outer, inner = 2, 4
+    mesh = mesh2d(outer, inner)
+    world = outer * inner
+    count = 24
+    root_outer, root_inner = root_g // inner, root_g % inner
+    common = dict(root_inner=root_inner, root_outer=root_outer,
+                  inner_axis="inner", outer_axis="outer",
+                  inner_world=inner, outer_world=outer)
+
+    x = RNG.standard_normal((world, world * count)).astype(np.float32)
+
+    def sc_body(xl):
+        out = hierarchical_scatter_schedule(
+            xl.reshape(-1), wire=schedules.Wire(None), **common)
+        return out.reshape(1, -1)
+
+    out = run2d_outer_major(sc_body, mesh, x)
+    for g in range(world):
+        np.testing.assert_allclose(out[g],
+                                   x[root_g, g * count:(g + 1) * count],
+                                   rtol=0, err_msg=f"scatter chunk {g}")
+
+    xg = RNG.standard_normal((world, count)).astype(np.float32)
+
+    def ga_body(xl):
+        out = hierarchical_gather_schedule(
+            xl.reshape(-1), wire=schedules.Wire(None), **common)
+        return out.reshape(1, -1)
+
+    out = run2d_outer_major(ga_body, mesh, xg)
+    np.testing.assert_allclose(out[root_g], xg.reshape(-1), rtol=0)
+
+
+@pytest.mark.parametrize("root_g", [0, 5])
+def test_hier_reduce_process_major(root_g):
+    from accl_tpu.sequencer.hierarchical import hierarchical_reduce_schedule
+
+    outer, inner = 2, 4
+    mesh = mesh2d(outer, inner)
+    world = outer * inner
+    count = 130  # not divisible by inner: pad path
+    x = RNG.standard_normal((world, count)).astype(np.float32)
+
+    def body(xl):
+        out = hierarchical_reduce_schedule(
+            xl.reshape(-1), func=ReduceFunction.SUM,
+            root_outer=root_g // inner, root_inner=root_g % inner,
+            inner_axis="inner", outer_axis="outer",
+            inner_world=inner, outer_world=outer,
+            wire=schedules.Wire(None))
+        return out.reshape(1, -1)
+
+    out = run2d_outer_major(body, mesh, x)
+    np.testing.assert_allclose(out[root_g], x.sum(0), rtol=1e-4, atol=1e-4)
+
+
+def test_hier_barrier():
+    from accl_tpu.sequencer.hierarchical import hierarchical_barrier_schedule
+
+    mesh = mesh2d(2, 4)
+
+    def body(t):
+        out = hierarchical_barrier_schedule(
+            t.reshape(-1), inner_axis="inner", outer_axis="outer",
+            inner_world=4, outer_world=2, wire=schedules.Wire(None))
+        return out.reshape(1, -1)
+
+    out = run2d_outer_major(body, mesh, np.ones((8, 1), np.float32))
+    assert np.isfinite(out).all()
+
+
+def test_hier_dcn_byte_counts():
+    """The slow tier carries 1/L of the payload: per-device DCN (outer
+    axis) ppermute bytes of each two-tier composition are counted at
+    trace time and checked against the optimal decomposition — the
+    regression this guards is an outer hop running on every inner row
+    with full payload (L x the bytes)."""
+    outer, inner = 2, 4
+    mesh = mesh2d(outer, inner)
+    world = outer * inner
+    n = 4096  # divisible by inner: no padding in the shard math
+    elem = 4
+
+    def trace(body_fn, x):
+        f = jax.jit(jax.shard_map(
+            body_fn, mesh=mesh, in_specs=(P(("outer", "inner")),),
+            out_specs=P(("outer", "inner")), check_vma=False))
+        jax.eval_shape(f, jax.ShapeDtypeStruct(x.shape, x.dtype))
+
+    from accl_tpu.sequencer.hierarchical import (
+        hierarchical_bcast_schedule, hierarchical_reduce_schedule)
+
+    common = dict(inner_axis="inner", outer_axis="outer",
+                  inner_world=inner, outer_world=outer)
+
+    # bcast: (P-1) shard-sized outer hops per device, NOT (P-1) * full n
+    w = CountingWire()
+
+    def bc(xl):
+        return hierarchical_bcast_schedule(
+            xl.reshape(-1), root_inner=0, root_outer=0, wire=w,
+            **common).reshape(1, -1)
+
+    trace(bc, np.zeros((world, n), np.float32))
+    shard = n // inner
+    assert w.bytes_by_axis["outer"] == (outer - 1) * shard * elem, \
+        w.bytes_by_axis
+    # ICI side sanity: inner bcast (L-1 hops of n) + inner allgather
+    # ((L-1) shard hops) — bounded, and allowed to be larger than the
+    # DCN side (that is the whole point)
+    assert w.bytes_by_axis["inner"] <= (inner - 1) * (n + shard) * elem
+
+    # reduce: ring reduce of the 1/L shard over outer = (P-1) shard hops
+    w = CountingWire()
+
+    def rd(xl):
+        return hierarchical_reduce_schedule(
+            xl.reshape(-1), func=ReduceFunction.SUM, root_inner=0,
+            root_outer=0, wire=w, **common).reshape(1, -1)
+
+    trace(rd, np.zeros((world, n), np.float32))
+    assert w.bytes_by_axis["outer"] == (outer - 1) * shard * elem, \
+        w.bytes_by_axis
+
+
 def test_hier_allreduce_wire_compressed():
     """Two-tier allreduce with fp16 wire compression on both tiers."""
     from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
