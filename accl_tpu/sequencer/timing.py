@@ -1,0 +1,219 @@
+"""Per-hop timing model: the cclo_sim slot, TPU-idiomatically.
+
+The reference ships a second, cycle-accurate simulation target whose job
+is to answer "how long does this schedule take?" before hardware runs it
+(test/model/simulator/cclo_sim.cpp:25-80 driving the RTL through XSI,
+xsi_dut.cpp:1-172). An RTL clock makes no sense for XLA programs, so the
+TPU-native fill for that slot is an analytic alpha-beta cost model over
+the SAME algorithm structures the two executors run
+(sequencer/schedules.py / native runtime do_*):
+
+    T(call) = alpha * messages_on_critical_path
+            + bytes_on_critical_path / beta
+
+with per-link parameters calibrated from measured sweeps (the emulator
+benchmark CSV or the TPU profile). Rendezvous messages count their
+address handshake as an extra message, exactly the extra wire round trip
+the protocol pays.
+
+Two uses:
+  - predict(): expected seconds for a planned call — schedule selection
+    can be evaluated as a PERFORMANCE choice, not just a control-flow
+    choice;
+  - tuning_crossovers(): the model's own switch-over points for the five
+    tuning registers (accl.cpp:1198-1208 defaults), so the defaults are
+    validated against measurements instead of taken on faith.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from ..constants import Operation
+from .plan import Algorithm, Plan, Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkParams:
+    """alpha: seconds of fixed cost per message on the critical path
+    (dispatch + header + matching); beta: sustained payload bytes/second
+    of one link direction."""
+
+    alpha: float
+    beta: float
+
+    def seconds(self, messages: float, nbytes: float) -> float:
+        return self.alpha * messages + nbytes / self.beta
+
+
+def _segs(nbytes: int, rx_buf_bytes: int) -> int:
+    return max(1, math.ceil(nbytes / max(rx_buf_bytes, 1)))
+
+
+def coefficients(
+    op: Operation,
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    world: int,
+    *,
+    rx_buf_bytes: int,
+) -> tuple[float, float]:
+    """(messages, bytes) on the CRITICAL PATH of the planned schedule —
+    the busiest serialized sequence of hops, mirroring the structures in
+    schedules.py / the native do_* bodies. Rendezvous messages count 2
+    (address notification + one-sided write)."""
+    n = count * elem_bytes
+    P = world
+    if P <= 1 or plan.algorithm == Algorithm.NONE:
+        return 0.0, 0.0
+    alg = plan.algorithm
+    s = _segs(n, rx_buf_bytes)  # eager segments per full-payload message
+
+    if alg == Algorithm.EAGER_SENDRECV:
+        return s, n
+    if alg == Algorithm.RNDZV_SENDRECV:
+        return 2, n
+    if alg == Algorithm.EAGER_FLAT:
+        # root serializes P-1 sends of n each (scatter's `count` is
+        # already per-chunk by the descriptor convention, so n covers
+        # both bcast and scatter)
+        return (P - 1) * _segs(n, rx_buf_bytes), (P - 1) * n
+    if alg == Algorithm.EAGER_RING:
+        # daisy chain: P-1 sequential full-payload hops
+        return (P - 1) * s, (P - 1) * n
+    if alg == Algorithm.EAGER_RING_RS_AG:
+        # 2(P-1) steps of the 1/P chunk
+        chunk = n / P
+        return 2 * (P - 1) * _segs(int(chunk), rx_buf_bytes), \
+            2 * (P - 1) * chunk
+    if alg == Algorithm.RNDZV_FLAT_TREE:
+        if op in (Operation.gather, Operation.reduce):
+            # handshakes overlap; P-1 one-sided writes serialize into the
+            # root's link
+            return 2.0, (P - 1) * n
+        # bcast/scatter: root serializes P-1 rendezvous sends
+        return 2 * (P - 1), (P - 1) * n
+    if alg == Algorithm.RNDZV_BIN_TREE:
+        r = math.ceil(math.log2(P)) if P > 1 else 0
+        return 2 * r, r * n
+    if alg == Algorithm.RNDZV_RING:
+        return 2 * (P - 1), (P - 1) * n
+    if alg in (Algorithm.RNDZV_REDUCE_BCAST,
+               Algorithm.RNDZV_REDUCE_SCATTER):
+        # compositions carry their per-stage plans (plan.py resolves them
+        # with the same tuning registers): sum the stages back to back
+        if alg == Algorithm.RNDZV_REDUCE_BCAST:
+            stage_ops = (Operation.reduce, Operation.bcast)
+            stage_counts = (count, count)
+        else:
+            stage_ops = (Operation.reduce, Operation.scatter)
+            stage_counts = (count * world, count)
+        tm = tb = 0.0
+        for sub_op, sub_count, sub_plan in zip(stage_ops, stage_counts,
+                                               plan.stages):
+            m, b = coefficients(sub_op, sub_plan, sub_count, elem_bytes,
+                                world, rx_buf_bytes=rx_buf_bytes)
+            tm += m
+            tb += b
+        return tm, tb
+    if alg == Algorithm.FLAT_ALLTOALL:
+        per = 2 if plan.protocol == Protocol.RENDEZVOUS else \
+            _segs(n, rx_buf_bytes)
+        return (P - 1) * per, (P - 1) * n
+    if alg == Algorithm.BARRIER_GATHER_SCATTER:
+        return 2 * (P - 1), 0.0
+    raise ValueError(f"no cost shape for {alg}")
+
+
+def predict(
+    params: LinkParams,
+    op: Operation,
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    world: int,
+    *,
+    rx_buf_bytes: int,
+) -> float:
+    """Expected seconds for the planned call on a link with `params`."""
+    m, b = coefficients(op, plan, count, elem_bytes, world,
+                        rx_buf_bytes=rx_buf_bytes)
+    return params.seconds(m, b)
+
+
+def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
+    """Least-squares fit of (alpha, 1/beta) from samples of
+    (messages, bytes, measured_seconds): t ~= alpha*m + bytes*inv_beta.
+    Non-negative solution (a degenerate sweep clamps at zero rather than
+    producing a negative latency)."""
+    import numpy as np
+
+    A = np.array([[m, b] for m, b, _ in samples], float)
+    y = np.array([t for _, _, t in samples], float)
+    # scale columns so the solve is well-conditioned across the 1 KB-1 GB
+    # dynamic range
+    scale = A.max(axis=0)
+    scale[scale == 0] = 1.0
+    x, *_ = np.linalg.lstsq(A / scale, y, rcond=None)
+    x = np.maximum(x / scale, 0.0)
+    alpha, inv_beta = float(x[0]), float(x[1])
+    if inv_beta <= 0:
+        inv_beta = 1e-12  # pure-latency sweep: effectively infinite beta
+    if alpha <= 0:
+        alpha = 1e-9
+    return LinkParams(alpha=alpha, beta=1.0 / inv_beta)
+
+
+def tuning_crossovers(params: LinkParams, *, world: int = 8,
+                      elem_bytes: int = 4,
+                      rx_buf_bytes: int = 4096) -> dict:
+    """The model's own switch-over points for the five tuning registers
+    (reference defaults accl.cpp:1198-1208: gather fan-in capped above
+    32 KB, bcast flat <= 3 ranks, reduce flat <= 4 ranks or <= 32 KB).
+
+    - bcast ranks: flat costs (P-1) serialized sends, the binary tree
+      ceil(log2 P) rounds — the crossover is STRUCTURAL (P-1 vs log2 P),
+      independent of alpha/beta: flat wins up to the largest P with
+      P-1 <= ceil(log2 P).
+    - reduce/gather byte thresholds: flat trees pay one round of latency
+      but serialize (P-1) payloads into the root's link; trees pay
+      log2(P) rounds of latency for log2(P) payloads. Crossover bytes =
+      where the extra serialized payload time equals the saved round
+      latency.
+    """
+    P = world
+    a, b = params.alpha, params.beta
+
+    bcast_max = 1
+    while (bcast_max + 1) - 1 <= math.ceil(math.log2(bcast_max + 1)):
+        bcast_max += 1
+
+    r = math.ceil(math.log2(P))
+    # flat reduce: 2 latency + (P-1)n/b ; binomial: 2r latency + r*n/b
+    denom = (P - 1 - r) / b
+    reduce_cross = (2 * r - 2) * a / denom if denom > 0 else float("inf")
+    # flat gather (unbounded fan-in) vs fan-in-capped binomial: same shape
+    gather_cross = reduce_cross
+
+    # rank crossover at a large representative payload (1 MB, where the
+    # rank register governs — small payloads are the count register's
+    # job): the last world where the flat tree's serialized payload still
+    # beats the tree's extra latency rounds
+    n_big = float(1 << 20)
+    reduce_ranks = 1
+    for pq in range(2, 65):
+        rq = math.ceil(math.log2(pq))
+        if 2 * a + (pq - 1) * n_big / b <= 2 * rq * a + rq * n_big / b:
+            reduce_ranks = pq
+        else:
+            break
+
+    return {
+        "bcast_flat_tree_max_ranks": bcast_max,
+        "reduce_flat_tree_max_count_bytes": reduce_cross,
+        "gather_flat_tree_max_count_bytes": gather_cross,
+        "reduce_flat_tree_max_ranks": reduce_ranks,
+        "world": P,
+    }

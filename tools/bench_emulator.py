@@ -6,7 +6,7 @@ per-collective latency/throughput logging with eager/rendezvous and
 buffer-placement switches, results to accl_log/*.log): sweeps message
 sizes across both protocols over N emulator ranks and writes
 accl_log/emu_bench.csv — or emu_bench_udp.csv with --transport udp —
-(Collective,Protocol,Bytes,Seconds,GBps).
+(Collective,Protocol,Bytes,Seconds,GBps,World).
 """
 
 import argparse
@@ -70,9 +70,10 @@ def main():
     csv = outdir / ("emu_bench.csv" if args.transport == "tcp"
                     else "emu_bench_udp.csv")
     with open(csv, "w") as f:
-        f.write("Collective,Protocol,Bytes,Seconds,GBps\n")
+        f.write("Collective,Protocol,Bytes,Seconds,GBps,World\n")
         for r in rows:
-            f.write(f"{r[0]},{r[1]},{r[2]},{r[3]:.6e},{r[4]:.3f}\n")
+            f.write(f"{r[0]},{r[1]},{r[2]},{r[3]:.6e},{r[4]:.3f},"
+                    f"{args.world}\n")
     print(f"wrote {csv} ({len(rows)} rows)")
 
 

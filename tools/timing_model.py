@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Calibrate the per-hop timing model from measured sweeps and validate
+the tuning-register defaults as performance crossovers.
+
+The cclo_sim role (reference test/model/simulator/cclo_sim.cpp:25-80):
+a second target answering "how long should this schedule take" — here an
+alpha-beta model (sequencer/timing.py) fitted to the emulator benchmark
+CSV (tools/bench_emulator.py) and, when present, the TPU profile.
+
+Writes accl_log/timing_model.json:
+  { link params, per-row predicted-vs-measured, tuning crossovers }
+"""
+
+import csv
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from accl_tpu.constants import Operation, TuningParams  # noqa: E402
+from accl_tpu.sequencer.plan import select_algorithm  # noqa: E402
+from accl_tpu.sequencer.timing import (  # noqa: E402
+    calibrate,
+    coefficients,
+    predict,
+    tuning_crossovers,
+)
+
+OPS = {"allreduce": Operation.allreduce, "bcast": Operation.bcast,
+       "allgather": Operation.allgather, "reduce": Operation.reduce,
+       "gather": Operation.gather, "scatter": Operation.scatter,
+       "alltoall": Operation.alltoall,
+       "reduce_scatter": Operation.reduce_scatter}
+
+# the emulator bench's fixed eager configuration (tools/bench_emulator.py)
+MAX_EAGER = 4096
+RX_BUF = 4096
+
+
+def load_rows(path: pathlib.Path, default_world: int):
+    rows = []
+    with open(path) as f:
+        for r in csv.DictReader(f):
+            op = OPS.get(r["Collective"])
+            if op is None:
+                continue
+            world = int(r.get("World") or default_world)
+            rows.append((op, int(r["Bytes"]), float(r["Seconds"]), world))
+    return rows
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=4,
+                    help="world size of the sweep, used only for CSVs "
+                         "written before the World column existed")
+    args = ap.parse_args()
+
+    src = REPO / "accl_log" / "emu_bench.csv"
+    if not src.exists():
+        print(f"no {src}; run tools/bench_emulator.py first",
+              file=sys.stderr)
+        return 1
+    rows = load_rows(src, args.world)
+    if not rows:
+        print(f"{src} has no usable collective rows; re-run "
+              "tools/bench_emulator.py", file=sys.stderr)
+        return 1
+    tuning = TuningParams.default()
+    samples = []
+    meta = []
+    for op, nbytes, secs, world in rows:
+        count = nbytes // 4
+        plan = select_algorithm(op, count, 4, world,
+                                max_eager_size=MAX_EAGER,
+                                eager_rx_buf_size=RX_BUF, tuning=tuning)
+        m, b = coefficients(op, plan, count, 4, world, rx_buf_bytes=RX_BUF)
+        samples.append((m, b, secs))
+        meta.append((op, plan, count, nbytes, secs, world))
+
+    params = calibrate(samples)
+    report = []
+    for op, plan, count, nbytes, secs, world in meta:
+        pred = predict(params, op, plan, count, 4, world,
+                       rx_buf_bytes=RX_BUF)
+        report.append({
+            "collective": op.name, "bytes": nbytes, "world": world,
+            "algorithm": plan.algorithm.name,
+            "measured_s": secs, "predicted_s": pred,
+            "ratio": pred / secs if secs else None,
+        })
+    ratios = sorted(r["ratio"] for r in report if r["ratio"])
+    med = ratios[len(ratios) // 2]
+
+    cross = tuning_crossovers(params, world=8)
+    out = {
+        "source": str(src.relative_to(REPO)),
+        "link": {"alpha_us": params.alpha * 1e6,
+                 "beta_gbps": params.beta / 1e9},
+        "fit": {"rows": len(report), "median_pred_over_meas": med},
+        "rows": report,
+        "tuning_crossovers": cross,
+        "reference_defaults": {
+            "bcast_flat_tree_max_ranks": 3,
+            "reduce_flat_tree_max_ranks": 4,
+            "reduce_flat_tree_max_count_bytes": 32 * 1024,
+            "gather_flat_tree_max_count_bytes": 32 * 1024,
+        },
+    }
+    dst = REPO / "accl_log" / "timing_model.json"
+    dst.write_text(json.dumps(out, indent=1) + "\n")
+    print(f"alpha={params.alpha*1e6:.1f}us beta={params.beta/1e9:.2f}GB/s "
+          f"median pred/meas={med:.2f} -> {dst.relative_to(REPO)}")
+    print(f"crossovers: {cross}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
