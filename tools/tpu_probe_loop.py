@@ -9,9 +9,16 @@ at intervals and leaves a machine-readable trail:
   accl_log/TPU_ALIVE       sentinel written the moment a probe succeeds
                            (content: ISO timestamp of the successful probe)
 
-Run detached: ``nohup python tools/tpu_probe_loop.py &``. Exits after the
-first success (the caller then launches the real hardware suite/bench) or
-after --max-hours.
+Run detached: ``nohup python tools/tpu_probe_loop.py &``. On the first
+success it writes the sentinel, then (with --run-on-alive, the default)
+immediately runs the hardware payload serially — the Mosaic-compile HW
+suite and the on-chip bench — so a recovery at ANY hour produces
+committed-ready artifacts without an operator in the loop:
+
+  accl_log/hw_suite.log    ACCL_TPU_HW=1 pytest tests/test_tpu_hw.py
+  accl_log/bench_tpu.log   python bench.py (writes accl_log/profile.csv)
+
+Exits after the payload (or after --max-hours without a live tunnel).
 
 Each probe runs ``jax.devices()`` in a SUBPROCESS with a hard timeout, so
 the loop itself can never hang; the child inherits the platform plugin via
@@ -48,11 +55,43 @@ def probe(timeout_s: int) -> bool:
     return ok
 
 
+def run_hw_payload() -> None:
+    """Serially run the hardware suite and the on-chip bench with generous
+    timeouts (first compiles are remote and slow); each to its own log.
+    Serial on purpose: concurrent heavy jobs saturate the box and a killed
+    TPU-attached process can re-wedge the tunnel."""
+    import subprocess
+
+    jobs = [
+        ("hw_suite", ["python", "-m", "pytest", "tests/test_tpu_hw.py",
+                      "-v", "-x"], {"ACCL_TPU_HW": "1"}, 3600),
+        ("bench_tpu", ["python", str(REPO / "bench.py")], {}, 3600),
+    ]
+    import os
+
+    for name, cmd, extra_env, tmo in jobs:
+        logp = REPO / "accl_log" / f"{name}.log"
+        env = dict(os.environ)
+        env.update(extra_env)
+        log(f"payload {name}: {' '.join(cmd)}")
+        try:
+            with open(logp, "w") as f:
+                r = subprocess.run(cmd, cwd=REPO, env=env, stdout=f,
+                                   stderr=subprocess.STDOUT, timeout=tmo)
+            log(f"payload {name}: rc={r.returncode} -> {logp.name}")
+        except subprocess.TimeoutExpired:
+            log(f"payload {name}: TIMEOUT after {tmo}s -> {logp.name}")
+        except Exception as e:
+            log(f"payload {name}: error {e!r}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval-min", type=float, default=20.0)
     ap.add_argument("--timeout-s", type=int, default=150)
     ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--no-run-on-alive", action="store_true",
+                    help="only write the sentinel; skip the HW payload")
     args = ap.parse_args()
 
     # a sentinel from a PREVIOUS run must not make a caller launch the
@@ -65,7 +104,10 @@ def main() -> int:
         log(f"attempt {attempt}")
         if probe(args.timeout_s):
             SENTINEL.write_text(_now() + "\n")
-            log("sentinel written; exiting")
+            log("sentinel written")
+            if not args.no_run_on_alive:
+                run_hw_payload()
+            log("exiting")
             return 0
         time.sleep(args.interval_min * 60)
     log("max-hours reached without a live tunnel")
