@@ -9,10 +9,12 @@ alltoall, ccl_offload_control.c:2123-2218). This is the vadd_put pattern
 again at a different scale point: device compute feeding straight into a
 collective inside one compiled program, no host in the loop.
 
-Routing is capacity-based (fixed shapes, XLA-friendly): each expert
-accepts at most C = ceil(T / E * capacity_factor) tokens per rank;
-overflow tokens pass through on the residual stream (standard dropped-
-token semantics).
+Routing is capacity-based top-k (fixed shapes, XLA-friendly): each token
+routes to its top_k experts (k=1 keeps the raw router probability as the
+gate; k>1 normalizes gates over the chosen k), each expert accepts at
+most C = ceil(T * k / E * capacity_factor) pseudo-tokens per rank, and
+overflow passes through on the residual stream (standard dropped-token
+semantics).
 """
 
 from __future__ import annotations
@@ -35,6 +37,8 @@ class MoEConfig:
     n_experts: int = 4       # total experts == ep axis size x experts_per_rank
     experts_per_rank: int = 1
     capacity_factor: float = 1.25
+    top_k: int = 1           # experts per token (k=1: raw-prob gate;
+                             # k>1: gates normalized over the chosen k)
     vocab: int = 64
     seq: int = 32
     dtype: str = "float32"
@@ -89,18 +93,23 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
     n_local = cfg.experts_per_rank
     E = ep_world * n_local
     assert E == cfg.n_experts, (E, cfg.n_experts)
-    C = _capacity(cfg, T)
+    k = cfg.top_k
+    C = _capacity(cfg, T * k)
 
-    # top-1 routing (router weights are replicated)
+    # top-k routing (router weights are replicated): each token becomes k
+    # pseudo-tokens, token-major, so capacity positions fill in token order
     logits = x @ params["router"]                      # (T, E)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    assign = jnp.argmax(probs, axis=-1)                # (T,)
-    gate = jnp.take_along_axis(probs, assign[:, None], axis=-1)[:, 0]
+    topv, topi = lax.top_k(probs, k)                   # (T, k)
+    gates = topv if k == 1 else topv / topv.sum(-1, keepdims=True)
+    assign = topi.reshape(-1)                          # (T*k,)
+    gate = gates.reshape(-1)
+    x_rep = jnp.repeat(x, k, axis=0)                   # (T*k, D)
 
-    # capacity assignment: position of each token within its expert
-    onehot = jax.nn.one_hot(assign, E, dtype=jnp.int32)          # (T, E)
-    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot              # (T, E)
-    pos_in_e = pos.sum(axis=-1)                                  # (T,)
+    # capacity assignment: position of each pseudo-token within its expert
+    onehot = jax.nn.one_hot(assign, E, dtype=jnp.int32)          # (T*k, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot              # (T*k, E)
+    pos_in_e = pos.sum(axis=-1)                                  # (T*k,)
     keep = pos_in_e < C
 
     # dispatch buffer (E, C, D): slot [e, c] = the c-th token routed to e
@@ -108,7 +117,7 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
     safe_c = jnp.where(keep, pos_in_e, 0)
     dispatch = jnp.zeros((E, C, D), x.dtype)
     dispatch = dispatch.at[safe_e, safe_c].add(
-        jnp.where(keep[:, None], x, 0.0)
+        jnp.where(keep[:, None], x_rep, 0.0)
     )
 
     # dispatch alltoall: destination rank r gets experts [r*n_local, ...)
@@ -135,10 +144,12 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
         out.reshape(-1), axis=ep_axis, world=ep_world, wire=wire
     ).reshape(E, C, D)
 
-    # combine: gather each token's slot, weight by the router gate
-    token_out = back[safe_e, safe_c]                   # (T, D)
-    return jnp.where(keep[:, None], token_out * gate[:, None].astype(x.dtype),
-                     0.0)
+    # combine: gather each pseudo-token's slot, weight by its gate, and
+    # sum each token's k expert contributions
+    token_out = back[safe_e, safe_c]                   # (T*k, D)
+    contrib = jnp.where(keep[:, None],
+                        token_out * gate[:, None].astype(x.dtype), 0.0)
+    return contrib.reshape(T, k, D).sum(axis=1)
 
 
 def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
@@ -246,24 +257,28 @@ def moe_reference_forward(params, tokens, cfg: MoEConfig):
 
     def per_seq(xi):
         T, D = xi.shape
-        E, C = cfg.n_experts, _capacity(cfg, T)
+        k = cfg.top_k
+        E, C = cfg.n_experts, _capacity(cfg, T * k)
         logits = xi @ params["router"]
         probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
-        assign = jnp.argmax(probs, -1)
-        gate = jnp.take_along_axis(probs, assign[:, None], -1)[:, 0]
+        topv, topi = jax.lax.top_k(probs, k)
+        gates = topv if k == 1 else topv / topv.sum(-1, keepdims=True)
+        assign = topi.reshape(-1)
+        gate = gates.reshape(-1)
+        x_rep = jnp.repeat(xi, k, axis=0)
         onehot = jax.nn.one_hot(assign, E, dtype=jnp.int32)
         pos_in_e = ((jnp.cumsum(onehot, 0) - 1) * onehot).sum(-1)
         keep = pos_in_e < C
         safe_e = jnp.where(keep, assign, 0)
         safe_c = jnp.where(keep, pos_in_e, 0)
         disp = jnp.zeros((E, C, D), xi.dtype).at[safe_e, safe_c].add(
-            jnp.where(keep[:, None], xi, 0.0))
+            jnp.where(keep[:, None], x_rep, 0.0))
         h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", disp, params["w_up"]))
         out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])
         tok = out[safe_e, safe_c]
-        moe = jnp.where(keep[:, None], tok * gate[:, None].astype(xi.dtype),
-                        0.0)
-        return xi + moe
+        contrib = jnp.where(keep[:, None],
+                            tok * gate[:, None].astype(xi.dtype), 0.0)
+        return xi + contrib.reshape(T, k, D).sum(axis=1)
 
     x = jax.vmap(per_seq)(x)
     x = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), -1, keepdims=True) + 1e-6)

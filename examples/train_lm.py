@@ -28,7 +28,21 @@ def main():
     ap.add_argument("--ckpt", default=None, help="checkpoint directory")
     ap.add_argument("--cpu-devices", type=int, default=8)
     ap.add_argument("--model", choices=("dense", "moe"), default="dense")
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages for the dense model (layers "
+                         "shard over a pp mesh axis, GPipe microbatching)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize each block in the backward pass "
+                         "(jax.checkpoint): O(1) activation memory")
+    ap.add_argument("--top-k", type=int, default=1,
+                    help="experts per token for --model moe")
     args = ap.parse_args()
+    # model-specific flags fail loudly on the wrong path instead of
+    # silently measuring the plain step
+    if args.model == "moe" and (args.pp > 1 or args.remat):
+        raise SystemExit("--pp/--remat apply to --model dense only")
+    if args.model == "dense" and args.top_k != 1:
+        raise SystemExit("--top-k applies to --model moe only")
 
     import jax
 
@@ -54,8 +68,10 @@ def main():
         axes = {"dp": dp, "ep": ep}
         mesh = make_mesh(axes)
         cfg = MoEConfig(d_model=64, d_ff=128, n_experts=ep,
-                        experts_per_rank=1, vocab=128, seq=32)
-        print(f"mesh {axes}; MoE with {cfg.n_experts} experts")
+                        experts_per_rank=1, vocab=128, seq=32,
+                        top_k=args.top_k)
+        print(f"mesh {axes}; MoE with {cfg.n_experts} experts, "
+              f"top-{cfg.top_k} routing")
         params = init_moe_params(cfg, jax.random.key(0))
 
         def place(p):
@@ -73,22 +89,33 @@ def main():
                                      make_train_step)
         from accl_tpu.models.transformer import demo_batch, shard_params
 
-        axes = factorize_devices(n_dev)
+        pp = max(1, args.pp)
+        if pp > 1:
+            if n_dev % pp:
+                raise SystemExit(f"--pp {pp} does not divide {n_dev} devices")
+            rest = n_dev // pp
+            tp = 2 if rest % 2 == 0 else 1
+            axes = {"dp": rest // tp, "sp": 1, "tp": tp, "pp": pp}
+        else:
+            axes = factorize_devices(n_dev)
         mesh = make_mesh(axes)
         heads = max(4, axes["tp"] * 2)
         cfg = TransformerConfig(vocab=128, d_model=heads * 8, n_heads=heads,
-                                n_layers=2, d_ff=heads * 16)
-        print(f"mesh {axes}; model d={cfg.d_model} heads={cfg.n_heads}")
+                                n_layers=max(2, pp), d_ff=heads * 16)
+        print(f"mesh {axes}; model d={cfg.d_model} heads={cfg.n_heads} "
+              f"layers={cfg.n_layers}" + (" remat" if args.remat else ""))
         params = init_params(cfg, jax.random.key(0))
 
         def place(p):
             return shard_params(p, cfg, mesh)
 
         def make_batch():
-            return demo_batch(cfg, mesh, batch=max(2, axes["dp"] * 2),
+            # B_local = batch/dp must divide by the pp microbatch count
+            batch = max(2, axes["dp"]) * max(pp, 2)
+            return demo_batch(cfg, mesh, batch=batch,
                               seq=max(32, axes["sp"] * 16))
 
-        step = make_train_step(cfg, mesh, lr=3e-2)
+        step = make_train_step(cfg, mesh, lr=3e-2, remat=args.remat)
 
     start_step = 0
 
@@ -119,6 +146,14 @@ def main():
         target = pathlib.Path(args.ckpt).absolute() / \
             f"step_{start_step + args.steps:06d}"
         host_params = jax.tree.map(lambda x: np.asarray(x), params)
+        if args.model == "dense" and args.pp > 1:
+            # checkpoints stay in the mesh-independent per-layer list form,
+            # so a run can resume onto a different pp width WHEN the model
+            # depth matches (n_layers here is max(2, pp): pp<=2 widths
+            # interchange; deeper pipelines need the same --pp to resume)
+            from accl_tpu.models.transformer import unstack_layer_params
+
+            host_params = unstack_layer_params(host_params, cfg.n_layers)
         ckptr.save(target, host_params, force=True)
         ckptr.wait_until_finished()
         print(f"saved {target}")
