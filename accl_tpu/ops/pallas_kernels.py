@@ -75,9 +75,12 @@ def _combine_kernel(op, a_ref, b_ref, o_ref):
 @functools.partial(jax.jit, static_argnames=("op", "interpret"))
 def combine_pallas(a, b, op: str = "sum", interpret: bool | None = None):
     """Elementwise SUM/MAX over two flat buffers via Pallas (reduce_ops
-    stream_add/stream_max analog, reduce_ops.cpp:31-73)."""
+    stream_add/stream_max analog, reduce_ops.cpp:31-73). float16 lanes
+    route through XLA on real TPU (see _mosaic_rejects)."""
     if interpret is None:
         interpret = not _on_tpu()
+    if not interpret and _mosaic_rejects(a.dtype, b.dtype):
+        return jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
     at, n = _as_tiles(a)
     bt, _ = _as_tiles(b)
     at = _pad_rows(at, _BLOCK_ROWS)
@@ -104,12 +107,22 @@ def _cast_kernel(dtype, x_ref, o_ref):
     o_ref[...] = x_ref[...].astype(dtype)
 
 
+def _mosaic_rejects(*dtypes) -> bool:
+    """The v5e Mosaic dialect has no f16 type (bf16 is the native half
+    precision): compiled Pallas kernels touching float16 are rejected with
+    'Unsupported type in mosaic dialect'. Measured on the live toolchain."""
+    return any(jnp.dtype(d) == jnp.float16 for d in dtypes)
+
+
 @functools.partial(jax.jit, static_argnames=("to_dtype", "interpret"))
 def cast_pallas(x, to_dtype, interpret: bool | None = None):
     """Streaming dtype cast (hp_compression fp2hp/hp2fp analog) — one VMEM
-    pass, grid over row blocks."""
+    pass, grid over row blocks. float16 lanes route through XLA on real
+    TPU (see _mosaic_rejects); the numerics are identical either way."""
     if interpret is None:
         interpret = not _on_tpu()
+    if not interpret and _mosaic_rejects(x.dtype, to_dtype):
+        return x.astype(to_dtype)
     xt, n = _as_tiles(x)
     xt = _pad_rows(xt, _BLOCK_ROWS)
     grid = (xt.shape[0] // _BLOCK_ROWS,)
@@ -146,10 +159,17 @@ def fused_combine_cast_pallas(
     a, b, op="sum", acc_dtype=jnp.float32, out_dtype=None, interpret=None
 ):
     """Combine in acc_dtype, emit in out_dtype — one VMEM pass instead of
-    decompress + reduce + compress round-trips through HBM."""
+    decompress + reduce + compress round-trips through HBM. float16 wire
+    domains route through XLA on real TPU (see _mosaic_rejects), where the
+    same fusion happens at the HLO level."""
     if interpret is None:
         interpret = not _on_tpu()
     out_dtype = out_dtype or a.dtype
+    if not interpret and _mosaic_rejects(a.dtype, b.dtype, acc_dtype,
+                                         out_dtype):
+        r = a.astype(acc_dtype) + b.astype(acc_dtype) if op == "sum" \
+            else jnp.maximum(a.astype(acc_dtype), b.astype(acc_dtype))
+        return r.astype(out_dtype)
     at, n = _as_tiles(a)
     bt, _ = _as_tiles(b)
     at = _pad_rows(at, _BLOCK_ROWS)

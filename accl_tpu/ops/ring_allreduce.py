@@ -103,6 +103,26 @@ def _kernel(axis_name, world, chunk, func, x_ref, o_ref, v_ref, comm_ref,
         release(t, slot)
 
 
+def _compiled_f16_detour(x, interpret):
+    """The v5e Mosaic dialect rejects float16 (see pallas_kernels
+    ._mosaic_rejects), so a compiled-on-TPU ring over an f16 wire domain
+    runs the kernel in fp32 and casts the result back: numerics are at
+    least as accurate (fp32 ring accumulation, one final f16 round) at the
+    cost of 2x wire bytes. Interpret-mode (CPU) f16 stays on the native
+    f16 path. Returns a rerun closure, or None when no detour is needed."""
+    from .pallas_kernels import _mosaic_rejects, _on_tpu
+
+    compiled = (interpret is False) or (interpret is None and _on_tpu())
+    if not (compiled and _mosaic_rejects(x.dtype)):
+        return None
+    orig = x.dtype
+
+    def rerun(entry, **kw):
+        return entry(x.astype(jnp.float32), **kw).astype(orig)
+
+    return rerun
+
+
 def ring_allreduce_pallas(
     x,
     *,
@@ -114,6 +134,11 @@ def ring_allreduce_pallas(
 ):
     """Per-device body (call inside shard_map): fused ring allreduce of a
     flat (n,) buffer. Pads n up to a world-aligned, lane-aligned chunk."""
+    f16_detour = _compiled_f16_detour(x, interpret)
+    if f16_detour is not None:
+        return f16_detour(
+            ring_allreduce_pallas, axis_name=axis_name, world=world,
+            func=func, interpret=interpret, detect_races=detect_races)
     n = x.shape[-1]
     chunk = -(-n // world)
     chunk = -(-chunk // 128) * 128  # lane alignment
@@ -253,6 +278,11 @@ def ring_allreduce_pallas_bidir(
     detect_races: bool = False,
 ):
     """Bidirectional fused ring allreduce of a flat (n,) buffer."""
+    f16_detour = _compiled_f16_detour(x, interpret)
+    if f16_detour is not None:
+        return f16_detour(
+            ring_allreduce_pallas_bidir, axis_name=axis_name, world=world,
+            func=func, interpret=interpret, detect_races=detect_races)
     n = x.shape[-1]
     # pad so n splits into 2 * world lane-aligned chunks
     chunk = -(-n // (2 * world))

@@ -64,15 +64,27 @@ def _topology_mesh():
     from jax.experimental import topologies
     from jax.sharding import Mesh
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    # The compile target must match the ATTACHED generation, so the
+    # topology name is derived from device_kind (8-chip slice of the same
+    # generation). Anonymous get_topology_desc forms are NOT attempted:
+    # on the tunneled v5e plugin they yield a topology whose AOT compile
+    # wedges instead of erroring (observed live), and a named mismatched
+    # generation would validate the wrong Mosaic target.
+    kind = dev.device_kind.lower()
+    names_by_kind = [
+        ("v5 lite", "v5e:2x4"), ("v5e", "v5e:2x4"),
+        ("v6 lite", "v6e:2x4"), ("v6e", "v6e:2x4"),
+        ("v5p", "v5p:2x2x2"), ("v5", "v5p:2x2x2"),
+        ("v4", "v4:2x2x2"),
+    ]
+    name = next((n for k, n in names_by_kind if k in kind), None)
+    if name is None:
+        pytest.skip(f"no known 8-chip topology name for kind {kind!r}")
     try:
-        try:
-            topo = topologies.get_topology_desc(platform=platform,
-                                                chips=WORLD)
-        except TypeError:
-            topo = topologies.get_topology_desc(platform=platform)
+        topo = topologies.get_topology_desc(name, platform="tpu")
         devs = np.array(topo.devices[:WORLD])
-    except (NotImplementedError, RuntimeError, ValueError) as e:
+    except (NotImplementedError, RuntimeError, ValueError, TypeError) as e:
         pytest.skip(f"detached-topology AOT unsupported on this plugin: {e}")
     if devs.size < WORLD:
         pytest.skip(f"topology exposes {devs.size} < {WORLD} devices")
@@ -129,6 +141,16 @@ def test_combine_and_cast_execute_on_chip():
     out = np.asarray(combine_pallas(a, b, op="sum", interpret=False))
     np.testing.assert_allclose(out, np.asarray(a) + np.asarray(b), rtol=1e-6)
 
+    # bf16 is the TPU-native half type and MUST ride the Mosaic lane
+    import jax.numpy as jnp
+
+    g = cast_pallas(a, jnp.bfloat16, interpret=False)
+    np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
+                               np.asarray(a).astype(jnp.bfloat16)
+                               .astype(np.float32), rtol=0)
+
+    # f16 lanes route through the XLA guard on this toolchain (Mosaic has
+    # no f16 type); numerics must still match exactly
     h = cast_pallas(a, np.float16, interpret=False)
     np.testing.assert_allclose(np.asarray(h),
                                np.asarray(a).astype(np.float16), rtol=0)
