@@ -72,21 +72,26 @@ def _combine_kernel(op, a_ref, b_ref, o_ref):
     o_ref[...] = jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
 
 
-@functools.partial(jax.jit, static_argnames=("op", "interpret"))
-def combine_pallas(a, b, op: str = "sum", interpret: bool | None = None):
+@functools.partial(jax.jit,
+                   static_argnames=("op", "interpret", "block_rows"))
+def combine_pallas(a, b, op: str = "sum", interpret: bool | None = None,
+                   block_rows: int | None = None):
     """Elementwise SUM/MAX over two flat buffers via Pallas (reduce_ops
     stream_add/stream_max analog, reduce_ops.cpp:31-73). float16 lanes
-    route through XLA on real TPU (see _mosaic_rejects)."""
+    route through XLA on real TPU (see _mosaic_rejects). block_rows sets
+    the per-grid-step VMEM tile height (default _BLOCK_ROWS; the bench
+    sweeps it on-chip to pick the streaming-regime optimum)."""
     if interpret is None:
         interpret = not _on_tpu()
     if not interpret and _mosaic_rejects(a.dtype, b.dtype):
         return jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
+    block_rows = block_rows or _BLOCK_ROWS
     at, n = _as_tiles(a)
     bt, _ = _as_tiles(b)
-    at = _pad_rows(at, _BLOCK_ROWS)
-    bt = _pad_rows(bt, _BLOCK_ROWS)
-    grid = (at.shape[0] // _BLOCK_ROWS,)
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    at = _pad_rows(at, block_rows)
+    bt = _pad_rows(bt, block_rows)
+    grid = (at.shape[0] // block_rows,)
+    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
     out = pl.pallas_call(
         functools.partial(_combine_kernel, op),
         out_shape=jax.ShapeDtypeStruct(at.shape, at.dtype),

@@ -295,11 +295,33 @@ class ScheduleCompiler:
                     and options.compression_flags & CompressionFlags.ETH_COMPRESSED
                     and wire_dtype(arithcfg) is not None
                 )
+                # the dtype the fused kernel would run in: the wire dtype
+                # under compressed-domain execution, the payload dtype
+                # otherwise. On real TPU, dtypes Mosaic rejects (f16) must
+                # take the lax schedule — XLA carries f16 natively, so the
+                # requested wire compression keeps its bandwidth meaning
+                # (the kernel-level _compiled_f16_detour would silently
+                # widen the wire back to fp32).
+                from ..ops.pallas_kernels import _mosaic_rejects, _on_tpu
+
+                from ..constants import to_numpy_dtype
+
+                ring_dtype = (
+                    wire_dtype(arithcfg) if compressed_domain
+                    else (to_numpy_dtype(options.data_type)
+                          if options.data_type != DataType.none else None)
+                )
+                mosaic_ok = not (
+                    ring_dtype is not None
+                    and _mosaic_rejects(ring_dtype)
+                    and _on_tpu()
+                )
                 if (
                     self.use_pallas_ring
                     # per-hop compression with uncompressed-domain arithmetic
                     # cannot be fused into the single-dtype ring kernel
                     and (not eth_active or compressed_domain)
+                    and mosaic_ok
                 ):
                     from ..ops.ring_allreduce import ring_allreduce_pallas_bidir
 

@@ -103,6 +103,15 @@ def bench_combine(jax, sizes_bytes):
             ("combine_sum_fp32_pallas",
              lambda c, b: combine_pallas(c, b, op="sum", interpret=False))
         )
+        if os.environ.get("ACCL_BENCH_FULL") == "1":
+            # on-chip VMEM-tile-height sweep for the Pallas lane: the
+            # streaming-regime winner becomes the next default block size
+            for br in (2048, 8192):
+                variants.append(
+                    (f"combine_sum_fp32_pallas_br{br}",
+                     lambda c, b, _br=br: combine_pallas(
+                         c, b, op="sum", interpret=False, block_rows=_br))
+                )
 
     rows = []
     for nbytes in sizes_bytes:
@@ -114,8 +123,8 @@ def bench_combine(jax, sizes_bytes):
         # crude estimate: 3x payload over ~300 GB/s HBM + kernel overhead
         est = 3 * nbytes / 300e9 + 3e-6
         for name, op in variants:
-            if name.endswith("_pallas") and nbytes < 256 * 1024 * 1024:
-                continue  # plugin variant measured in the streaming regime
+            if "_pallas" in name and nbytes < 256 * 1024 * 1024:
+                continue  # plugin variants measured in the streaming regime
             sec, k, snr = _timeit_loop(make_variant(op), (a, b), est, jax=jax)
             gbps = nbytes / sec / 1e9
             rows.append((name, nbytes, sec, gbps, snr))
@@ -124,36 +133,49 @@ def bench_combine(jax, sizes_bytes):
     return rows
 
 
-def bench_allreduce(jax, sizes_bytes, world):
-    """Eager ring allreduce schedule over however many devices exist."""
+def bench_collective(jax, op_name, sizes_bytes, world):
+    """Time one compiled collective schedule over however many devices
+    exist (the per-collective sweep of the reference's bench.cpp:25-61,
+    one Test name per collective)."""
     from jax.sharding import Mesh
 
     from accl_tpu import CallOptions, DataType, Operation, ReduceFunction, TuningParams
     from accl_tpu.sequencer import select_algorithm
     from accl_tpu.sequencer.lowering import ScheduleCompiler
 
+    op = Operation[op_name]
     mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
     comp = ScheduleCompiler(mesh)
     rows = []
     for nbytes in sizes_bytes:
         count = nbytes // 4
-        opts = CallOptions(scenario=Operation.allreduce, count=count,
+        opts = CallOptions(scenario=op, count=count, root_src_dst=0,
                            function=int(ReduceFunction.SUM),
                            data_type=DataType.float32)
         plan = select_algorithm(
-            Operation.allreduce, count, 4, world,
+            op, count, 4, world,
             max_eager_size=1 << 30, eager_rx_buf_size=1 << 22,
             tuning=TuningParams.default(),
         )
         base_fn = comp.lower(opts, plan)
         import jax as _j
-        from jax import lax as _lax
 
-        def make_fn(k, _f=base_fn):
+        # the repeat loop chains output into input only for ops whose
+        # output shape matches the input; other ops still dispatch k
+        # independent times (per-op seconds are the mean over k either way)
+        same_shape = op in (Operation.allreduce, Operation.bcast,
+                            Operation.reduce, Operation.alltoall)
+
+        def make_fn(k, _f=base_fn, _same=same_shape):
             def rep(x):
-                for _ in range(k):  # re-dispatch the compiled schedule
-                    x = _f(x)
-                return x
+                if _same:
+                    for _ in range(k):
+                        x = _f(x)
+                    return x
+                out = None
+                for _ in range(k):
+                    out = _f(x)
+                return out
             return rep
 
         x = np.random.default_rng(2).standard_normal((world, count)) \
@@ -163,16 +185,18 @@ def bench_allreduce(jax, sizes_bytes, world):
         sec, _k, snr = _timeit_loop(make_fn, (xd,), est, target=0.5,
                                     kmax=200, jax=_j)
         if world > 1:
-            # bus bandwidth convention: 2*(P-1)/P * payload per chip
-            bw = 2 * (world - 1) / world * nbytes / sec / 1e9
-            name = "allreduce_ring_fp32"
+            # bus bandwidth convention for allreduce; payload/s elsewhere
+            scale = (2 * (world - 1) / world
+                     if op == Operation.allreduce else 1.0)
+            bw = scale * nbytes / sec / 1e9
+            name = f"{op_name}_w{world}_fp32"
         else:
             # single chip (the real-TPU regime): no wire exists, so this
-            # times the COMPILED allreduce program's dispatch + datapath
-            # (the world-1 degenerate schedule); multi-rank wire numbers
-            # come from the emulator sweep (accl_log/emu_bench.csv)
+            # times the COMPILED program's dispatch + datapath (the
+            # world-1 degenerate schedule); multi-rank wire numbers come
+            # from the emulator sweep (accl_log/emu_bench.csv)
             bw = nbytes / sec / 1e9
-            name = "allreduce_w1_dispatch_datapath_fp32"
+            name = f"{op_name}_w1_dispatch_datapath_fp32"
         rows.append((name, nbytes, sec, bw, snr))
         print(f"  {name} {nbytes:>10d} B  {sec*1e6:10.1f} us  "
               f"{bw:8.2f} GB/s", file=sys.stderr)
@@ -214,7 +238,20 @@ def main():
     # schedule (the BASELINE.md sweep's on-chip component); with a CPU
     # mesh it also exercises the wire path
     ar_sizes = [1 << k for k in range(12, 27, 6)]
-    rows += bench_allreduce(jax, ar_sizes, min(world, 8))
+    rows += bench_collective(jax, "allreduce", ar_sizes, min(world, 8))
+
+    # ACCL_BENCH_FULL=1: the reference's 8-collective sweep shape
+    # (bench.cpp:25-61) — every collective through its compiled schedule.
+    # Off by default because each (op, size) pair costs a remote compile
+    # on the tunneled chip; the probe-loop payload runs it.
+    if os.environ.get("ACCL_BENCH_FULL") == "1":
+        full_sizes = [1 << k for k in range(12, 25, 6)]
+        for op_name in ("bcast", "scatter", "gather", "allgather",
+                        "reduce", "reduce_scatter", "alltoall"):
+            rows += bench_collective(jax, op_name, full_sizes,
+                                     min(world, 8))
+        rows += bench_collective(jax, "allreduce", [1 << 28],
+                                 min(world, 8))
 
     outdir = pathlib.Path(__file__).parent / "accl_log"
     outdir.mkdir(exist_ok=True)

@@ -91,7 +91,7 @@ def _topology_mesh():
     return Mesh(devs.reshape(WORLD), ("ccl",))
 
 
-def _compile_for_topology(kernel_fn):
+def _compile_for_topology(kernel_fn, dtype=np.float32):
     """AOT-compile the 8-device ring program against a TPU topology.
     Compilation errors PROPAGATE — a Mosaic rejection here is exactly the
     failure this suite exists to catch."""
@@ -104,17 +104,22 @@ def _compile_for_topology(kernel_fn):
                       check_vma=False)
     )
     x = jax.ShapeDtypeStruct(
-        (WORLD, 4096), np.float32,
+        (WORLD, 4096), dtype,
         sharding=NamedSharding(mesh, spec))
     return fn.lower(x).compile()
 
 
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "float16"])
 @pytest.mark.parametrize("variant", ["uni", "bidir"])
-def test_mosaic_compiles_ring_kernels_world8(variant):
+def test_mosaic_compiles_ring_kernels_world8(variant, dtype):
     """Lower + Mosaic-compile the fused ring allreduce kernels for an
     8-device ring on the real TPU toolchain (compile-only: one attached
     chip cannot execute the program, but compilation is where Mosaic
-    validates semaphores, DMA descriptors and collective_id)."""
+    validates semaphores, DMA descriptors and collective_id). bfloat16 is
+    the compressed wire domain and must ride the Mosaic lane natively;
+    float16 exercises the fp32 detour (_compiled_f16_detour)."""
+    import jax.numpy as jnp
+
     from accl_tpu.ops.ring_allreduce import (
         ring_allreduce_pallas,
         ring_allreduce_pallas_bidir,
@@ -122,7 +127,7 @@ def test_mosaic_compiles_ring_kernels_world8(variant):
 
     kernel = (ring_allreduce_pallas if variant == "uni"
               else ring_allreduce_pallas_bidir)
-    compiled = _compile_for_topology(kernel)
+    compiled = _compile_for_topology(kernel, jnp.dtype(dtype))
     assert compiled is not None
     # the executable embeds the Mosaic custom call — reaching here means
     # the kernel passed the Mosaic compiler for a real 8-chip target

@@ -65,7 +65,10 @@ def run_hw_payload() -> None:
     jobs = [
         ("hw_suite", ["python", "-m", "pytest", "tests/test_tpu_hw.py",
                       "-v", "-x"], {"ACCL_TPU_HW": "1"}, 3600),
-        ("bench_tpu", ["python", str(REPO / "bench.py")], {}, 3600),
+        # full mode: 8-collective sweep + Pallas tile-height sweep — each
+        # (op, size) costs a remote compile, hence the generous timeout
+        ("bench_tpu", ["python", str(REPO / "bench.py")],
+         {"ACCL_BENCH_FULL": "1"}, 5400),
     ]
     import os
 
