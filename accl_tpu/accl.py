@@ -539,3 +539,30 @@ class ACCL:
 
     def dump_communicator(self, index: int = 0) -> str:
         return self.communicators[index].dump()
+
+    def dump_eager_rx_buffers(self) -> str:
+        """Snapshot of the eager rx machinery (reference
+        dump_eager_rx_buffers, accl.cpp:964-1012): the native executor
+        reports its rx ring slot-by-slot; the XLA executor reports its
+        parked recv/send queues (the rx-notification parking that plays
+        the ring's role there)."""
+        return self.cclo.dump_eager_rx_buffers()
+
+    def soft_reset(self):
+        """reset_periph config call (reference soft_reset, accl.cpp:57-69):
+        drains parked/pending call state and compiled-schedule caches but
+        leaves the device configured (unlike deinit, which also clears
+        CFGRDY)."""
+        self._config_call(CfgFunc.reset_periph, 0)
+
+    def get_comm_group(self, comm: Communicator | None = None) -> list[Rank]:
+        """Round-trip the communicator's rank table from exchange memory
+        (reference get_comm_group, accl.hpp readback path): returns what
+        the DEVICE holds, not the facade's cached object, so drift between
+        the two is observable."""
+        comm = comm or self.communicators[0]
+        n_words = 2 + Communicator.WORDS_PER_RANK * comm.size
+        words = [self.cclo.read(comm.exchmem_addr + 4 * i)
+                 for i in range(n_words)]
+        return Communicator.from_exchmem_words(
+            words, exchmem_addr=comm.exchmem_addr).ranks

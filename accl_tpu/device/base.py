@@ -74,6 +74,11 @@ class CCLODevice:
             lines.append(f"  [{addr:#06x}] = {self._exchmem[addr]:#010x}")
         return "\n".join(lines)
 
+    def dump_eager_rx_buffers(self) -> str:
+        """Reference ACCL::dump_eager_rx_buffers (accl.cpp:964-1012);
+        backends with eager rx state override."""
+        return "eager rx ring: none on this backend"
+
     # -- calls ------------------------------------------------------------
 
     def call(self, options: CallOptions) -> BaseRequest:

@@ -83,6 +83,9 @@ def load_native():
         lib.accl_rt_read.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.accl_rt_write.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                       ctypes.c_uint32]
+        lib.accl_rt_dump_rxbufs.restype = ctypes.c_size_t
+        lib.accl_rt_dump_rxbufs.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_size_t]
         _lib = lib
         return lib
 
@@ -155,6 +158,17 @@ class EmuRank:
 
     def write(self, addr: int, value: int):
         self._lib.accl_rt_write(self._rt, addr, value)
+
+    def dump_eager_rx_buffers(self) -> str:
+        """Slot-by-slot rx-ring snapshot from the native runtime
+        (accl_rt_dump_rxbufs; reference accl.cpp:964-1012)."""
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            need = self._lib.accl_rt_dump_rxbufs(self._rt, buf, cap)
+            if need < cap:  # re-loop if the ring grew between calls
+                return buf.value.decode()
+            cap = need + 4096
 
     # -- calls -------------------------------------------------------------
 

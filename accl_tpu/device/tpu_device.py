@@ -503,6 +503,30 @@ class TPUDevice(CCLODevice):
 
         return TPURequest("stream_put", [out], on_complete=place)
 
+    def dump_eager_rx_buffers(self) -> str:
+        """The XLA executor's analog of the rx-ring dump
+        (accl.cpp:964-1012): this backend has no spare-buffer ring — XLA
+        owns the data plane — so the parked recv/send queues (its
+        rx-notification parking, rxbuf_seek.cpp role) are the observable
+        eager state."""
+        with self._recv_mu:
+            lines = [
+                f"eager rx (XLA executor): buf_size {self.eager_rx_buf_size}"
+                f", parked sends {self._parked_send_count}"
+                f"/{self.MAX_PARKED_SENDS}"
+            ]
+            for (ca, s, d, tag), q in sorted(self._pending_recvs.items()):
+                for parked in q:
+                    lines.append(
+                        f"parked recv: comm {ca:#x} src {s} dst {d} "
+                        f"tag {tag} seq {parked._park_seq}")
+            for (ca, s, d, tag), q in sorted(self._pending_sends.items()):
+                for seq, opts in q:
+                    lines.append(
+                        f"parked send: comm {ca:#x} src {s} dst {d} "
+                        f"tag {tag} seq {seq} count {opts.count}")
+        return "\n".join(lines)
+
     # -- config calls (ACCL_CONFIG switch, .c:2416-2452) -------------------
 
     def _config(self, options: CallOptions) -> BaseRequest:

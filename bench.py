@@ -166,15 +166,35 @@ def bench_collective(jax, op_name, sizes_bytes, world):
         same_shape = op in (Operation.allreduce, Operation.bcast,
                             Operation.reduce, Operation.alltoall)
 
-        def make_fn(k, _f=base_fn, _same=same_shape):
+        # multi-device CPU worlds sync every dispatch: deep async queues
+        # of multi-device programs starve XLA's in-process CPU rendezvous
+        # (worker threads service later-enqueued programs while earlier
+        # participants wait — observed as collective-permute termination
+        # timeouts at k~200, world 8). The ops are ms-scale there, so the
+        # per-dispatch sync does not distort the measurement. Real-TPU
+        # worlds keep the pipelined chain: hardware collectives are
+        # us-scale and a host sync per dispatch would dominate them.
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        sync_each = world > 1 and not on_tpu
+
+        def make_fn(k, _f=base_fn, _same=same_shape, _sync=sync_each):
             def rep(x):
                 if _same:
                     for _ in range(k):
                         x = _f(x)
+                        if _sync:
+                            jax.block_until_ready(x)
                     return x
                 out = None
                 for _ in range(k):
                     out = _f(x)
+                    if _sync:
+                        jax.block_until_ready(out)
+                    else:
+                        # per-row (sharding-aligned, collective-free) data
+                        # dependency serializes dispatches like the chained
+                        # lane and bounds in-flight outputs to one buffer
+                        x = x + (out[..., :1] * 0).astype(x.dtype)
                 return out
             return rep
 
@@ -201,6 +221,73 @@ def bench_collective(jax, op_name, sizes_bytes, world):
         print(f"  {name} {nbytes:>10d} B  {sec*1e6:10.1f} us  "
               f"{bw:8.2f} GB/s", file=sys.stderr)
     return rows
+
+
+def bench_flagship(jax):
+    """Flagship training-step lane: tokens/s and approximate model-FLOPs
+    utilization of the compiled dense-transformer train step (forward +
+    backward + grad sync + SGD) on the attached device. The reference has
+    no model layer — this lane shows the framework's compute path is
+    MXU-shaped (bf16 matmuls), complementing the collective lanes.
+    Writes accl_log/flagship.csv."""
+    from accl_tpu.models import TransformerConfig, init_params, make_train_step
+    from accl_tpu.models.transformer import demo_batch, shard_params
+    from accl_tpu.parallel import make_mesh
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        cfg = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
+                                n_layers=8, d_ff=4096, dtype="bfloat16")
+        batch, seq = 8, 1024
+        # bf16 MXU peak per chip, by generation (unknown kinds report no
+        # MFU rather than one computed against the wrong ceiling)
+        kind = jax.devices()[0].device_kind.lower()
+        if "v5 lite" in kind or "v5e" in kind:
+            peak_flops = 197e12
+        elif "v5p" in kind or "v5" in kind:
+            peak_flops = 459e12
+        else:
+            peak_flops = None
+    else:
+        cfg = TransformerConfig(dtype="float32")
+        batch, seq = 4, 64
+        peak_flops = None
+
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1},
+                     devices=jax.devices()[:1])
+    params = shard_params(init_params(cfg, jax.random.key(0)), cfg, mesh)
+    tokens, targets = demo_batch(cfg, mesh, batch=batch, seq=seq)
+    step = make_train_step(cfg, mesh, lr=1e-3)
+
+    def make_fn(k):
+        def rep(p, t, g):
+            loss = None
+            for _ in range(k):
+                p, loss = step(p, t, g)  # param chain serializes steps
+            return loss
+        return rep
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree.leaves(params))
+    T = batch * seq
+    # standard fwd+bwd estimate: 6 FLOPs/param/token + attention term
+    flops_step = 6.0 * n_params * T + 12.0 * cfg.n_layers * T * seq * cfg.d_model
+    est = flops_step / (peak_flops or 50e9) + 1e-3
+    sec, k, snr = _timeit_loop(make_fn, (params, tokens, targets), est,
+                               target=1.0, kmax=50, jax=jax)
+    tok_s = T / sec
+    mfu = flops_step / sec / peak_flops * 100 if peak_flops else float("nan")
+    print(f"  flagship_train_step  {n_params/1e6:.0f}M params  "
+          f"{sec*1e3:8.2f} ms/step  {tok_s:9.0f} tok/s  MFU {mfu:5.1f}%  "
+          f"(K={k})", file=sys.stderr)
+    outdir = pathlib.Path(__file__).parent / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    name = "flagship_cpu.csv" if not on_tpu else "flagship.csv"
+    with open(outdir / name, "w") as f:
+        f.write("NParams,TokensPerStep,SecPerStep,TokensPerSec,"
+                "ApproxFLOPsPerStep,MFUpct,SNR\n")
+        f.write(f"{n_params},{T},{sec:.6e},{tok_s:.1f},"
+                f"{flops_step:.3e},{mfu:.2f},{snr:.1f}\n")
 
 
 def main():
@@ -252,6 +339,10 @@ def main():
                                      min(world, 8))
         rows += bench_collective(jax, "allreduce", [1 << 28],
                                  min(world, 8))
+        try:
+            bench_flagship(jax)
+        except Exception as e:  # the sweep rows must survive a flagship
+            print(f"flagship lane failed: {e!r}", file=sys.stderr)
 
     outdir = pathlib.Path(__file__).parent / "accl_log"
     outdir.mkdir(exist_ok=True)

@@ -81,6 +81,10 @@ void accl_rt_release(accl_rt_t *rt, int64_t handle);
 uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr);
 void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value);
 
+/* Eager-rx-ring snapshot (dump_eager_rx_buffers analog): NUL-terminated
+ * report into out (truncated at cap); returns the untruncated length. */
+size_t accl_rt_dump_rxbufs(accl_rt_t *rt, char *out, size_t cap);
+
 /* Data types, matching accl_tpu.constants.DataType. */
 enum accl_rt_dtype {
   ACCL_DT_NONE = 0,

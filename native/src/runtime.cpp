@@ -2008,4 +2008,34 @@ void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value) {
   rt->wr(addr, value);
 }
 
+// Snapshot of the eager rx ring (the reference's dump_eager_rx_buffers,
+// accl.cpp:964-1012: one line per spare-buffer descriptor with status and
+// the last-landed header fields). Writes a NUL-terminated report into out
+// (truncated at cap); returns the untruncated length a la snprintf.
+size_t accl_rt_dump_rxbufs(accl_rt_t *rt, char *out, size_t cap) {
+  std::string s;
+  {
+    std::lock_guard<std::mutex> g(rt->rx_mu);
+    s += "eager rx ring: " + std::to_string(rt->rx_slots.size()) +
+         " slots (configured " + std::to_string(rt->base_rx_slots) +
+         "), " + std::to_string(rt->idle_q.size()) + " idle\n";
+    for (size_t i = 0; i < rt->rx_slots.size(); i++) {
+      const RxSlot &sl = rt->rx_slots[i];
+      s += "slot " + std::to_string(i) + ": " +
+           (sl.status == RxSlot::VALID ? "VALID" : "IDLE");
+      if (sl.status == RxSlot::VALID)
+        s += " src " + std::to_string(sl.src) + " tag " +
+             std::to_string(sl.tag) + " seqn " + std::to_string(sl.seqn) +
+             " len " + std::to_string(sl.data.size());
+      s += "\n";
+    }
+  }
+  if (cap) {
+    size_t n = std::min(cap - 1, s.size());
+    std::memcpy(out, s.data(), n);
+    out[n] = '\0';
+  }
+  return s.size();
+}
+
 }  // extern "C"

@@ -397,3 +397,38 @@ def test_async_sendrecv_stress(accl):
         accl.wait(recv_reqs[t])
         np.testing.assert_allclose(bufs[t].host[2], x[1], rtol=1e-6,
                                    err_msg=f"iteration {t}")
+
+
+def test_get_comm_group_roundtrip(accl):
+    """get_comm_group reads the rank table back from exchange memory
+    (reference get_comm_group readback): device truth, not facade cache."""
+    ranks = accl.get_comm_group()
+    assert len(ranks) == WORLD
+    cached = accl.communicators[0].ranks
+    assert [r.device_index for r in ranks] == [r.device_index for r in cached]
+    assert [r.port for r in ranks] == [r.port for r in cached]
+    sub = accl.split([0, 3, 5])
+    subranks = accl.get_comm_group(sub)
+    assert [r.device_index for r in subranks] == \
+        [cached[i].device_index for i in (0, 3, 5)]
+
+
+def test_dump_eager_rx_buffers_and_soft_reset(accl):
+    """An unmatched send parks and is visible in the rx dump
+    (accl.cpp:964-1012 observability role); soft_reset (accl.cpp:57-69)
+    drains it without deconfiguring the device."""
+    x = RNG.standard_normal((WORLD, 16)).astype(np.float32)
+    sb = accl.create_buffer(16, data=x)
+    accl.send(sb, 16, src=3, dst=4, tag=321)
+    dump = accl.dump_eager_rx_buffers()
+    assert "parked send:" in dump and "tag 321" in dump
+
+    accl.soft_reset()
+    assert "parked send:" not in accl.dump_eager_rx_buffers()
+    assert accl.cclo.read(0x1FF4) == 1  # still configured (CFGRDY intact)
+
+    # the device remains fully usable after the reset
+    rb = accl.create_buffer(16)
+    accl.send(sb, 16, src=3, dst=4, tag=322)
+    accl.recv(rb, 16, src=3, dst=4, tag=322)
+    np.testing.assert_allclose(rb.host[4], x[3], rtol=1e-6)

@@ -914,3 +914,33 @@ def test_udp_100k_datagram_burst_drains_fast():
         assert t_drain < 30.0, f"burst drain took {t_drain:.1f}s"
     finally:
         w.close()
+
+
+def test_emu_dump_rx_ring(world4):
+    """dump_eager_rx_buffers (accl_rt_dump_rxbufs) surfaces a landed but
+    unconsumed eager segment as a VALID slot with its header fields, and
+    shows the slot released after the recv drains it (the reference's
+    dump_eager_rx_buffers observability role, accl.cpp:964-1012)."""
+    import time
+
+    x = RNG.standard_normal(64).astype(np.float32)
+
+    def body(rank, i):
+        if i == 0:
+            rank.send(x.copy(), 64, dst=1, tag=55)
+        elif i == 1:
+            for _ in range(200):
+                if "VALID" in rank.dump_eager_rx_buffers():
+                    break
+                time.sleep(0.01)
+            d = rank.dump_eager_rx_buffers()
+            assert "eager rx ring" in d
+            assert "src 0 tag 55" in d, d
+            out = np.zeros(64, np.float32)
+            rank.recv(out, 64, src=0, tag=55)
+            assert "tag 55" not in rank.dump_eager_rx_buffers()
+            return out
+        return None
+
+    res = world4.run(body)
+    np.testing.assert_allclose(res[1], x, rtol=1e-6)
