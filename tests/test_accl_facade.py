@@ -425,7 +425,9 @@ def test_dump_eager_rx_buffers_and_soft_reset(accl):
 
     accl.soft_reset()
     assert "parked send:" not in accl.dump_eager_rx_buffers()
-    assert accl.cclo.read(0x1FF4) == 1  # still configured (CFGRDY intact)
+    from accl_tpu.device.base import CCLOAddr
+
+    assert accl.cclo.read(CCLOAddr.CFGRDY) == 1  # still configured
 
     # the device remains fully usable after the reset
     rb = accl.create_buffer(16)

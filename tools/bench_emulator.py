@@ -69,12 +69,27 @@ def main():
     outdir.mkdir(exist_ok=True)
     csv = outdir / ("emu_bench.csv" if args.transport == "tcp"
                     else "emu_bench_udp.csv")
+    # merge by world: a run at one world size refreshes only its own rows,
+    # so the committed artifact can accumulate a multi-world sweep
+    kept = []
+    if csv.exists():
+        with open(csv) as f:
+            header = f.readline()
+            # only merge rows from the current 6-column format; an older
+            # CSV (pre-World-column) is regenerated from scratch, else its
+            # 5-field rows would survive every world filter and corrupt
+            # the file
+            if header.strip() == "Collective,Protocol,Bytes,Seconds,GBps,World":
+                kept = [ln for ln in f
+                        if ln.strip() and ln.rsplit(",", 1)[1].strip()
+                        != str(args.world)]
     with open(csv, "w") as f:
         f.write("Collective,Protocol,Bytes,Seconds,GBps,World\n")
+        f.writelines(kept)
         for r in rows:
             f.write(f"{r[0]},{r[1]},{r[2]},{r[3]:.6e},{r[4]:.3f},"
                     f"{args.world}\n")
-    print(f"wrote {csv} ({len(rows)} rows)")
+    print(f"wrote {csv} ({len(rows)} new rows, {len(kept)} kept)")
 
 
 if __name__ == "__main__":
