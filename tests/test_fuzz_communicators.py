@@ -1,0 +1,188 @@
+"""Cross-executor fuzz over the COMMUNICATOR dimension.
+
+test_cross_executor_fuzz.py samples full-world configurations; this file
+fuzzes random sub-groups of random worlds through both executors — the
+facade path (split() + comm=) on the XLA executor and write_communicator
++ comm_addr on the native runtime — against a numpy oracle restricted to
+member rows. Communicator-relative roots, non-member no-op semantics and
+count-scales-with-group-size shapes are all part of the contract under
+test (reference: firmware caches the communicator per call,
+ccl_offload_control.c:2317-2372; multi-communicator gtest suites).
+Seeded, so failures reproduce.
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import jax
+from accl_tpu import ReduceFunction
+from accl_tpu.accl import ACCL
+from accl_tpu.communicator import Communicator, Rank
+from accl_tpu.device.emu_device import EmuWorld
+
+SEED = 7707
+N_CONFIGS = 10
+
+# per-op shape rules: (send buffer slots, recv buffer slots) in units of
+# the per-slot count c, with g = group size
+SHAPES = {
+    "allreduce": (1, 1),
+    "bcast": (1, 0),
+    "reduce": (1, 1),
+    "allgather": (1, None),   # None = g slots
+    "gather": (1, None),
+    "scatter": (None, 1),
+    "reduce_scatter": (None, 1),
+    "alltoall": (None, None),
+}
+OPS = list(SHAPES)
+
+
+def _sample():
+    rng = np.random.default_rng(SEED)
+    cfgs = []
+    for i in range(N_CONFIGS):
+        world = int(rng.integers(3, 9))
+        gsize = int(rng.integers(2, world + 1))
+        members = sorted(
+            rng.choice(world, size=gsize, replace=False).tolist())
+        op = OPS[int(rng.integers(len(OPS)))]
+        count = int(rng.integers(1, 200))
+        func = ReduceFunction(int(rng.integers(2)))
+        root = int(rng.integers(gsize))  # communicator-relative
+        cfgs.append((i, op, world, tuple(members), count, func, root))
+    # pinned: the count-scaling ops at a non-trivial subgroup
+    cfgs.append((N_CONFIGS, "alltoall", 6, (0, 2, 5), 64,
+                 ReduceFunction.SUM, 0))
+    cfgs.append((N_CONFIGS + 1, "reduce_scatter", 5, (1, 2, 4), 50,
+                 ReduceFunction.MAX, 0))
+    return cfgs
+
+
+def _oracle(op, x_members, func, g, root, count):
+    """Expected member-row results (g, slots*count) from the member rows
+    of the input."""
+    if op == "bcast":
+        return np.tile(x_members[root], (g, 1))
+    if op == "scatter":
+        return np.stack([x_members[root, r * count:(r + 1) * count]
+                         for r in range(g)])
+    if op == "gather":
+        return x_members.reshape(1, -1)  # root row only
+    if op == "allgather":
+        return np.tile(x_members.reshape(-1), (g, 1))
+    red = (x_members.sum(0) if func == ReduceFunction.SUM
+           else x_members.max(0))
+    if op == "reduce":
+        return red.reshape(1, -1)  # root row only
+    if op == "allreduce":
+        return np.tile(red, (g, 1))
+    if op == "reduce_scatter":
+        return red.reshape(g, count)
+    if op == "alltoall":
+        return x_members.reshape(g, g, count).transpose(1, 0, 2) \
+            .reshape(g, -1)
+    raise AssertionError(op)
+
+
+def _slots(spec, g):
+    return g if spec is None else spec
+
+
+@pytest.mark.parametrize(
+    "cfg", _sample(),
+    ids=lambda c: f"{c[0]}-{c[1]}-w{c[2]}-g{len(c[3])}-n{c[4]}")
+def test_communicator_fuzz(cfg):
+    i, op, world, members, count, func, root = cfg
+    g = len(members)
+    send_slots = _slots(SHAPES[op][0], g)
+    recv_slots = _slots(SHAPES[op][1], g)
+    rng = np.random.default_rng(SEED + i)
+    x = rng.standard_normal((world, send_slots * count)).astype(np.float32)
+    xm = x[list(members)]
+    expected = _oracle(op, xm, func, g, root, count)
+    tol = dict(rtol=1e-4, atol=1e-4)
+
+    # ---- XLA executor through the production facade path --------------
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    sub = accl.split(list(members))
+    sb = accl.create_buffer(send_slots * count, data=x)
+    rb = (accl.create_buffer(recv_slots * count) if recv_slots else None)
+    kw = dict(comm=sub)
+    if op == "bcast":
+        accl.bcast(sb, count, root=root, **kw)
+        out_rows = sb.host
+    else:
+        args = {
+            "allreduce": lambda: accl.allreduce(sb, rb, count, func, **kw),
+            "reduce": lambda: accl.reduce(sb, rb, count, root, func, **kw),
+            "reduce_scatter": lambda: accl.reduce_scatter(
+                sb, rb, count, func, **kw),
+            "allgather": lambda: accl.allgather(sb, rb, count, **kw),
+            "gather": lambda: accl.gather(sb, rb, count, root, **kw),
+            "scatter": lambda: accl.scatter(sb, rb, count, root, **kw),
+            "alltoall": lambda: accl.alltoall(sb, rb, count, **kw),
+        }
+        args[op]()
+        out_rows = rb.host
+    if op in ("gather", "reduce"):
+        xla_out = out_rows[members[root]].reshape(1, -1)
+    else:
+        xla_out = out_rows[list(members)]
+        if op == "bcast":
+            # non-member rows must be untouched
+            nonmembers = [r for r in range(world) if r not in members]
+            if nonmembers:
+                np.testing.assert_allclose(
+                    out_rows[nonmembers], x[nonmembers], rtol=0,
+                    err_msg=f"XLA bcast touched non-members, cfg {cfg}")
+    np.testing.assert_allclose(xla_out, expected, **tol,
+                               err_msg=f"XLA {op} cfg {cfg}")
+
+    # ---- native executor ---------------------------------------------
+    comm_addr = 0x600
+    comm = Communicator([Rank(device_index=m) for m in members], 0,
+                        comm_addr)
+    w = EmuWorld(world)
+    try:
+        def body(rank, r):
+            if r not in members:
+                return None  # non-member no-op (MPI split semantics)
+            rank.write_communicator(comm)
+            me = members.index(r)
+            send = x[r].copy()
+            out = np.zeros(max(recv_slots, 1) * count, np.float32)
+            if op == "bcast":
+                rank.bcast(send, count, root=root, comm_addr=comm_addr)
+                return send[:count]
+            call = {
+                "allreduce": lambda: rank.allreduce(
+                    send, out, count, func, comm_addr=comm_addr),
+                "reduce": lambda: rank.reduce(
+                    send, out, count, root=root, func=func,
+                    comm_addr=comm_addr),
+                "reduce_scatter": lambda: rank.reduce_scatter(
+                    send, out, count, func, comm_addr=comm_addr),
+                "allgather": lambda: rank.allgather(
+                    send, out, count, comm_addr=comm_addr),
+                "gather": lambda: rank.gather(
+                    send, out, count, root=root, comm_addr=comm_addr),
+                "scatter": lambda: rank.scatter(
+                    send, out, count, root=root, comm_addr=comm_addr),
+                "alltoall": lambda: rank.alltoall(
+                    send, out, count, comm_addr=comm_addr),
+            }
+            call[op]()
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    if op in ("gather", "reduce"):
+        native_out = np.asarray(res[members[root]]).reshape(1, -1)
+    else:
+        native_out = np.stack([res[m] for m in members])
+    np.testing.assert_allclose(native_out, expected, **tol,
+                               err_msg=f"native {op} cfg {cfg}")
