@@ -112,12 +112,8 @@ class ACCL:
         # (split) are laid out from here
         self._exchmem_alloc = addr
         # tuning registers (configure_tuning_parameters, accl.cpp:1198-1208)
-        tuning = TuningParams.default(cfg["max_rendezvous_size"])
-        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN, tuning.gather_flat_tree_max_fanin)
-        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT, tuning.gather_flat_tree_max_count)
-        dev.write(CCLOAddr.BCAST_FLAT_TREE_MAX_RANKS, tuning.bcast_flat_tree_max_ranks)
-        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_RANKS, tuning.reduce_flat_tree_max_ranks)
-        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT, tuning.reduce_flat_tree_max_count)
+        self.configure_tuning_parameters(
+            TuningParams.default(cfg["max_rendezvous_size"]))
         # thresholds via config calls (accl.cpp:1096-1109)
         self._config_call(CfgFunc.set_max_eager_msg_size, cfg["max_eager_size"])
         self._config_call(CfgFunc.set_max_rendezvous_msg_size, cfg["max_rendezvous_size"])
@@ -547,6 +543,48 @@ class ACCL:
         parked recv/send queues (the rx-notification parking that plays
         the ring's role there)."""
         return self.cclo.dump_eager_rx_buffers()
+
+    def configure_tuning_parameters(self, tuning: TuningParams):
+        """Write the five algorithm-tuning registers to exchange memory
+        (reference configure_tuning_parameters, accl.cpp:1198-1208); both
+        executors read them per call."""
+        dev = self.cclo
+        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_FANIN,
+                  tuning.gather_flat_tree_max_fanin)
+        dev.write(CCLOAddr.GATHER_FLAT_TREE_MAX_COUNT,
+                  tuning.gather_flat_tree_max_count)
+        dev.write(CCLOAddr.BCAST_FLAT_TREE_MAX_RANKS,
+                  tuning.bcast_flat_tree_max_ranks)
+        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_RANKS,
+                  tuning.reduce_flat_tree_max_ranks)
+        dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT,
+                  tuning.reduce_flat_tree_max_count)
+
+    def autotune(self, link=None, timing_model_path=None) -> TuningParams:
+        """Derive the four switch-point tuning registers from the
+        calibrated timing model and apply them (gather fan-in keeps its
+        structural default): the measured-performance closure of the
+        reference's hand-picked defaults. `link` is a
+        sequencer.timing.LinkParams; absent, it is loaded from
+        `timing_model_path` (default accl_log/timing_model.json, written
+        by tools/timing_model.py). Returns the applied TuningParams."""
+        from .sequencer.timing import LinkParams, tuning_crossovers
+
+        if link is None:
+            import json
+            import pathlib
+
+            path = pathlib.Path(
+                timing_model_path
+                or pathlib.Path(__file__).parent.parent
+                / "accl_log" / "timing_model.json")
+            model = json.loads(path.read_text())
+            link = LinkParams(alpha=model["link"]["alpha_us"] * 1e-6,
+                              beta=model["link"]["beta_gbps"] * 1e9)
+        cross = tuning_crossovers(link, world=self.world)
+        tuning = TuningParams.from_crossovers(cross)
+        self.configure_tuning_parameters(tuning)
+        return tuning
 
     def soft_reset(self):
         """reset_periph config call (reference soft_reset, accl.cpp:57-69):

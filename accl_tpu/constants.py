@@ -303,3 +303,29 @@ class TuningParams:
                 max_rndzv_msg_size // reduce_flat_ranks, 32 * 1024
             ),
         )
+
+    @classmethod
+    def from_crossovers(cls, cross: dict,
+                        max_count_cap: int = 1 << 22) -> "TuningParams":
+        """Register values from the timing model's switch-over points
+        (sequencer.timing.tuning_crossovers / the committed
+        accl_log/timing_model.json): the measured-performance form of the
+        reference's hand-picked defaults (accl.cpp:1198-1208). Byte
+        thresholds are clamped to [1, max_count_cap] — an infinite
+        crossover (flat never loses on this link) caps rather than
+        overflowing the 32-bit register."""
+        def as_reg(v):
+            if v != v or v == float("inf"):  # NaN/inf -> cap
+                return max_count_cap
+            return max(1, min(int(v), max_count_cap))
+
+        return cls(
+            gather_flat_tree_max_count=as_reg(
+                cross["gather_flat_tree_max_count_bytes"]),
+            bcast_flat_tree_max_ranks=max(
+                1, int(cross["bcast_flat_tree_max_ranks"])),
+            reduce_flat_tree_max_ranks=max(
+                1, int(cross["reduce_flat_tree_max_ranks"])),
+            reduce_flat_tree_max_count=as_reg(
+                cross["reduce_flat_tree_max_count_bytes"]),
+        )

@@ -149,3 +149,47 @@ def test_tuning_crossovers_match_reference_defaults():
     # thresholds — consistent with a 100 Gbps low-latency NIC
     ref = tuning_crossovers(LinkParams(alpha=5e-6, beta=12.5e9), world=8)
     assert 1024 < ref["reduce_flat_tree_max_count_bytes"] < 10 * 1024 * 1024
+
+
+def test_from_crossovers_register_mapping():
+    """Crossover dict -> register values: byte thresholds round to ints
+    within the cap; inf (flat never loses) caps instead of overflowing."""
+    from accl_tpu import TuningParams
+
+    cross = tuning_crossovers(LinkParams(alpha=5e-6, beta=12.5e9), world=8)
+    t = TuningParams.from_crossovers(cross)
+    assert t.bcast_flat_tree_max_ranks == 3
+    assert t.reduce_flat_tree_max_count == int(
+        cross["reduce_flat_tree_max_count_bytes"])
+    inf_cross = dict(cross, reduce_flat_tree_max_count_bytes=float("inf"))
+    assert TuningParams.from_crossovers(
+        inf_cross).reduce_flat_tree_max_count == 1 << 22
+
+
+def test_facade_autotune_applies_model(mesh8):
+    """ACCL.autotune closes the loop model -> registers -> selection:
+    the registers land in exchange memory (device.tuning() readback) and
+    algorithm selection actually flips at the tuned byte threshold."""
+    from accl_tpu import Operation
+    from accl_tpu.accl import ACCL
+    from accl_tpu.sequencer import Algorithm, select_algorithm
+
+    accl = ACCL(mesh8)
+    link = LinkParams(alpha=50e-6, beta=1e9)
+    applied = accl.autotune(link=link)
+    live = accl.cclo.tuning()
+    assert live.reduce_flat_tree_max_count == applied.reduce_flat_tree_max_count
+    assert live.bcast_flat_tree_max_ranks == applied.bcast_flat_tree_max_ranks
+
+    # selection flips exactly at the applied threshold (rendezvous
+    # regime, where the flat/binomial switch lives)
+    thr = applied.reduce_flat_tree_max_count
+    world = 8
+    below = select_algorithm(Operation.reduce, thr // 4, 4, world,
+                             max_eager_size=0, eager_rx_buf_size=1024,
+                             tuning=live)
+    above = select_algorithm(Operation.reduce, thr, 4, world,
+                             max_eager_size=0, eager_rx_buf_size=1024,
+                             tuning=live)
+    assert below.algorithm == Algorithm.RNDZV_FLAT_TREE
+    assert above.algorithm == Algorithm.RNDZV_BIN_TREE
