@@ -10,9 +10,11 @@ one compiled training step.
 
 from .transformer import (  # noqa: F401
     TransformerConfig,
+    init_kv_cache,
     init_params,
-    make_train_step,
+    make_decode_step,
     make_forward,
+    make_train_step,
 )
 from .moe import (  # noqa: F401
     MoEConfig,

@@ -175,6 +175,15 @@ def _grad_allreduce(g, axis, wire):
     return out.reshape(shape) / world  # mean over replicas
 
 
+def _mlp_half(x, lyr, wire):
+    """ln2 + gelu MLP + tp partial-sum residual — shared by the training
+    block and the decode block so the two cannot silently diverge."""
+    h = _rmsnorm(x, lyr["ln2"])
+    up = jax.nn.gelu(jnp.einsum("btd,df->btf", h, lyr["w_up"]))
+    down_partial = jnp.einsum("btf,fd->btd", up, lyr["w_down"])
+    return x + _tp_allreduce(down_partial, wire)
+
+
 def _block(x, lyr, wire):
     """One transformer block (ring attention over sp, tp partial-sum
     reductions through the framework ring)."""
@@ -185,11 +194,7 @@ def _block(x, lyr, wire):
     o_partial = jnp.einsum("bthk,hkd->btd", attn, lyr["wo"])
     # heads are sharded over tp: partial sums reduce on-device-ring
     x = x + _tp_allreduce(o_partial, wire)
-    h = _rmsnorm(x, lyr["ln2"])
-    up = jnp.einsum("btd,df->btf", h, lyr["w_up"])
-    up = jax.nn.gelu(up)
-    down_partial = jnp.einsum("btf,fd->btd", up, lyr["w_down"])
-    return x + _tp_allreduce(down_partial, wire)
+    return _mlp_half(x, lyr, wire)
 
 
 def _block_fn(wire, remat: bool):
@@ -274,6 +279,83 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh,
             check_vma=False,
         )
     )
+
+
+# KV-cache layout: (batch over dp, seq, heads over tp, head_dim) — ONE
+# constant shared by allocation and the decode step's shard_map specs
+_KV_SPEC = P("dp", None, "tp", None)
+
+
+def init_kv_cache(cfg: TransformerConfig, mesh: Mesh, batch: int,
+                  max_len: int):
+    """Per-layer KV cache for incremental decode, sharded batch over dp
+    and heads over tp (the sequence dim is NOT sharded: decode emits one
+    token at a time, so sp must be 1 on the decode mesh)."""
+    dt = jnp.dtype(cfg.dtype)
+    sh = NamedSharding(mesh, _KV_SPEC)
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    return [
+        {"k": jax.device_put(jnp.zeros(shape, dt), sh),
+         "v": jax.device_put(jnp.zeros(shape, dt), sh)}
+        for _ in range(cfg.n_layers)
+    ]
+
+
+def _decode_block(x, lyr, ck, cv, pos, wire):
+    """One block for a single new token position: append this position's
+    k/v to the cache and attend over cache[:pos+1] (masked full-length
+    dot — static shapes, so one compiled program serves every step)."""
+    h = _rmsnorm(x, lyr["ln1"])
+    qkv = jnp.einsum("btd,dchk->btchk", h, lyr["wqkv"])
+    q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ck = lax.dynamic_update_slice_in_dim(ck, k_new, pos, axis=1)
+    cv = lax.dynamic_update_slice_in_dim(cv, v_new, pos, axis=1)
+    # (B, 1, H, hd) x (B, T, H, hd) -> (B, H, T); mask j > pos
+    scores = jnp.einsum("bqhk,bthk->bht", q, ck) / np.sqrt(q.shape[-1])
+    mask = jnp.arange(ck.shape[1])[None, None, :] > pos
+    scores = jnp.where(mask, -jnp.inf, scores.astype(jnp.float32))
+    attn = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    ctx = jnp.einsum("bht,bthk->bhk", attn, cv)[:, None]  # (B, 1, H, hd)
+    o_partial = jnp.einsum("bthk,hkd->btd", ctx, lyr["wo"])
+    x = x + _tp_allreduce(o_partial, wire)
+    return _mlp_half(x, lyr, wire), ck, cv
+
+
+def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
+    """One compiled incremental-decode step (the inference half of the
+    model family): (params, cache, tokens (B, 1), pos) ->
+    (logits (B, 1, V), cache). Batch over dp, heads + ffn over tp —
+    the same tp partial-sum reductions as training, through the
+    framework's ring schedule. sp/pp must be 1 on the decode mesh
+    (decode is one position; pipeline decode would bubble every step).
+    The cache threads through functionally — donate it at the call site
+    for in-place updates."""
+    for ax in ("sp", "pp"):
+        if dict(mesh.shape).get(ax, 1) != 1:
+            raise ValueError(f"decode mesh must have {ax}=1")
+    wire = schedules.Wire(None)
+    pspecs = param_specs(cfg)
+    cache_spec = [{"k": _KV_SPEC, "v": _KV_SPEC}] * cfg.n_layers
+
+    def body(params, cache, tokens, pos):
+        x = params["embed"][tokens[:, :1]]
+        p = pos[0]  # replicated scalar arrives as a (1,) shard
+        new_cache = []
+        for lyr, c in zip(params["layers"], cache):
+            x, ck, cv = _decode_block(x, lyr, c["k"], c["v"], p, wire)
+            new_cache.append({"k": ck, "v": cv})
+        x = _rmsnorm(x, jnp.ones((cfg.d_model,), x.dtype))
+        logits = jnp.einsum("btd,dv->btv", x, params["unembed"])
+        return logits, new_cache
+
+    step = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspecs, cache_spec, P("dp", None), P()),
+        out_specs=(P("dp", None), cache_spec),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(1,))
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3,

@@ -44,7 +44,14 @@ def main():
     if args.model == "dense" and args.top_k != 1:
         raise SystemExit("--top-k applies to --model moe only")
 
+    # a wedged TPU tunnel hangs jax.devices() forever — probe it in a
+    # subprocess (the shared watchdog) and force CPU when unreachable
+    from __graft_entry__ import _tpu_reachable
+
     import jax
+
+    if not _tpu_reachable(timeout_s=150):
+        jax.config.update("jax_platforms", "cpu")
 
     # must run before any backend query (device count locks at init);
     # only affects the cpu backend, harmless under a real TPU
