@@ -49,12 +49,14 @@ def _pad_rows(x, rows):
     return x
 
 
-def _as_tiles(x):
-    """Reshape a flat buffer to (rows, 128) lanes, padding the tail."""
+def _as_tiles(x, lanes: int = _LANES):
+    """Reshape a flat buffer to (rows, lanes), padding the tail. lanes
+    must be a multiple of 128 (the VREG minor dim); wider rows give the
+    streaming kernels larger contiguous DMA bursts per grid step."""
     n = x.shape[-1]
-    rows = -(-n // _LANES)
-    flat = jnp.pad(x, (0, rows * _LANES - n))
-    return flat.reshape(rows, _LANES), n
+    rows = -(-n // lanes)
+    flat = jnp.pad(x, (0, rows * lanes - n))
+    return flat.reshape(rows, lanes), n
 
 
 def _from_tiles(t, n):
@@ -73,25 +75,27 @@ def _combine_kernel(op, a_ref, b_ref, o_ref):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("op", "interpret", "block_rows"))
+                   static_argnames=("op", "interpret", "block_rows",
+                                    "lanes"))
 def combine_pallas(a, b, op: str = "sum", interpret: bool | None = None,
-                   block_rows: int | None = None):
+                   block_rows: int | None = None, lanes: int | None = None):
     """Elementwise SUM/MAX over two flat buffers via Pallas (reduce_ops
     stream_add/stream_max analog, reduce_ops.cpp:31-73). float16 lanes
-    route through XLA on real TPU (see _mosaic_rejects). block_rows sets
-    the per-grid-step VMEM tile height (default _BLOCK_ROWS; the bench
-    sweeps it on-chip to pick the streaming-regime optimum)."""
+    route through XLA on real TPU (see _mosaic_rejects). block_rows /
+    lanes set the per-grid-step VMEM tile (default _BLOCK_ROWS x _LANES;
+    the bench sweeps both on-chip to pick the streaming-regime optimum)."""
     if interpret is None:
         interpret = not _on_tpu()
     if not interpret and _mosaic_rejects(a.dtype, b.dtype):
         return jnp.add(a, b) if op == "sum" else jnp.maximum(a, b)
     block_rows = block_rows or _BLOCK_ROWS
-    at, n = _as_tiles(a)
-    bt, _ = _as_tiles(b)
+    lanes = lanes or _LANES
+    at, n = _as_tiles(a, lanes)
+    bt, _ = _as_tiles(b, lanes)
     at = _pad_rows(at, block_rows)
     bt = _pad_rows(bt, block_rows)
     grid = (at.shape[0] // block_rows,)
-    spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    spec = pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
     out = pl.pallas_call(
         functools.partial(_combine_kernel, op),
         out_shape=jax.ShapeDtypeStruct(at.shape, at.dtype),

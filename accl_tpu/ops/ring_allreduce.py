@@ -32,6 +32,13 @@ from jax.experimental.pallas import tpu as pltpu
 from ..constants import ReduceFunction
 
 
+def _sublane(dtype) -> int:
+    """Rows of the dtype's VMEM tile (fp32 (8,128), bf16 (16,128), int8
+    (32,128)). Dynamic row offsets into a VMEM ref must be provably
+    tile-aligned, so per-rank chunks are padded to whole tiles."""
+    return max(8, 32 // jnp.dtype(dtype).itemsize)
+
+
 def _kernel(axis_name, world, chunk, func, x_ref, o_ref, v_ref, comm_ref,
             send_sem, recv_sem, credit_sem):
     me = lax.axis_index(axis_name)
@@ -140,8 +147,9 @@ def ring_allreduce_pallas(
             ring_allreduce_pallas, axis_name=axis_name, world=world,
             func=func, interpret=interpret, detect_races=detect_races)
     n = x.shape[-1]
+    tile = _sublane(x.dtype) * 128
     chunk = -(-n // world)
-    chunk = -(-chunk // 128) * 128  # lane alignment
+    chunk = -(-chunk // tile) * tile  # whole-tile chunks (lane + sublane)
     padded = world * chunk
     if padded != n:
         x = jnp.pad(x, (0, padded - n))
@@ -284,9 +292,10 @@ def ring_allreduce_pallas_bidir(
             ring_allreduce_pallas_bidir, axis_name=axis_name, world=world,
             func=func, interpret=interpret, detect_races=detect_races)
     n = x.shape[-1]
-    # pad so n splits into 2 * world lane-aligned chunks
+    # pad so n splits into 2 * world whole-tile chunks
+    tile = _sublane(x.dtype) * 128
     chunk = -(-n // (2 * world))
-    chunk = -(-chunk // 128) * 128
+    chunk = -(-chunk // tile) * tile
     padded = 2 * world * chunk
     if padded != n:
         x = jnp.pad(x, (0, padded - n))

@@ -46,19 +46,19 @@ def main():
 
     # a wedged TPU tunnel hangs jax.devices() forever — probe it in a
     # subprocess (the shared watchdog) and force CPU when unreachable
-    from __graft_entry__ import _tpu_reachable
+    from __graft_entry__ import _force_cpu, _tpu_reachable
 
     import jax
 
     if not _tpu_reachable(timeout_s=150):
-        jax.config.update("jax_platforms", "cpu")
-
-    # must run before any backend query (device count locks at init);
-    # only affects the cpu backend, harmless under a real TPU
-    try:
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
-    except Exception:
-        pass
+        _force_cpu(args.cpu_devices)
+    else:
+        # device count locks at backend init; only affects the cpu
+        # backend, harmless under a real TPU
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except Exception:
+            pass
 
     import numpy as np
 

@@ -66,7 +66,10 @@ def section_tpu(out: list[str]) -> None:
                    f"{float(r['GBps']):.2f} | {r.get('Regime', '')} |")
     out.append("")
     out.append("`latency` rows measure dispatch/VMEM-resident time, not "
-               "bandwidth; only `stream` rows are HBM throughput.\n")
+               "bandwidth; only `stream` rows are HBM throughput; `noise` "
+               "rows never resolved above relay jitter — their Seconds is "
+               "the jitter resolution floor (an upper bound on the true "
+               "time, so GB/s is a lower bound), not a measurement.\n")
 
     cpu = _read_csv("profile_cpu.csv")
     if cpu:
