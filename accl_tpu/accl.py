@@ -75,6 +75,10 @@ class ACCL:
         self.communicators: list[Communicator] = []
         self._initialized = False
         self._last_request: BaseRequest | None = None
+        # placeholder rank buffers backing the buffer-less stream forms
+        # (reference send/recv/copy overloads that take only a dataType,
+        # accl.hpp:190,278,349): one per (count, dtype), reused
+        self._stream_scratch: dict = {}
         self.initialize()
 
     # ------------------------------------------------------------------ #
@@ -306,6 +310,54 @@ class ACCL:
         return self._execute(opts, [srcbuf], [dstbuf], from_device, to_device,
                              run_async)
 
+    def _scratch(self, count, dtype):
+        """Internal placeholder buffer for a buffer-less stream endpoint
+        (the dataType-only overloads of the reference driver)."""
+        if isinstance(dtype, DataType):
+            dtype = to_numpy_dtype(dtype)
+        key = (int(count), str(np.dtype(dtype)))
+        buf = self._stream_scratch.get(key)
+        if buf is None:
+            buf = self.create_buffer(count, dtype)
+            self._stream_scratch[key] = buf
+        return buf
+
+    def copy_from_stream(self, dstbuf, count, *, op0_stream, to_device=False,
+                         run_async=False):
+        """Operand arrives from a registered producer stream, result lands
+        in dstbuf (reference copy_from_stream, accl.hpp:317)."""
+        opts = self._prepare(Operation.copy, dstbuf, None, dstbuf, count)
+        self._stream_opts(opts, op0_stream, None)
+        return self._execute(opts, [dstbuf], [dstbuf], True, to_device,
+                             run_async)
+
+    def copy_to_stream(self, srcbuf, count, *, res_stream, dstbuf=None,
+                       from_device=False, run_async=False):
+        """srcbuf routes through a registered consumer stream (reference
+        copy_to_stream, accl.hpp:334). The consumer's return value
+        materializes into dstbuf when given (the observable form; the
+        reference's PL-kernel sink has no host-visible landing spot),
+        else into an internal placeholder."""
+        dst = dstbuf if dstbuf is not None else self._scratch(count, srcbuf.np_dtype)
+        opts = self._prepare(Operation.copy, srcbuf, None, dst, count)
+        self._stream_opts(opts, None, res_stream)
+        # to_device=True (skip the device->host result sync) only for the
+        # unobserved internal placeholder
+        return self._execute(opts, [srcbuf], [dst], from_device,
+                             dstbuf is None, run_async)
+
+    def copy_from_to_stream(self, data_type, count, *, op0_stream, res_stream,
+                            dstbuf=None, run_async=False):
+        """Producer stream -> consumer stream, no host buffers (reference
+        copy_from_to_stream, accl.hpp:349); dstbuf optionally captures the
+        consumer output."""
+        scratch = self._scratch(count, data_type)
+        dst = dstbuf if dstbuf is not None else scratch
+        opts = self._prepare(Operation.copy, scratch, None, dst, count)
+        self._stream_opts(opts, op0_stream, res_stream)
+        return self._execute(opts, [scratch], [dst], True,
+                             dstbuf is None, run_async)
+
     def combine(self, count, function, op0, op1, res, *, from_device=False,
                 to_device=False, run_async=False):
         opts = self._prepare(Operation.combine, op0, op1, res, count,
@@ -314,17 +366,37 @@ class ACCL:
                              run_async)
 
     def send(self, srcbuf, count, src, dst, tag=TAG_ANY, *, from_device=False,
-             run_async=False, compress_dtype=None, comm=None):
+             run_async=False, compress_dtype=None, comm=None,
+             op0_stream=None):
+        """srcbuf may be a DataType when op0_stream is set (the reference's
+        stream-send overload, accl.hpp:190: the payload comes from the
+        producer kernel, not a buffer)."""
+        if isinstance(srcbuf, DataType):
+            if op0_stream is None:
+                raise ValueError("dataType-only send requires op0_stream")
+            srcbuf = self._scratch(count, srcbuf)
+            from_device = True
         opts = self._prepare(Operation.send, srcbuf, None, None, count,
                              root_src_dst=src | (dst << 16), tag=tag,
                              compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, op0_stream, None)
         return self._execute(opts, [srcbuf], [], from_device, True, run_async)
 
     def recv(self, dstbuf, count, src, dst, tag=TAG_ANY, *, to_device=False,
-             run_async=False, compress_dtype=None, comm=None):
+             run_async=False, compress_dtype=None, comm=None,
+             res_stream=None):
+        """dstbuf may be a DataType when res_stream is set (the reference's
+        stream-recv overload, accl.hpp:278: the payload feeds the consumer
+        kernel; pass a real buffer to also capture the consumer output)."""
+        if isinstance(dstbuf, DataType):
+            if res_stream is None:
+                raise ValueError("dataType-only recv requires res_stream")
+            dstbuf = self._scratch(count, dstbuf)
+            to_device = True  # nothing observes the placeholder: skip sync
         opts = self._prepare(Operation.recv, None, None, dstbuf, count,
                              root_src_dst=src | (dst << 16), tag=tag,
                              compress_dtype=compress_dtype, comm=comm)
+        self._stream_opts(opts, None, res_stream)
         return self._execute(opts, [], [dstbuf], True, to_device, run_async)
 
     def _stream_opts(self, opts, op0_stream, res_stream):

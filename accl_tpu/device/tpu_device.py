@@ -259,11 +259,11 @@ class TPUDevice(CCLODevice):
             eager_rx_buf_size=self.eager_rx_buf_size,
             tuning=self.tuning(),
         )
-        if options.stream_flags and options.scenario not in (
-            Operation.send, Operation.recv,
-        ):
-            # streamed collective: stream ids ride dedicated descriptor
-            # bytes (word 8), so the tag stays available for matching
+        if options.stream_flags:
+            # streamed call: stream ids ride dedicated descriptor bytes
+            # (word 8), so the tag stays available for matching. send/recv
+            # arrive here already PAIRED (start() routes the raw halves
+            # through the parking maps; _pair merged their endpoint ids)
             from ..constants import StreamFlags
 
             producer = consumer = None
@@ -383,6 +383,20 @@ class TPUDevice(CCLODevice):
     def _pair(self, recv_opts: CallOptions, send_opts: CallOptions) -> CallOptions:
         src = recv_opts.root_src_dst & 0xFFFF
         dst = (recv_opts.root_src_dst >> 16) & 0xFFFF
+        # stream endpoints merge from the side that owns them: the send
+        # contributes OP0 (its operand may come from a producer kernel,
+        # reference accl.hpp:190 stream-send overload), the recv RES (its
+        # result may feed a consumer kernel, accl.hpp:278)
+        from ..constants import StreamFlags
+
+        flags = StreamFlags.NO_STREAM
+        op0_id = res_id = 0
+        if send_opts.stream_flags & StreamFlags.OP0_STREAM:
+            flags |= StreamFlags.OP0_STREAM
+            op0_id = send_opts.op0_stream_id
+        if recv_opts.stream_flags & StreamFlags.RES_STREAM:
+            flags |= StreamFlags.RES_STREAM
+            res_id = recv_opts.res_stream_id
         return CallOptions(
             scenario=Operation.send,
             count=recv_opts.count,
@@ -390,7 +404,9 @@ class TPUDevice(CCLODevice):
             root_src_dst=src | (dst << 16),
             tag=send_opts.tag,
             compression_flags=recv_opts.compression_flags,
-            stream_flags=recv_opts.stream_flags,
+            stream_flags=flags,
+            op0_stream_id=op0_id,
+            res_stream_id=res_id,
             data_type=recv_opts.data_type,
             addr_0=send_opts.addr_0,
             addr_2=recv_opts.addr_2,

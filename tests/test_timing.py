@@ -193,3 +193,40 @@ def test_facade_autotune_applies_model(mesh8):
                              tuning=live)
     assert below.algorithm == Algorithm.RNDZV_FLAT_TREE
     assert above.algorithm == Algorithm.RNDZV_BIN_TREE
+
+
+def test_tpu_tier_from_profile(tmp_path):
+    """The second calibration tier reads the on-chip profile artifact:
+    dispatch alpha from the w1 lanes, HBM beta from stream rows, noise
+    rows excluded (they are resolution floors, not measurements)."""
+    import pathlib
+    import sys
+
+    tools_dir = str(pathlib.Path(__file__).resolve().parents[1] / "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from timing_model import tpu_tier
+
+    csv_path = tmp_path / "profile.csv"
+    csv_path.write_text(
+        "Test,Bytes,Seconds,GBps,Regime\n"
+        "combine_sum_fp32,1024,1.0e-09,1024.0,noise\n"
+        "combine_sum_fp32,1073741824,3.6e-03,298.3,stream\n"
+        "allreduce_w1_dispatch_datapath_fp32,4096,2.0e-04,0.02,latency\n"
+        "allreduce_w1_dispatch_datapath_fp32,262144,2.1e-04,1.2,latency\n"
+        "allreduce_w1_dispatch_datapath_fp32,16777216,2.5e-04,67.0,latency\n"
+    )
+    tier = tpu_tier(csv_path)
+    assert tier is not None
+    # dispatch alpha ~200us (the constant part of the w1 fit)
+    assert 100 <= tier["dispatch_alpha_us"] <= 300
+    assert tier["hbm_stream_gbps"] == pytest.approx(298.3)
+    assert tier["ici_beta_gbps"] is None
+    # projected crossovers exist and are self-consistent with the huge
+    # dispatch alpha: flat trees stay preferable to far larger payloads
+    # than on the emulator link
+    proj = tier["projected_crossovers"]
+    assert proj["reduce_flat_tree_max_count_bytes"] > 1 << 20
+
+    # absent profile -> no tier, never a crash
+    assert tpu_tier(tmp_path / "missing.csv") is None

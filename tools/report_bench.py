@@ -150,6 +150,17 @@ def section_timing(out: list[str]) -> None:
             v_s = _fmt_bytes(int(v)) if "bytes" in k else v
             out.append(f"- {k}: {v_s}")
         out.append("")
+    tpu = tm.get("tpu_tier")
+    if tpu:
+        beta = tpu.get("dispatch_beta_gbps")
+        hbm = tpu.get("hbm_stream_gbps")
+        out.append(
+            f"**TPU tier** (from `{tpu.get('source', '?')}`): dispatch "
+            f"alpha {tpu.get('dispatch_alpha_us', float('nan')):.0f} us"
+            + (f", datapath beta {beta:.1f} GB/s" if beta
+               else " (dispatch-bound: datapath beta unresolved)")
+            + (f", HBM stream {hbm:.0f} GB/s" if hbm else "")
+            + "; ICI beta unmeasured (single-chip tunnel).\n")
 
 
 def main() -> int:
