@@ -424,8 +424,22 @@ def main():
                         and r[1] >= 256 * 1024 * 1024]
     p50 = float(np.median([r[3] for r in combine_rows]))
     on_tpu_run = any(r[0].endswith("_pallas") for r in rows)
-    note = (" [CPU FALLBACK: TPU unreachable]"
-            if os.environ.get("ACCL_BENCH_CPU_FALLBACK") == "1" else "")
+    note = ""
+    if os.environ.get("ACCL_BENCH_CPU_FALLBACK") == "1":
+        note = " [CPU FALLBACK: TPU unreachable"
+        # point the one-line record at the last committed on-chip number
+        # so a wedged tunnel doesn't read as a perf regression (the value
+        # itself stays the honest CPU measurement)
+        try:
+            for line in (outdir / "profile.csv").read_text().splitlines():
+                parts = line.split(",")
+                if parts[0] == "combine_sum_fp32" and parts[-1] == "stream":
+                    note += (f"; committed TPU artifact: {float(parts[3]):.1f}"
+                             " GB/s at this point, accl_log/profile.csv")
+                    break
+        except (OSError, ValueError, IndexError):
+            pass
+        note += "]"
     if unresolved_headline:
         # the value derives from the jitter-resolution floor: a LOWER
         # bound on throughput, not a measurement — say so in the one
