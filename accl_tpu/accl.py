@@ -632,16 +632,24 @@ class ACCL:
         dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT,
                   tuning.reduce_flat_tree_max_count)
 
-    def autotune(self, link=None, timing_model_path=None) -> TuningParams:
+    def autotune(self, link=None, timing_model_path=None,
+                 tier: str = "emulator") -> TuningParams:
         """Derive the four switch-point tuning registers from the
         calibrated timing model and apply them (gather fan-in keeps its
         structural default): the measured-performance closure of the
         reference's hand-picked defaults. `link` is a
         sequencer.timing.LinkParams; absent, it is loaded from
         `timing_model_path` (default accl_log/timing_model.json, written
-        by tools/timing_model.py). Returns the applied TuningParams."""
+        by tools/timing_model.py). tier="tpu" uses the on-chip
+        calibration tier instead of the emulator link fit (dispatch alpha
+        + HBM-bounded beta — a projection until ICI is measured on a
+        multi-chip slice). Returns the applied TuningParams."""
         from .sequencer.timing import LinkParams, tuning_crossovers
 
+        if tier not in ("emulator", "tpu"):
+            raise ValueError(f"unknown autotune tier {tier!r}")
+        if link is not None and tier != "emulator":
+            raise ValueError("pass either link= or tier=, not both")
         if link is None:
             import json
             import pathlib
@@ -651,8 +659,17 @@ class ACCL:
                 or pathlib.Path(__file__).parent.parent
                 / "accl_log" / "timing_model.json")
             model = json.loads(path.read_text())
-            link = LinkParams(alpha=model["link"]["alpha_us"] * 1e-6,
-                              beta=model["link"]["beta_gbps"] * 1e9)
+            if tier == "tpu":
+                t = model.get("tpu_tier")
+                if not t or not t.get("hbm_stream_gbps"):
+                    raise ValueError(
+                        "timing model has no usable tpu_tier; re-run "
+                        "tools/timing_model.py with an on-chip profile")
+                link = LinkParams(alpha=t["dispatch_alpha_us"] * 1e-6,
+                                  beta=t["hbm_stream_gbps"] * 1e9)
+            else:
+                link = LinkParams(alpha=model["link"]["alpha_us"] * 1e-6,
+                                  beta=model["link"]["beta_gbps"] * 1e9)
         cross = tuning_crossovers(link, world=self.world)
         tuning = TuningParams.from_crossovers(cross)
         self.configure_tuning_parameters(tuning)

@@ -55,11 +55,14 @@ def _kernel(axis_name, world, chunk, func, x_ref, o_ref, v_ref, comm_ref,
 
     # Neighbor barrier: nobody issues a remote write until its peers are in
     # the kernel (remote comm buffers alive) — the role CFGRDY + rx-ring
-    # priming plays at the reference's bring-up.
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
-    pltpu.semaphore_wait(barrier, 2)
+    # priming plays at the reference's bring-up. A world-1 ring has no
+    # peers (and no hops): skip it so the degenerate kernel still
+    # executes on a single attached chip.
+    if world > 1:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
+        pltpu.semaphore_wait(barrier, 2)
 
     def hop(t):
         """One ring hop of the accumulator into the next rank's slot t%2.
@@ -211,10 +214,11 @@ def _kernel_bidir(axis_name, world, chunk, func, x_ref, o_ref,
     def combine(a, b):
         return a + b if func == ReduceFunction.SUM else jnp.maximum(a, b)
 
-    barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
-    pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
-    pltpu.semaphore_wait(barrier, 2)
+    if world > 1:  # see the unidirectional kernel's barrier note
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=nxt)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=prv)
+        pltpu.semaphore_wait(barrier, 2)
 
     def fwd_chunk(idx):
         return x_ref[pl.ds(idx * chunk, chunk)]

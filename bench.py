@@ -267,15 +267,12 @@ def bench_collective(jax, op_name, sizes_bytes, world):
     return rows
 
 
-def bench_flagship(jax):
-    """Flagship training-step lane: tokens/s and approximate model-FLOPs
-    utilization of the compiled dense-transformer train step (forward +
-    backward + grad sync + SGD) on the attached device. The reference has
-    no model layer — this lane shows the framework's compute path is
-    MXU-shaped (bf16 matmuls), complementing the collective lanes.
-    Writes accl_log/flagship.csv."""
-    from accl_tpu.models import TransformerConfig, init_params, make_train_step
-    from accl_tpu.models.transformer import demo_batch, shard_params
+def _flagship_setup(jax):
+    """One flagship model configuration shared by the train and decode
+    lanes (so both benchmark the SAME model): returns
+    (cfg, batch, seq_or_ctx, mesh, params, peak_flops)."""
+    from accl_tpu.models import TransformerConfig, init_params
+    from accl_tpu.models.transformer import shard_params
     from accl_tpu.parallel import make_mesh
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
@@ -300,6 +297,21 @@ def bench_flagship(jax):
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1},
                      devices=jax.devices()[:1])
     params = shard_params(init_params(cfg, jax.random.key(0)), cfg, mesh)
+    return cfg, batch, seq, mesh, params, peak_flops
+
+
+def bench_flagship(jax):
+    """Flagship training-step lane: tokens/s and approximate model-FLOPs
+    utilization of the compiled dense-transformer train step (forward +
+    backward + grad sync + SGD) on the attached device. The reference has
+    no model layer — this lane shows the framework's compute path is
+    MXU-shaped (bf16 matmuls), complementing the collective lanes.
+    Writes accl_log/flagship.csv."""
+    from accl_tpu.models import make_train_step
+    from accl_tpu.models.transformer import demo_batch
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    cfg, batch, seq, mesh, params, peak_flops = _flagship_setup(jax)
     tokens, targets = demo_batch(cfg, mesh, batch=batch, seq=seq)
     step = make_train_step(cfg, mesh, lr=1e-3)
 
@@ -332,6 +344,54 @@ def bench_flagship(jax):
                 "ApproxFLOPsPerStep,MFUpct,SNR\n")
         f.write(f"{n_params},{T},{sec:.6e},{tok_s:.1f},"
                 f"{flops_step:.3e},{mfu:.2f},{snr:.1f}\n")
+
+
+def bench_decode(jax):
+    """Inference lane: incremental KV-cache decode throughput (tokens/s
+    and per-token latency) of the compiled single-position step on the
+    attached device — the serving-path complement of the train-step lane.
+    Writes accl_log/decode.csv."""
+    import jax.numpy as jnp
+
+    from accl_tpu.models import init_kv_cache, make_decode_step
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    cfg, batch, ctx, mesh, params, _peak = _flagship_setup(jax)
+    step = make_decode_step(cfg, mesh)
+    cache = init_kv_cache(cfg, mesh, batch, max_len=ctx)
+    tok = jnp.zeros((batch, 1), jnp.int32)
+
+    # warm the cache to mid-context so the attention reads a realistic
+    # window, then time steps at a FIXED position (chained cache, one
+    # dispatch per generated token — the serving shape). The step donates
+    # its cache (in-place KV update), so the live cache threads through a
+    # closure across timing invocations rather than riding args.
+    pos = jnp.array([ctx // 2], jnp.int32)
+    logits, cache = step(params, cache, tok, pos)
+    state = {"cache": cache}
+
+    def make_fn(k):
+        def rep(p, t):
+            c = state["cache"]
+            lg = None
+            for i in range(k):
+                lg, c = step(p, c, t, pos)
+            state["cache"] = c
+            return lg
+        return rep
+
+    sec, k, snr, resolved = _timeit_loop(make_fn, (params, tok),
+                                         1e-3, target=1.0, kmax=400, jax=jax)
+    tok_s = batch / sec
+    regime = "ok" if resolved else "noise"
+    print(f"  decode_step  batch={batch} ctx={ctx}  {sec*1e3:8.3f} ms/tok-step"
+          f"  {tok_s:9.0f} tok/s  (K={k}, {regime})", file=sys.stderr)
+    outdir = pathlib.Path(__file__).parent / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    name = "decode_cpu.csv" if not on_tpu else "decode.csv"
+    with open(outdir / name, "w") as f:
+        f.write("Batch,Context,SecPerStep,TokensPerSec,SNR,Regime\n")
+        f.write(f"{batch},{ctx},{sec:.6e},{tok_s:.1f},{snr:.1f},{regime}\n")
 
 
 def main():
@@ -387,6 +447,10 @@ def main():
             bench_flagship(jax)
         except Exception as e:  # the sweep rows must survive a flagship
             print(f"flagship lane failed: {e!r}", file=sys.stderr)
+        try:
+            bench_decode(jax)
+        except Exception as e:
+            print(f"decode lane failed: {e!r}", file=sys.stderr)
 
     outdir = pathlib.Path(__file__).parent / "accl_log"
     outdir.mkdir(exist_ok=True)

@@ -230,3 +230,33 @@ def test_tpu_tier_from_profile(tmp_path):
 
     # absent profile -> no tier, never a crash
     assert tpu_tier(tmp_path / "missing.csv") is None
+
+
+def test_facade_autotune_tpu_tier(mesh8, tmp_path):
+    """autotune(tier='tpu') derives the registers from the on-chip
+    calibration tier (dispatch alpha + HBM-bounded beta); a model without
+    a usable tier fails loudly instead of silently tuning from the wrong
+    link."""
+    import json
+
+    from accl_tpu.accl import ACCL
+
+    model = {
+        "link": {"alpha_us": 30.0, "beta_gbps": 0.1},
+        "tpu_tier": {"dispatch_alpha_us": 500.0, "hbm_stream_gbps": 300.0},
+    }
+    p = tmp_path / "timing_model.json"
+    p.write_text(json.dumps(model))
+    accl = ACCL(mesh8)
+    applied = accl.autotune(timing_model_path=p, tier="tpu")
+    # 500us of dispatch per round against a 300 GB/s wire: flat trees win
+    # to far larger payloads than the emulator tier's 2.8 KB crossover
+    assert applied.reduce_flat_tree_max_count > 1 << 20
+    assert accl.cclo.tuning().reduce_flat_tree_max_count == \
+        applied.reduce_flat_tree_max_count
+
+    p.write_text(json.dumps({"link": model["link"]}))
+    with pytest.raises(ValueError):
+        accl.autotune(timing_model_path=p, tier="tpu")
+    with pytest.raises(ValueError):
+        accl.autotune(timing_model_path=p, tier="wat")

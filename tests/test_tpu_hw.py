@@ -223,3 +223,35 @@ def test_combine_and_cast_execute_on_chip():
     h = cast_pallas(a, np.float16, interpret=False)
     np.testing.assert_allclose(np.asarray(h),
                                np.asarray(a).astype(np.float16), rtol=0)
+
+
+@pytest.mark.parametrize("variant", ["uni", "bidir"])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_ring_kernel_executes_world1_on_chip(variant, dtype):
+    """EXECUTE (not just compile) the fused ring kernel on silicon: the
+    attached chip runs it as a world-1 ring — the hop loops vanish but
+    the Mosaic-compiled kernel body (VMEM scratch plumbing, dynamic
+    tile-aligned chunk indexing, output assembly) runs for real, and a
+    world-1 allreduce must be the identity."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from accl_tpu.ops.ring_allreduce import (
+        ring_allreduce_pallas,
+        ring_allreduce_pallas_bidir,
+    )
+
+    kernel = (ring_allreduce_pallas if variant == "uni"
+              else ring_allreduce_pallas_bidir)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("ccl",))
+    body, spec = _ring_program(kernel, 1)
+    fn = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                      check_vma=False)
+    )
+    x = np.random.default_rng(5).standard_normal((1, 5000)) \
+        .astype(np.float32)
+    out = np.asarray(fn(jnp.asarray(x, jnp.dtype(dtype)))
+                     .astype(jnp.float32))
+    tol = 1e-6 if dtype == "float32" else 1e-2
+    np.testing.assert_allclose(out, x, rtol=tol, atol=tol)

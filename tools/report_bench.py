@@ -123,6 +123,24 @@ def section_flagship(out: list[str]) -> None:
     if not any_row:
         out.append("*absent*")
     out.append("")
+    dec = False
+    for name, regime in (("decode.csv", "TPU"),
+                         ("decode_cpu.csv", "CPU (functional)")):
+        rows = _read_csv(name)
+        if not rows:
+            continue
+        if not dec:
+            out.append("## Flagship incremental decode (KV cache)\n")
+            dec = True
+        r = rows[-1]
+        noise = ("" if r.get("Regime", "ok") == "ok"
+                 else " (NOISE: below timing resolution, a bound only)")
+        out.append(
+            f"- **{regime}**: batch {r['Batch']}, context {r['Context']}, "
+            f"{float(r['SecPerStep']) * 1e3:.3f} ms/token-step, "
+            f"{float(r['TokensPerSec']):.0f} tokens/s{noise}")
+    if dec:
+        out.append("")
 
 
 def section_timing(out: list[str]) -> None:
