@@ -42,10 +42,24 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 256
     dtype: str = "float32"
+    # grouped-query attention: kv heads < query heads shrink the KV cache
+    # (the decode-path memory lever) and the ring-attention wire bytes;
+    # None = multi-head (kv_heads == n_heads)
+    n_kv_heads: int | None = None
+    # rotary position embeddings; positions are GLOBAL under sequence
+    # parallelism (each sp shard offsets by its rank)
+    rope: bool = True
+    rope_theta: float = 10000.0
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % kv == 0, (self.n_heads, kv)
+        return kv
 
 
 def init_params(cfg: TransformerConfig, key) -> dict:
@@ -66,7 +80,9 @@ def init_params(cfg: TransformerConfig, key) -> dict:
         k = jax.random.split(keys[2 + i], 6)
         params["layers"].append(
             {
-                "wqkv": dense(k[0], (cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+                "wq": dense(k[0], (cfg.d_model, cfg.n_heads, cfg.head_dim)),
+                "wkv": dense(k[4], (cfg.d_model, 2, cfg.kv_heads,
+                                    cfg.head_dim)),
                 "wo": dense(k[1], (cfg.n_heads, cfg.head_dim, cfg.d_model)),
                 "w_up": dense(k[2], (cfg.d_model, cfg.d_ff)),
                 "w_down": dense(k[3], (cfg.d_ff, cfg.d_model)),
@@ -80,7 +96,8 @@ def init_params(cfg: TransformerConfig, key) -> dict:
 def param_specs(cfg: TransformerConfig) -> dict:
     """PartitionSpecs: tp shards heads/ff, everything else replicated."""
     layer = {
-        "wqkv": P(None, None, "tp", None),
+        "wq": P(None, "tp", None),
+        "wkv": P(None, None, "tp", None),
         "wo": P("tp", None, None),
         "w_up": P(None, "tp"),
         "w_down": P("tp", None),
@@ -143,6 +160,42 @@ def _rmsnorm(x, g):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g
 
 
+def _rope(x, pos, theta: float):
+    """Rotate (B, T, H, D) by absolute positions `pos` (T,) — rotary
+    embeddings in fp32, half-split form. Positions must be GLOBAL: under
+    sequence parallelism the caller offsets by its sp shard."""
+    D = x.shape[-1]
+    assert D % 2 == 0, "rope needs an even head_dim"
+    half = D // 2
+    inv_freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (T, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def _qkv(h, lyr, cfg: TransformerConfig, pos):
+    """Project q / k / v with grouped-query layout and rotate q,k by the
+    global positions `pos`. kv heads replicate per group AFTER rotation
+    (one shared slice per G query heads — GQA); head dims are tp-LOCAL
+    here, and H_local / Hkv_local == n_heads / kv_heads on every shard
+    (tp must divide kv_heads)."""
+    q = jnp.einsum("btd,dhk->bthk", h, lyr["wq"])
+    kv = jnp.einsum("btd,dchk->btchk", h, lyr["wkv"])
+    k, v = kv[:, :, 0], kv[:, :, 1]
+    if cfg.rope:
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+    groups = cfg.n_heads // cfg.kv_heads
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    return q, k, v
+
+
 def _tp_allreduce(x, wire):
     """Tensor-parallel partial-sum reduction through the framework's ring
     reduce-scatter + allgather schedule (the ACCL eager allreduce)."""
@@ -184,12 +237,14 @@ def _mlp_half(x, lyr, wire):
     return x + _tp_allreduce(down_partial, wire)
 
 
-def _block(x, lyr, wire):
+def _block(x, lyr, cfg: TransformerConfig, wire):
     """One transformer block (ring attention over sp, tp partial-sum
-    reductions through the framework ring)."""
+    reductions through the framework ring). RoPE positions are global:
+    each sp shard offsets by its rank."""
     h = _rmsnorm(x, lyr["ln1"])
-    qkv = jnp.einsum("btd,dchk->btchk", h, lyr["wqkv"])
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    T = h.shape[1]
+    pos = lax.axis_index("sp") * T + jnp.arange(T)
+    q, k, v = _qkv(h, lyr, cfg, pos)
     attn = ring_attention(q, k, v, axis_name="sp", causal=True)
     o_partial = jnp.einsum("bthk,hkd->btd", attn, lyr["wo"])
     # heads are sharded over tp: partial sums reduce on-device-ring
@@ -197,12 +252,12 @@ def _block(x, lyr, wire):
     return _mlp_half(x, lyr, wire)
 
 
-def _block_fn(wire, remat: bool):
+def _block_fn(cfg: TransformerConfig, wire, remat: bool):
     """The per-layer body, optionally rematerialized: jax.checkpoint drops
     the block's activations (attention scores, MLP hidden) in the forward
     pass and recomputes them — including the ring/tp collectives — during
     the backward, trading FLOPs for HBM (the long-context lever on TPU)."""
-    fn = lambda x, lyr: _block(x, lyr, wire)  # noqa: E731
+    fn = lambda x, lyr: _block(x, lyr, cfg, wire)  # noqa: E731
     return jax.checkpoint(fn) if remat else fn
 
 
@@ -210,7 +265,7 @@ def _forward_local(params, tokens, cfg: TransformerConfig, wire,
                    remat: bool = False):
     """Per-device forward: tokens (B_local, T_local) -> logits. Runs inside
     shard_map; heads are the tp-local slice, sequence the sp-local shard."""
-    blk = _block_fn(wire, remat)
+    blk = _block_fn(cfg, wire, remat)
     x = params["embed"][tokens]  # (B, T, Dm)
     for lyr in params["layers"]:
         x = blk(x, lyr)
@@ -233,7 +288,7 @@ def _forward_local_pp(params, tokens, cfg: TransformerConfig, wire,
     assert B % M == 0, (B, M)
     mb = x.reshape((M, B // M) + x.shape[1:])
 
-    blk = _block_fn(wire, remat)
+    blk = _block_fn(cfg, wire, remat)
 
     def stage(h):
         def one_layer(carry, lyr):
@@ -293,7 +348,9 @@ def init_kv_cache(cfg: TransformerConfig, mesh: Mesh, batch: int,
     token at a time, so sp must be 1 on the decode mesh)."""
     dt = jnp.dtype(cfg.dtype)
     sh = NamedSharding(mesh, _KV_SPEC)
-    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    # kv_heads (not n_heads): under GQA the cache is the grouped slice —
+    # the inference memory saving that motivates grouped-query attention
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     return [
         {"k": jax.device_put(jnp.zeros(shape, dt), sh),
          "v": jax.device_put(jnp.zeros(shape, dt), sh)}
@@ -301,21 +358,31 @@ def init_kv_cache(cfg: TransformerConfig, mesh: Mesh, batch: int,
     ]
 
 
-def _decode_block(x, lyr, ck, cv, pos, wire):
+def _decode_block(x, lyr, cfg, ck, cv, pos, wire):
     """One block for a single new token position: append this position's
-    k/v to the cache and attend over cache[:pos+1] (masked full-length
-    dot — static shapes, so one compiled program serves every step)."""
+    (rotated, grouped) k/v to the cache and attend over cache[:pos+1]
+    (masked full-length dot — static shapes, so one compiled program
+    serves every step). The cache holds kv_heads; query heads index their
+    group's slice at attention time."""
     h = _rmsnorm(x, lyr["ln1"])
-    qkv = jnp.einsum("btd,dchk->btchk", h, lyr["wqkv"])
-    q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = jnp.einsum("btd,dhk->bthk", h, lyr["wq"])
+    kv = jnp.einsum("btd,dchk->btchk", h, lyr["wkv"])
+    k_new, v_new = kv[:, :, 0], kv[:, :, 1]
+    if cfg.rope:
+        p1 = pos[None]  # (1,) absolute position of this token
+        q = _rope(q, p1, cfg.rope_theta)
+        k_new = _rope(k_new, p1, cfg.rope_theta)
     ck = lax.dynamic_update_slice_in_dim(ck, k_new, pos, axis=1)
     cv = lax.dynamic_update_slice_in_dim(cv, v_new, pos, axis=1)
-    # (B, 1, H, hd) x (B, T, H, hd) -> (B, H, T); mask j > pos
-    scores = jnp.einsum("bqhk,bthk->bht", q, ck) / np.sqrt(q.shape[-1])
-    mask = jnp.arange(ck.shape[1])[None, None, :] > pos
+    groups = cfg.n_heads // cfg.kv_heads
+    # (B, 1, Hkv, G, hd) x (B, T, Hkv, hd) -> (B, Hkv, G, T); mask j > pos
+    qg = q.reshape(q.shape[0], 1, -1, groups, q.shape[-1])
+    scores = jnp.einsum("bqhgk,bthk->bhgt", qg, ck) / np.sqrt(q.shape[-1])
+    mask = jnp.arange(ck.shape[1])[None, None, None, :] > pos
     scores = jnp.where(mask, -jnp.inf, scores.astype(jnp.float32))
     attn = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    ctx = jnp.einsum("bht,bthk->bhk", attn, cv)[:, None]  # (B, 1, H, hd)
+    ctx = jnp.einsum("bhgt,bthk->bhgk", attn, cv)  # (B, Hkv, G, hd)
+    ctx = ctx.reshape(ctx.shape[0], 1, -1, ctx.shape[-1])  # (B, 1, H, hd)
     o_partial = jnp.einsum("bthk,hkd->btd", ctx, lyr["wo"])
     x = x + _tp_allreduce(o_partial, wire)
     return _mlp_half(x, lyr, wire), ck, cv
@@ -342,7 +409,7 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
         p = pos[0]  # replicated scalar arrives as a (1,) shard
         new_cache = []
         for lyr, c in zip(params["layers"], cache):
-            x, ck, cv = _decode_block(x, lyr, c["k"], c["v"], p, wire)
+            x, ck, cv = _decode_block(x, lyr, cfg, c["k"], c["v"], p, wire)
             new_cache.append({"k": ck, "v": cv})
         x = _rmsnorm(x, jnp.ones((cfg.d_model,), x.dtype))
         logits = jnp.einsum("btd,dv->btv", x, params["unembed"])

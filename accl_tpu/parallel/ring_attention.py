@@ -25,20 +25,28 @@ from jax import lax
 def _block_attend(q, k, v, q_pos, k_pos, causal, sm_scale):
     """Scores + masked online-softmax statistics for one K/V block.
 
-    q: (B, Tq, H, D), k/v: (B, Tk, H, D). Returns (m, l, acc) partials in
-    fp32: per-query running max, normalizer, and value accumulator.
+    q: (B, Tq, H, D), k/v: (B, Tk, Hkv, D) with H a multiple of Hkv
+    (grouped-query attention: each kv head serves H/Hkv query heads —
+    H == Hkv is plain MHA). Returns (m, l, acc) partials in fp32 with a
+    (B, Hkv, G, ...) head layout: per-query running max, normalizer, and
+    value accumulator.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    B, Tq, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, Tq, Hkv, G, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * sm_scale
     if causal:
         mask = k_pos[None, :] <= q_pos[:, None]  # (Tq, Tk)
-        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)  # (B, H, Tq)
+        s = jnp.where(mask[None, None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # (B, Hkv, G, Tq)
     # guard fully-masked rows (m = -inf) so exp stays finite
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(s - m_safe[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)
-    l = jnp.sum(p, axis=-1)  # (B, H, Tq)
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    l = jnp.sum(p, axis=-1)  # (B, Hkv, G, Tq)
+    acc = jnp.einsum("bhgqk,bkhd->bhgqd", p.astype(v.dtype), v) \
+        .astype(jnp.float32)
     return m_safe, l, acc
 
 

@@ -278,7 +278,8 @@ def _flagship_setup(jax):
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     if on_tpu:
         cfg = TransformerConfig(vocab=32768, d_model=1024, n_heads=16,
-                                n_layers=8, d_ff=4096, dtype="bfloat16")
+                                n_kv_heads=4, n_layers=8, d_ff=4096,
+                                dtype="bfloat16")
         batch, seq = 8, 1024
         # bf16 MXU peak per chip, by generation (unknown kinds report no
         # MFU rather than one computed against the wrong ceiling)

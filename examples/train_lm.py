@@ -107,10 +107,15 @@ def main():
             axes = factorize_devices(n_dev)
         mesh = make_mesh(axes)
         heads = max(4, axes["tp"] * 2)
+        # grouped-query shape when it divides cleanly: half the kv heads,
+        # still a multiple of tp (kv heads shard over tp too)
+        kv = heads // 2 if (heads // 2) % axes["tp"] == 0 else heads
         cfg = TransformerConfig(vocab=128, d_model=heads * 8, n_heads=heads,
-                                n_layers=max(2, pp), d_ff=heads * 16)
+                                n_kv_heads=kv, n_layers=max(2, pp),
+                                d_ff=heads * 16)
         print(f"mesh {axes}; model d={cfg.d_model} heads={cfg.n_heads} "
-              f"layers={cfg.n_layers}" + (" remat" if args.remat else ""))
+              f"kv={cfg.kv_heads} layers={cfg.n_layers}"
+              + (" remat" if args.remat else ""))
         params = init_params(cfg, jax.random.key(0))
 
         def place(p):

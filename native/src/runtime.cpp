@@ -388,8 +388,22 @@ struct accl_rt {
   // burst quadratic). src_valid_count keeps stray-seqn detection O(1).
   std::unordered_map<uint64_t, size_t> rx_index;
   std::vector<uint32_t> src_valid_count;
+  // src -> the call (CollState address) that has consumed part of a
+  // multi-segment eager message from that src and owns the remainder of
+  // its stream: segments of one message share tag and consecutive seqns,
+  // so a DIFFERENT call matching the next head by tag would interleave
+  // payload mid-message (two concurrent TAG_ANY recvs, or a recv racing
+  // a collective on the same src link). Guarded by rx_mu; released on
+  // message completion or call termination (release_rx_ownership).
+  std::unordered_map<uint32_t, const void *> rx_stream_owner;
   static uint64_t rx_key(uint32_t src, uint32_t seqn) {
     return ((uint64_t)src << 32) | seqn;
+  }
+
+  void release_rx_ownership(const void *tok) {
+    std::lock_guard<std::mutex> lk(rx_mu);
+    for (auto it = rx_stream_owner.begin(); it != rx_stream_owner.end();)
+      it = (it->second == tok) ? rx_stream_owner.erase(it) : std::next(it);
   }
   std::mutex rx_mu;
   std::condition_variable rx_cv;
@@ -747,7 +761,15 @@ struct accl_rt {
     RxSlot &s = rx_slots[i];
     if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY))
       return strict_tag ? DMA_TAG_MISMATCH_ERROR : NOT_READY;
-    if (s.data.size() > cap) return DMA_SIZE_ERROR;  // sender overshot
+    // Cap mismatch at the head follows the same strict/non-strict split
+    // as the tag check: inside a collective the head segment is sized by
+    // the schedule, so an overshoot is a protocol fault; on the SC_RECV
+    // retry path another parked recv with a larger buffer may legally
+    // consume this head first (two TAG_ANY recvs of different sizes race
+    // through the retry queue), so defer with NOT_READY and let the
+    // deadline turn a genuinely undersized recv into RECEIVE_TIMEOUT.
+    if (s.data.size() > cap)
+      return strict_tag ? DMA_SIZE_ERROR : NOT_READY;
     *got = s.data.size();
     if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
     s.status = RxSlot::IDLE;
@@ -969,15 +991,29 @@ struct accl_rt {
         }
         if (rt.udp_mode && n > st.max_rndzv) return DMA_SIZE_ERROR;
         std::lock_guard<std::mutex> lk(rt.rx_mu);
+        const void *tok = (const void *)&st;
+        // stream ownership: a call that consumed part of a multi-segment
+        // message from gsrc owns the remainder — any other call defers,
+        // or it would interleave payload mid-message
+        auto ow = rt.rx_stream_owner.find(gsrc);
+        if (ow != rt.rx_stream_owner.end() && ow->second != tok)
+          return NOT_READY;
         for (;;) {
           uint64_t got = 0;
           uint32_t rc = rt.seek_locked(gsrc, tag, p ? p + st.off : nullptr,
                                        n - st.off, &got, strict);
-          if (rc != NO_ERROR) return rc;  // NOT_READY keeps st.off progress
+          if (rc != NO_ERROR) {  // NOT_READY keeps st.off progress
+            if (rc == NOT_READY && st.off > 0 && st.off < n)
+              rt.rx_stream_owner[gsrc] = tok;  // mid-message: claim
+            return rc;
+          }
           st.off += got;
           if (st.off >= n) break;  // n == 0: one zero-length segment
         }
         st.off = 0;
+        auto own = rt.rx_stream_owner.find(gsrc);
+        if (own != rt.rx_stream_owner.end() && own->second == tok)
+          rt.rx_stream_owner.erase(own);
         return NO_ERROR;
       });
     }
@@ -1725,6 +1761,9 @@ struct accl_rt {
         rx_cv.wait_for(lk, std::chrono::microseconds(200));
         continue;
       }
+      // terminal (success OR error): any stream ownership this call holds
+      // must not outlive it — its CollState is about to be destroyed
+      if (c.cstate) release_rx_ownership(c.cstate.get());
       auto dur = std::chrono::steady_clock::now() - c.t_start;
       if (comm_serialized(c.desc[0])) {
         // release the communicator's serialization slot: a deferred

@@ -223,3 +223,172 @@ def test_cross_executor_agreement(cfg):
         native_out = np.stack(res)
     np.testing.assert_allclose(native_out, expected, **tol,
                                err_msg=f"native {op.name} cfg {cfg}")
+
+
+# ---------------------------------------------------------------------------
+# point-to-point fuzz: random send/recv patterns through both executors
+# ---------------------------------------------------------------------------
+
+P2P_CONFIGS = 10
+P2P_SEED = 4321
+
+
+def _sample_p2p():
+    """Random p2p traffic patterns: message groups per (src, dst) pair in
+    one of two tag modes — 'distinct' (every message its own tag, recvs
+    posted in a shuffled order) or 'any' (all TAG_ANY, strict FIFO
+    pairing — the arrival-order contract of rxbuf_seek.cpp:20-79)."""
+    rng = np.random.default_rng(P2P_SEED)
+    configs = []
+    for i in range(P2P_CONFIGS):
+        world = int(rng.integers(2, 7))
+        n_pairs = int(rng.integers(1, 4))
+        groups = []
+        used = set()
+        for _ in range(n_pairs):
+            src = int(rng.integers(world))
+            dst = int((src + 1 + rng.integers(world - 1)) % world)
+            if (src, dst) in used:
+                # one group per (src, dst) channel: a TAG_ANY group and a
+                # tagged group sharing a channel make pairing depend on
+                # retry-queue timing (wildcard sends match either recv
+                # class) — inherently racy, not a determinism bug
+                continue
+            used.add((src, dst))
+            mode = str(rng.choice(["distinct", "any"]))
+            n_msgs = int(rng.integers(1, 4))
+            counts = [int(rng.integers(1, 1200)) for _ in range(n_msgs)]
+            groups.append([src, dst, mode, counts])
+        max_eager = int(rng.choice([256, 4096]))
+        transport = str(rng.choice(["tcp", "udp"]))
+        # recv posting order per group, decided HERE so both executors
+        # mirror it. Out-of-order recvs make not-yet-wanted eager
+        # messages park in the bounded rx ring (the unexpected-message
+        # problem — reference rx buffers are finite the same way), so
+        # shuffling is only safe when every eager segment of the config
+        # fits the P2P_RX_BUFS ring together; otherwise FIFO.
+        seg = max(max_eager, 256)
+        total_eager_segs = sum(
+            -(-cnt * 4 // seg)
+            for _, _, _, counts in groups for cnt in counts
+            if cnt * 4 <= max_eager)
+        orders = []
+        for src, dst, mode, counts in groups:
+            order = list(range(len(counts)))
+            if mode == "distinct" and total_eager_segs <= P2P_RX_BUFS // 2:
+                rng.shuffle(order)  # tag matching is order-independent
+            orders.append(tuple(order))
+        configs.append((i, world,
+                        tuple((g[0], g[1], g[2], tuple(g[3]), o)
+                              for g, o in zip(groups, orders)),
+                        max_eager, transport))
+    return configs
+
+
+P2P_RX_BUFS = 64  # eager rx ring slots for the p2p fuzz worlds
+
+
+@pytest.mark.parametrize("cfg", _sample_p2p(),
+                         ids=lambda c: f"p2p{c[0]}w{c[1]}")
+def test_cross_executor_p2p_fuzz(cfg):
+    """Multiple outstanding sends/recvs per (src, dst) signature must pair
+    FIFO (the 512-entry parked-notification contract) with identical
+    payload routing on both executors; distinct-tag groups must match by
+    tag regardless of recv posting order."""
+    from accl_tpu import TAG_ANY
+    from accl_tpu.accl import ACCL
+
+    i, world, groups, max_eager, transport = cfg
+    rng = np.random.default_rng(P2P_SEED + 100 + i)
+    # payloads: group g message k -> distinct deterministic data
+    payloads = {}
+    for g, (src, dst, mode, counts, order) in enumerate(groups):
+        for k, cnt in enumerate(counts):
+            payloads[(g, k)] = rng.standard_normal(cnt).astype(np.float32)
+
+    # ---- XLA executor (facade: async sends park, recvs pair) ----------
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh, max_eager_size=max_eager,
+                egr_rx_buf_size=max(max_eager, 1024),
+                n_egr_rx_bufs=P2P_RX_BUFS)
+    bufs = {}
+    reqs = []
+    for g, (src, dst, mode, counts, order) in enumerate(groups):
+        for k, cnt in enumerate(counts):
+            sb = accl.create_buffer(cnt, data=np.tile(payloads[(g, k)],
+                                                      (world, 1)))
+            tag = (g << 8) | k if mode == "distinct" else TAG_ANY
+            reqs.append(accl.send(sb, cnt, src, dst, tag=tag,
+                                  run_async=True))
+            bufs[(g, k)] = sb
+    outs = {}
+    for g, (src, dst, mode, counts, order) in enumerate(groups):
+        for k in order:
+            cnt = counts[k]
+            ob = accl.create_buffer(cnt)
+            tag = (g << 8) | k if mode == "distinct" else TAG_ANY
+            accl.recv(ob, cnt, src, dst, tag=tag)
+            outs[(g, k)] = ob
+    for r in reqs:
+        accl.wait(r)
+    for (g, k), ob in outs.items():
+        dst = groups[g][1]
+        np.testing.assert_allclose(
+            ob.host[dst], payloads[(g, k)], rtol=1e-6,
+            err_msg=f"XLA p2p cfg {i} group {g} msg {k}")
+
+    # ---- native executor ---------------------------------------------
+    w = EmuWorld(world, max_eager=max_eager,
+                 rx_buf_bytes=max(max_eager, 256), n_rx_bufs=P2P_RX_BUFS,
+                 transport=transport)
+    try:
+        def body(rank, r):
+            got = {}
+            # issue every send ASYNC first (a rendezvous send is NOT_READY
+            # until its recv posts — the retry queue must interleave them
+            # with the recvs below, ccl_offload_control.c:2460-2479), then
+            # drain recvs in the generator's per-group order, then wait
+            # the sends
+            from accl_tpu.constants import from_numpy_dtype as _fnd
+
+            handles = []
+            for g, (src, dst, mode, counts, order) in enumerate(groups):
+                if r != src:
+                    continue
+                for k, cnt in enumerate(counts):
+                    tag = (g << 8) | k if mode == "distinct" else TAG_ANY
+                    o = CallOptions(scenario=Operation.send, count=cnt,
+                                    root_src_dst=dst, tag=tag,
+                                    data_type=_fnd(np.dtype(np.float32)))
+                    handles.append(rank.start(o, op0=payloads[(g, k)].copy()))
+            # recvs post ASYNC in the generator's order: an out-of-order
+            # tagged recv is NOT_READY at the head seqn until the
+            # in-order recv (posted later) consumes it — only the retry
+            # queue makes that converge, exactly as in the reference
+            # firmware (a sequential out-of-order recv would deadlock
+            # there too: rxbuf_seek matches tag AND the expected seqn)
+            recv_handles = []
+            for g, (src, dst, mode, counts, order) in enumerate(groups):
+                if r != dst:
+                    continue
+                for k in order:
+                    cnt = counts[k]
+                    out = np.zeros(cnt, np.float32)
+                    tag = (g << 8) | k if mode == "distinct" else TAG_ANY
+                    o = CallOptions(scenario=Operation.recv, count=cnt,
+                                    root_src_dst=src, tag=tag,
+                                    data_type=_fnd(np.dtype(np.float32)))
+                    recv_handles.append(rank.start(o, res=out))
+                    got[(g, k)] = out
+            for h in recv_handles + handles:
+                rank.wait(h)
+            return got
+
+        res = w.run(body)
+    finally:
+        w.close()
+    for g, (src, dst, mode, counts, order) in enumerate(groups):
+        for k in range(len(counts)):
+            np.testing.assert_allclose(
+                res[dst][(g, k)], payloads[(g, k)], rtol=1e-6,
+                err_msg=f"native p2p cfg {i} group {g} msg {k}")
