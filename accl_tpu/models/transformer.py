@@ -179,8 +179,9 @@ def _rope(x, pos, theta: float):
 
 def _qkv(h, lyr, cfg: TransformerConfig, pos):
     """Project q / k / v with grouped-query layout and rotate q,k by the
-    global positions `pos`. kv heads replicate per group AFTER rotation
-    (one shared slice per G query heads — GQA); head dims are tp-LOCAL
+    global positions `pos`. k/v stay at kv_heads (GQA): ring_attention
+    attends grouped natively, so each sp ring hop carries the Hkv slice —
+    a kv_heads/n_heads wire-byte saving per hop. Head dims are tp-LOCAL
     here, and H_local / Hkv_local == n_heads / kv_heads on every shard
     (tp must divide kv_heads)."""
     q = jnp.einsum("btd,dhk->bthk", h, lyr["wq"])
@@ -189,10 +190,6 @@ def _qkv(h, lyr, cfg: TransformerConfig, pos):
     if cfg.rope:
         q = _rope(q, pos, cfg.rope_theta)
         k = _rope(k, pos, cfg.rope_theta)
-    groups = cfg.n_heads // cfg.kv_heads
-    if groups > 1:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
     return q, k, v
 
 

@@ -108,5 +108,8 @@ def ring_attention(
 
     m, l, acc = state
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows emit zeros
-    out = (acc / l[..., None]).astype(q.dtype)  # (B, H, T, D)
+    out = (acc / l[..., None]).astype(q.dtype)  # (B, Hkv, G, T, D)
+    # merge the grouped head axes back: head h = hkv*G + g, matching the
+    # q.reshape(B, Tq, Hkv, G, D) grouping in _block_attend.
+    out = out.reshape(B, H, T, D)
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -57,6 +57,32 @@ def test_ring_attention_matches_full(world, causal):
 
 
 @pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_ring_attention_gqa_matches_full(world, hkv):
+    """Grouped-query ring attention (Hkv < H): k/v ride the ring at
+    kv-head width and must match the full-attention oracle with k/v
+    repeated to all query heads."""
+    B, T, H, D = 2, 32, 4, 16
+    mesh = Mesh(np.array(jax.devices()[:world]), ("sp",))
+    q = RNG.standard_normal((B, T, H, D)).astype(np.float32)
+    k, v = (RNG.standard_normal((B, T, hkv, D)).astype(np.float32)
+            for _ in range(2))
+
+    def body(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp", causal=True)
+
+    f = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+                      out_specs=P(None, "sp"), check_vma=False)
+    )
+    out = np.asarray(f(q, k, v))
+    G = H // hkv
+    exp = reference_attention(q, np.repeat(k, G, axis=2),
+                              np.repeat(v, G, axis=2), True)
+    np.testing.assert_allclose(out, exp, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("world", [2, 4])
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_matches_full(world, causal):
     run_sharded_attention(ulysses_attention, world, B=2, T=32, H=4, D=8,
