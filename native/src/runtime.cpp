@@ -28,6 +28,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <cstdio>
 #include <cstdlib>
@@ -98,7 +99,17 @@ struct MsgHeader {
   uint32_t host;
   uint64_t bytes;  // payload length / rendezvous size
   uint64_t vaddr;  // rendezvous target address
-  uint8_t pad[16];
+  // total bytes of the eager MESSAGE this segment belongs to: the
+  // receiver-side message boundary. Without it a parked recv whose count
+  // mismatches the head message would consume it as partial fill and
+  // misassemble two messages into one buffer (the reference wire needs no
+  // equivalent because rxbuf_seek pairs whole DMA commands, not byte
+  // streams). Rides every MSG_EGR_DATA segment, with msg_off locating the
+  // segment inside its message (0 = message head) so an orphaned
+  // continuation segment — left behind when a mid-message recv times out —
+  // can never masquerade as a fresh head of the same length.
+  uint64_t msg_bytes;
+  uint64_t msg_off;
 };
 static_assert(sizeof(MsgHeader) == 64, "ACCL header is 64 bytes");
 constexpr uint32_t MSG_MAGIC = 0xACC17B01u;
@@ -263,6 +274,8 @@ static bool recv_all(int fd, void *buf, size_t n) {
 struct RxSlot {
   enum { IDLE, VALID } status = IDLE;
   uint32_t src = 0, tag = 0, seqn = 0;
+  uint64_t msg_bytes = 0;  // total length of the message this segment is of
+  uint64_t msg_off = 0;    // this segment's byte offset inside that message
   std::vector<uint8_t> data;
 };
 
@@ -301,6 +314,10 @@ struct CommView {
 struct CollState {
   uint64_t off = 0;  // current op's partial progress: eager bytes landed,
                      // or the rendezvous posted-address marker
+  // SC_RECV posted-order FIFO ticket (see the recv op): assigned on the
+  // call's first eager pass, dropped with the registry entry on terminal
+  uint64_t ticket = 0;
+  bool ticketed = false;
   // Config/tuning SNAPSHOT taken on the call's first pass: the replayed
   // op sequence must be deterministic, and a config call (or tuning
   // register write) executing between requeue passes of a parked
@@ -400,10 +417,37 @@ struct accl_rt {
     return ((uint64_t)src << 32) | seqn;
   }
 
+  // Outstanding SC_RECV registry for posted-order FIFO pairing (see the
+  // recv op). Guarded by rx_mu, like the stream-owner map.
+  struct OutstandingRecv {
+    uint32_t src, tag;
+    uint64_t bytes, ticket;
+    const void *tok;
+  };
+  std::vector<OutstandingRecv> outstanding_recvs;
+  uint64_t recv_ticket_next = 0;
+  // srcs whose seqn head may hold orphaned continuation segments of a
+  // message whose recv died mid-consumption: seek discards segments with
+  // msg_off != 0 until the next message head surfaces. Guarded by rx_mu.
+  std::set<uint32_t> rx_drain_srcs;
+
+  // Drop every rx-side claim a terminating call holds: its stream
+  // ownership AND its outstanding-recv ticket (a dead elder must not
+  // defer younger recvs forever). An ownership entry still present here
+  // means the call died mid-message — arm the orphan drain for that src.
   void release_rx_ownership(const void *tok) {
     std::lock_guard<std::mutex> lk(rx_mu);
-    for (auto it = rx_stream_owner.begin(); it != rx_stream_owner.end();)
-      it = (it->second == tok) ? rx_stream_owner.erase(it) : std::next(it);
+    for (auto it = rx_stream_owner.begin(); it != rx_stream_owner.end();) {
+      if (it->second == tok) {
+        rx_drain_srcs.insert(it->first);
+        it = rx_stream_owner.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (auto it = outstanding_recvs.begin(); it != outstanding_recvs.end();)
+      it = (it->tok == tok) ? outstanding_recvs.erase(it) : std::next(it);
+    rx_cv.notify_all();
   }
   std::mutex rx_mu;
   std::condition_variable rx_cv;
@@ -512,7 +556,8 @@ struct accl_rt {
   // ----- transport -----
   bool frame_out(uint32_t dst, MsgType mt, uint32_t tag, uint32_t seqn,
                  uint64_t bytes, uint64_t vaddr, const void *payload,
-                 size_t payload_len, uint32_t host = 0) {
+                 size_t payload_len, uint32_t host = 0,
+                 uint64_t msg_bytes = 0, uint64_t msg_off = 0) {
     MsgHeader h{};
     h.magic = MSG_MAGIC;
     h.msg_type = mt;
@@ -523,6 +568,8 @@ struct accl_rt {
     h.host = host;
     h.bytes = bytes;
     h.vaddr = vaddr;
+    h.msg_bytes = msg_bytes;
+    h.msg_off = msg_off;
     if (udp_mode) {
       // sessionless: header + payload in one datagram (udp_packetizer
       // analog — segment == packet)
@@ -575,6 +622,9 @@ struct accl_rt {
       // seqn already consumed: a LATE datagram duplicate. Landing it
       // would leave a VALID slot no seek ever requests (leaked slot,
       // compaction disabled forever) — drop it.
+      if (getenv("ACCL_RT_DEBUG"))
+        fprintf(stderr, "[r%u] land DROP late src=%u seqn=%u want=%u\n", rank,
+                h.src, h.seqn, inbound_seq[h.src]);
       idle_q.push_back(idx);
       return true;
     }
@@ -589,6 +639,8 @@ struct accl_rt {
     slot.src = h.src;
     slot.tag = h.tag;
     slot.seqn = h.seqn;
+    slot.msg_bytes = h.msg_bytes;
+    slot.msg_off = h.msg_off;
     slot.data = std::move(payload);
     src_valid_count[h.src]++;
     rx_cv.notify_all();
@@ -722,7 +774,8 @@ struct accl_rt {
     while (off < bytes || bytes == 0) {
       uint64_t seg = std::min<uint64_t>(rx_buf_bytes, bytes - off);
       uint32_t seqn = outbound_seq[dst]++;
-      if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg))
+      if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg,
+                     /*host=*/0, /*msg_bytes=*/bytes, /*msg_off=*/off))
         return RECEIVE_TIMEOUT_ERROR;
       off += seg;
       if (bytes == 0) break;  // zero-length notification (barrier)
@@ -749,7 +802,9 @@ struct accl_rt {
   //    path keeps NOT_READY there, because another parked recv with the
   //    matching tag may legally consume the head first.
   uint32_t seek_locked(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
-                       uint64_t *got, bool strict_tag = false) {
+                       uint64_t *got, bool strict_tag = false,
+                       bool msg_start = false, uint64_t want_msg = 0) {
+    drain_orphans_locked(src);
     uint32_t want = inbound_seq[src];
     auto it = rx_index.find(rx_key(src, want));
     if (it == rx_index.end()) {
@@ -761,37 +816,75 @@ struct accl_rt {
     RxSlot &s = rx_slots[i];
     if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY))
       return strict_tag ? DMA_TAG_MISMATCH_ERROR : NOT_READY;
-    // Cap mismatch at the head follows the same strict/non-strict split
-    // as the tag check: inside a collective the head segment is sized by
-    // the schedule, so an overshoot is a protocol fault; on the SC_RECV
-    // retry path another parked recv with a larger buffer may legally
-    // consume this head first (two TAG_ANY recvs of different sizes race
-    // through the retry queue), so defer with NOT_READY and let the
-    // deadline turn a genuinely undersized recv into RECEIVE_TIMEOUT.
-    if (s.data.size() > cap)
+    // Message-boundary match at the head of a NEW message (msg_start):
+    // the head segment must BE a message head (msg_off == 0) and its
+    // total-message length must equal what this recv expects. Consuming a
+    // shorter head message as "partial fill" of a larger recv would
+    // concatenate two messages into one buffer; inside a collective
+    // (strict) a length mismatch is a protocol fault, on the SC_RECV
+    // retry path another parked recv with the matching length may
+    // legally consume this head first, so defer with NOT_READY and let
+    // the deadline turn an unmatched recv into RECEIVE_TIMEOUT.
+    if (msg_start && (s.msg_bytes != want_msg || s.msg_off != 0))
       return strict_tag ? DMA_SIZE_ERROR : NOT_READY;
+    // Mid-message continuation must line up exactly with the progress the
+    // resuming recv has already landed — anything else is a framing fault.
+    if (!msg_start && (s.msg_bytes != want_msg || s.msg_off != want_msg - cap))
+      return DMA_SIZE_ERROR;
+    // A segment overflowing the remaining capacity after the message-level
+    // match is a sender protocol fault (segments of one message must sum
+    // to its msg_bytes) — an error in both modes.
+    if (s.data.size() > cap) return DMA_SIZE_ERROR;
     *got = s.data.size();
     if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
+    release_slot_locked(i);
+    rx_index.erase(it);
+    src_valid_count[src]--;
+    inbound_seq[src] = want + 1;
+    rx_cv.notify_all();
+    return NO_ERROR;
+  }
+
+  // Drain orphaned continuation segments (rx_mu held): when a recv dies
+  // mid-message (deadline), the unconsumed tail of its message still
+  // occupies the head seqns — discard segments until the next message
+  // head (msg_off == 0) surfaces, then resume normal matching. Runs at
+  // the top of seek AND before the SC_RECV elder-pairing check, so FIFO
+  // eligibility is always judged against the true next message head.
+  void drain_orphans_locked(uint32_t src) {
+    while (rx_drain_srcs.count(src)) {
+      auto dit = rx_index.find(rx_key(src, inbound_seq[src]));
+      if (dit == rx_index.end()) return;  // tail not yet arrived: stay armed
+      RxSlot &ds = rx_slots[dit->second];
+      if (ds.msg_off == 0) {
+        rx_drain_srcs.erase(src);  // a fresh head: drain complete
+        return;
+      }
+      release_slot_locked(dit->second);
+      rx_index.erase(dit);
+      src_valid_count[src]--;
+      inbound_seq[src]++;
+    }
+  }
+
+  // Return one slot to the IDLE free-list (rx_mu held), compacting a
+  // grown ring back to the configured size once fully drained so one
+  // burst does not permanently retain payload memory (all slots idle
+  // implies the index is empty).
+  void release_slot_locked(size_t i) {
+    RxSlot &s = rx_slots[i];
     s.status = RxSlot::IDLE;
     if (i >= base_rx_slots)
       std::vector<uint8_t>().swap(s.data);  // free burst capacity
     else
       s.data.clear();
     idle_q.push_back(i);
-    rx_index.erase(it);
-    src_valid_count[src]--;
-    // compact a grown ring back to the configured size once fully
-    // drained, so one burst does not permanently retain payload memory
-    // (all slots idle implies the index is empty)
     if (rx_slots.size() > base_rx_slots &&
         idle_q.size() == rx_slots.size()) {
       rx_slots.resize(base_rx_slots);
       idle_q.clear();
       for (size_t j = 0; j < base_rx_slots; j++) idle_q.push_back(j);
     }
-    inbound_seq[src] = want + 1;
-    rx_cv.notify_all();
-    return NO_ERROR;
   }
 
   // ----- rendezvous protocol (.c:142-408) -----
@@ -992,16 +1085,51 @@ struct accl_rt {
         if (rt.udp_mode && n > st.max_rndzv) return DMA_SIZE_ERROR;
         std::lock_guard<std::mutex> lk(rt.rx_mu);
         const void *tok = (const void *)&st;
+        // SC_RECV posted-order FIFO: outstanding p2p recvs register a
+        // ticket (first execution follows run() order — the sequencer
+        // starts fresh calls in queue order), and a recv may take a new
+        // head message only when no EARLIER-posted outstanding recv
+        // also pairs with it (tag match + exact message length). This
+        // is the parked-notification FIFO contract: without it two
+        // TAG_ANY recvs race through the retry queue and the head goes
+        // to whichever retries first, not to the first posted. Register
+        // BEFORE any defer below, or a pass bounced off the stream-owner
+        // check would leave this call unticketed and a younger recv
+        // could out-rank it.
+        if (!strict && !st.ticketed) {
+          st.ticket = rt.recv_ticket_next++;
+          rt.outstanding_recvs.push_back({gsrc, tag, n, st.ticket, tok});
+          st.ticketed = true;
+        }
         // stream ownership: a call that consumed part of a multi-segment
         // message from gsrc owns the remainder — any other call defers,
         // or it would interleave payload mid-message
         auto ow = rt.rx_stream_owner.find(gsrc);
         if (ow != rt.rx_stream_owner.end() && ow->second != tok)
           return NOT_READY;
+        if (!strict) {
+          if (st.off == 0) {
+            // judge FIFO eligibility against the true next message head,
+            // not an orphaned continuation segment awaiting drain
+            rt.drain_orphans_locked(gsrc);
+            auto hit = rt.rx_index.find(rx_key(gsrc, rt.inbound_seq[gsrc]));
+            if (hit != rt.rx_index.end()) {
+              const RxSlot &hs = rt.rx_slots[hit->second];
+              for (const auto &r : rt.outstanding_recvs)
+                if (r.tok != tok && r.src == gsrc && r.ticket < st.ticket &&
+                    (r.tag == TAG_ANY || hs.tag == TAG_ANY ||
+                     r.tag == hs.tag) &&
+                    r.bytes == hs.msg_bytes)
+                  return NOT_READY;  // the elder recv pairs with this head
+            }
+          }
+        }
         for (;;) {
           uint64_t got = 0;
           uint32_t rc = rt.seek_locked(gsrc, tag, p ? p + st.off : nullptr,
-                                       n - st.off, &got, strict);
+                                       n - st.off, &got, strict,
+                                       /*msg_start=*/st.off == 0,
+                                       /*want_msg=*/n);
           if (rc != NO_ERROR) {  // NOT_READY keeps st.off progress
             if (rc == NOT_READY && st.off > 0 && st.off < n)
               rt.rx_stream_owner[gsrc] = tok;  // mid-message: claim

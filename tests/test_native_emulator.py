@@ -113,6 +113,81 @@ def test_emu_allgather(world4, count):
         np.testing.assert_allclose(out, xs.reshape(-1), rtol=0)
 
 
+def test_recv_fifo_pairing_same_signature():
+    """Two TAG_ANY recvs posted in order against two same-size TAG_ANY
+    sends must pair in POSTED order (the parked-notification FIFO
+    contract): the recv-ticket gating in the native runtime makes this
+    deterministic regardless of retry-queue timing. Before the fix, the
+    head message went to whichever parked recv happened to retry first."""
+    from accl_tpu import TAG_ANY, CallOptions
+    from accl_tpu.constants import Operation, from_numpy_dtype
+
+    a = RNG.standard_normal(300).astype(np.float32)
+    b = RNG.standard_normal(300).astype(np.float32)
+    f32 = from_numpy_dtype(np.dtype(np.float32))
+    for _ in range(3):  # repeat: the old behavior was timing-dependent
+        w = EmuWorld(2)
+        try:
+            def body(rank, i):
+                if i == 1:
+                    rank.send(a.copy(), 300, dst=0)
+                    rank.send(b.copy(), 300, dst=0)
+                    return None
+                out1 = np.zeros(300, np.float32)
+                out2 = np.zeros(300, np.float32)
+                h1 = rank.start(CallOptions(scenario=Operation.recv,
+                                            count=300, root_src_dst=1,
+                                            tag=TAG_ANY, data_type=f32),
+                                res=out1)
+                h2 = rank.start(CallOptions(scenario=Operation.recv,
+                                            count=300, root_src_dst=1,
+                                            tag=TAG_ANY, data_type=f32),
+                                res=out2)
+                rank.wait(h2)
+                rank.wait(h1)
+                return out1, out2
+            res = w.run(body)
+        finally:
+            w.close()
+        np.testing.assert_allclose(res[0][0], a, rtol=0)
+        np.testing.assert_allclose(res[0][1], b, rtol=0)
+
+
+def test_recv_length_mismatch_defers_not_corrupts():
+    """A parked recv whose count mismatches the head message must NOT
+    consume it as partial fill (the wire's msg_bytes boundary): it times
+    out, and a later exact-length recv still receives the message intact.
+    Before the fix the oversized recv swallowed the head message and
+    misassembled it with the next one."""
+    from accl_tpu import TAG_ANY, CallOptions
+    from accl_tpu.constants import CfgFunc, Operation, from_numpy_dtype
+
+    x = RNG.standard_normal(50).astype(np.float32)
+    f32 = from_numpy_dtype(np.dtype(np.float32))
+    w = EmuWorld(2)
+    try:
+        def body(rank, i):
+            if i == 1:
+                rank.send(x.copy(), 50, dst=0)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=500))
+            wrong = np.zeros(60, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.recv, count=60,
+                                       root_src_dst=1, tag=TAG_ANY,
+                                       data_type=f32), res=wrong)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            right = np.zeros(50, np.float32)
+            rank.recv(right, 50, src=1)
+            return right
+        res = w.run(body)
+    finally:
+        w.close()
+    np.testing.assert_allclose(res[0], x, rtol=0)
+
+
 @pytest.mark.parametrize("func", [ReduceFunction.SUM, ReduceFunction.MAX])
 @pytest.mark.parametrize("count", [64, 20000])  # eager ring / rndzv bin-tree
 def test_emu_reduce(world4, func, count):
