@@ -291,6 +291,13 @@ class ACCL:
         req.check()
         for b in getattr(req, "_accl_sync_out", []):
             b.sync_from_device()
+        # release the private placeholder a run_async stream form rode
+        # (fresh _scratch): it was registered like any user buffer and
+        # would otherwise leak one (world, count) array per async call
+        sc = getattr(req, "_accl_scratch", None)
+        if sc is not None:
+            self.free_buffer(sc)
+            req._accl_scratch = None
         return req
 
     def get_duration_ns(self, req: BaseRequest | None = None) -> int:
@@ -310,11 +317,16 @@ class ACCL:
         return self._execute(opts, [srcbuf], [dstbuf], from_device, to_device,
                              run_async)
 
-    def _scratch(self, count, dtype):
+    def _scratch(self, count, dtype, fresh=False):
         """Internal placeholder buffer for a buffer-less stream endpoint
-        (the dataType-only overloads of the reference driver)."""
+        (the dataType-only overloads of the reference driver). The cache is
+        keyed by (count, dtype), so two in-flight calls of the same shape
+        would DMA through the same placeholder — callers with run_async
+        pass fresh=True to get a private buffer instead of the cached one."""
         if isinstance(dtype, DataType):
             dtype = to_numpy_dtype(dtype)
+        if fresh:
+            return self.create_buffer(count, dtype)
         key = (int(count), str(np.dtype(dtype)))
         buf = self._stream_scratch.get(key)
         if buf is None:
@@ -338,25 +350,33 @@ class ACCL:
         materializes into dstbuf when given (the observable form; the
         reference's PL-kernel sink has no host-visible landing spot),
         else into an internal placeholder."""
-        dst = dstbuf if dstbuf is not None else self._scratch(count, srcbuf.np_dtype)
+        fresh = dstbuf is None and run_async
+        dst = dstbuf if dstbuf is not None else self._scratch(
+            count, srcbuf.np_dtype, fresh=run_async)
         opts = self._prepare(Operation.copy, srcbuf, None, dst, count)
         self._stream_opts(opts, None, res_stream)
         # to_device=True (skip the device->host result sync) only for the
         # unobserved internal placeholder
-        return self._execute(opts, [srcbuf], [dst], from_device,
-                             dstbuf is None, run_async)
+        req = self._execute(opts, [srcbuf], [dst], from_device,
+                            dstbuf is None, run_async)
+        if fresh:
+            req._accl_scratch = dst
+        return req
 
     def copy_from_to_stream(self, data_type, count, *, op0_stream, res_stream,
                             dstbuf=None, run_async=False):
         """Producer stream -> consumer stream, no host buffers (reference
         copy_from_to_stream, accl.hpp:349); dstbuf optionally captures the
         consumer output."""
-        scratch = self._scratch(count, data_type)
+        scratch = self._scratch(count, data_type, fresh=run_async)
         dst = dstbuf if dstbuf is not None else scratch
         opts = self._prepare(Operation.copy, scratch, None, dst, count)
         self._stream_opts(opts, op0_stream, res_stream)
-        return self._execute(opts, [scratch], [dst], True,
-                             dstbuf is None, run_async)
+        req = self._execute(opts, [scratch], [dst], True,
+                            dstbuf is None, run_async)
+        if run_async:
+            req._accl_scratch = scratch
+        return req
 
     def combine(self, count, function, op0, op1, res, *, from_device=False,
                 to_device=False, run_async=False):
@@ -371,16 +391,21 @@ class ACCL:
         """srcbuf may be a DataType when op0_stream is set (the reference's
         stream-send overload, accl.hpp:190: the payload comes from the
         producer kernel, not a buffer)."""
+        fresh = False
         if isinstance(srcbuf, DataType):
             if op0_stream is None:
                 raise ValueError("dataType-only send requires op0_stream")
-            srcbuf = self._scratch(count, srcbuf)
+            srcbuf = self._scratch(count, srcbuf, fresh=run_async)
             from_device = True
+            fresh = run_async
         opts = self._prepare(Operation.send, srcbuf, None, None, count,
                              root_src_dst=src | (dst << 16), tag=tag,
                              compress_dtype=compress_dtype, comm=comm)
         self._stream_opts(opts, op0_stream, None)
-        return self._execute(opts, [srcbuf], [], from_device, True, run_async)
+        req = self._execute(opts, [srcbuf], [], from_device, True, run_async)
+        if fresh:
+            req._accl_scratch = srcbuf
+        return req
 
     def recv(self, dstbuf, count, src, dst, tag=TAG_ANY, *, to_device=False,
              run_async=False, compress_dtype=None, comm=None,
@@ -388,16 +413,21 @@ class ACCL:
         """dstbuf may be a DataType when res_stream is set (the reference's
         stream-recv overload, accl.hpp:278: the payload feeds the consumer
         kernel; pass a real buffer to also capture the consumer output)."""
+        fresh = False
         if isinstance(dstbuf, DataType):
             if res_stream is None:
                 raise ValueError("dataType-only recv requires res_stream")
-            dstbuf = self._scratch(count, dstbuf)
+            dstbuf = self._scratch(count, dstbuf, fresh=run_async)
             to_device = True  # nothing observes the placeholder: skip sync
+            fresh = run_async
         opts = self._prepare(Operation.recv, None, None, dstbuf, count,
                              root_src_dst=src | (dst << 16), tag=tag,
                              compress_dtype=compress_dtype, comm=comm)
         self._stream_opts(opts, None, res_stream)
-        return self._execute(opts, [], [dstbuf], True, to_device, run_async)
+        req = self._execute(opts, [], [dstbuf], True, to_device, run_async)
+        if fresh:
+            req._accl_scratch = dstbuf
+        return req
 
     def _stream_opts(self, opts, op0_stream, res_stream):
         """Arm OP0_STREAM/RES_STREAM on a prepared descriptor (reference:

@@ -184,7 +184,8 @@ def test_transformer_train_step_decreases_loss():
 @pytest.mark.parametrize("axes", [{"dp": 1, "sp": 1, "tp": 2},
                                   {"dp": 2, "sp": 2, "tp": 2},
                                   {"dp": 1, "sp": 1, "tp": 1, "pp": 2},
-                                  {"dp": 2, "sp": 1, "tp": 2, "pp": 2}])
+                                  {"dp": 2, "sp": 1, "tp": 2, "pp": 2},
+                                  {"dp": 1, "sp": 2, "tp": 2, "pp": 2}])
 def test_transformer_train_step_matches_single_device(axes):
     """One SGD step on a tp-sharded mesh must produce the same updated
     params as the identical step on one device (the tp-aware gradient
