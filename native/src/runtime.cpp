@@ -486,6 +486,22 @@ struct accl_rt {
 
   uint64_t timeout_ms = 5000;
 
+  // ACCL_RT_STATS=1 diagnostics: sequencer behavior counters
+  std::atomic<uint64_t> stat_passes{0}, stat_parks{0}, stat_park_ns{0},
+      stat_seek_miss{0}, stat_seek_hit{0};
+
+  // Generation counter of rx-side progress events (eager landings,
+  // rendezvous addresses/completions): the sequencer snapshots it before
+  // an execute pass and parks a NOT_READY call ONLY if no event arrived
+  // since — otherwise an event landing in the gap between the failing
+  // poll and the park would cost the full park timeout (a missed-wakeup
+  // race the 200 us cap used to paper over, one whole cap per chunk).
+  std::atomic<uint64_t> rx_events{0};
+  void rx_event() {
+    rx_events.fetch_add(1, std::memory_order_release);
+    rx_cv.notify_all();
+  }
+
   // ----- exchmem -----
   uint32_t rd(uint32_t addr) {
     std::lock_guard<std::mutex> g(exch_mu);
@@ -643,7 +659,7 @@ struct accl_rt {
     slot.msg_off = h.msg_off;
     slot.data = std::move(payload);
     src_valid_count[h.src]++;
-    rx_cv.notify_all();
+    rx_event();
     return true;
   }
 
@@ -714,13 +730,21 @@ struct accl_rt {
       if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
       switch (h.msg_type) {
         case MSG_EGR_DATA: {
-          if (!land_eager(h, std::move(payload))) return;
+          // allow_grow on the session transport too: the ring collectives
+          // stream whole chunks as multi-segment messages, and a blocked
+          // rx thread (ring full, sequencer mid-send) would stall the
+          // socket into a ring-wide write deadlock. Growth is burst
+          // absorption — the ring compacts once drained.
+          if (!land_eager(h, std::move(payload), /*allow_grow=*/true)) return;
           break;
         }
         case MSG_RNDZV_ADDR: {
-          std::lock_guard<std::mutex> g(rndzv_mu);
-          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
-          rndzv_cv.notify_all();
+          {
+            std::lock_guard<std::mutex> g(rndzv_mu);
+            addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
+            rndzv_cv.notify_all();
+          }
+          rx_event();  // wake a parked sequencer waiting on the address
           break;
         }
         case MSG_RNDZV_WRITE: {
@@ -749,6 +773,7 @@ struct accl_rt {
               rndzv_cv.notify_all();
             }
           }
+          if (posted) rx_event();  // wake a parked completion poll
           if (!posted)
             fprintf(stderr,
                     "[r%u] DROP unposted RNDZV_WRITE from r%u vaddr=%llx "
@@ -763,16 +788,24 @@ struct accl_rt {
 
   // ----- eager protocol (send .c:611-648 / recv .c:687-704) -----
 
+  // seg_bytes 0 segments at the configured rx-buf size (the reference's
+  // fixed rx-buffer geometry); the ring collectives pass a jumbo segment
+  // for their streamed whole-chunk messages — receiver slots are growable
+  // vectors, and on a CPU-bound host the per-segment syscall+header
+  // overhead at 4 KB dominates the wire cost of a large chunk. Datagram
+  // transport always respects the 64 KB packet ceiling.
   uint32_t egr_send(uint32_t dst, const uint8_t *ptr, uint64_t bytes,
-                    uint32_t tag) {
+                    uint32_t tag, uint64_t seg_bytes = 0) {
     // the datagram POE has no rendezvous path, so the configured message
     // ceiling applies to eager transfers there (without it, a huge send
     // would overflow the receiver's datagram buffer and surface as a
     // misleading sequencing error)
     if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
+    uint64_t seg_max = seg_bytes ? seg_bytes : rx_buf_bytes;
+    if (udp_mode) seg_max = std::min<uint64_t>(seg_max, rx_buf_bytes);
     uint64_t off = 0;
     while (off < bytes || bytes == 0) {
-      uint64_t seg = std::min<uint64_t>(rx_buf_bytes, bytes - off);
+      uint64_t seg = std::min<uint64_t>(seg_max, bytes - off);
       uint32_t seqn = outbound_seq[dst]++;
       if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg,
                      /*host=*/0, /*msg_bytes=*/bytes, /*msg_off=*/off))
@@ -810,8 +843,10 @@ struct accl_rt {
     if (it == rx_index.end()) {
       if (src_valid_count[src] > 0 && !udp_mode)
         return PACK_SEQ_NUMBER_ERROR;  // stray seqn on an ordered link
+      stat_seek_miss++;
       return NOT_READY;
     }
+    stat_seek_hit++;
     size_t i = it->second;
     RxSlot &s = rx_slots[i];
     if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY))
@@ -1065,9 +1100,14 @@ struct accl_rt {
     // once (st.off as the posted marker) then polls the completion.
     // strict=false is the SC_RECV contract: a head-tag mismatch stays
     // NOT_READY because another parked recv may legally consume it.
-    uint32_t recv(uint32_t gsrc, uint8_t *p, uint64_t n, bool strict = true) {
+    // force_eager: consume a message the peer is known to stream eagerly
+    // regardless of size (the ring collectives' whole-chunk messages) —
+    // the protocol split would otherwise post a rendezvous address for a
+    // write that never comes.
+    uint32_t recv(uint32_t gsrc, uint8_t *p, uint64_t n, bool strict = true,
+                  bool force_eager = false) {
       return op([&]() -> uint32_t {
-        if (rndzv(n)) {
+        if (!force_eager && rndzv(n)) {
           if (n > st.max_rndzv) return DMA_SIZE_ERROR;
           uint64_t va = (uint64_t)(uintptr_t)p;
           if (st.off == 0) {
@@ -1428,63 +1468,68 @@ struct accl_rt {
       o.local([&] { std::memcpy(dst, src, bytes); });
       return NO_ERROR;
     }
-    if (o.rndzv(bytes)) {
-      // reduce + bcast composition (.c:1878-1887): the nested calls share
-      // this call's op index space, so the replay walks straight through
-      if ((rc = do_reduce(o, cm, dt, func, src, dst, count, 0))) return rc;
-      return do_bcast(o, cm, dst, bytes, 0);
-    }
-    // segmented ring reduce-scatter + allgather (.c:1888-2071)
-    uint64_t max_seg = rx_buf_bytes / eb;
-    max_seg -= max_seg % cm.world;
-    if (max_seg == 0) max_seg = cm.world;
+    // Ring reduce-scatter + ring allgather at EVERY size (.c:1888-2071's
+    // ring with streamed relay). The hop payload is the whole world-th
+    // chunk as ONE eager message: egr_send streams its rx-buf segments
+    // without waiting and the receiver drains them incrementally inside
+    // one resumable recv op, so the wire pipelines at segment granularity
+    // while the op program stays at 2(P-1) hops x O(1) ops — the
+    // reference's >2-moves-in-flight posture (.c:626-647) without a
+    // per-segment op explosion (whose replay scan is quadratic in ops).
+    // The receiver-side rx ring absorbs a whole in-flight chunk by
+    // growing (land_eager allow_grow) and compacts when drained.
+    // The former rendezvous reduce+bcast composition (.c:1878-1887)
+    // measured 4x slower than bcast alone at 1 MB / 8 ranks
+    // (accl_log/emu_bench.csv): the tree reduce serializes full payloads
+    // through combine nodes, while the ring moves the bandwidth-optimal
+    // 2*bytes*(P-1)/P per link — so this framework drops the composition.
+    uint64_t bulk = (count + cm.world - 1) / cm.world;
+    auto chunk = [&](uint32_t idx) {
+      uint64_t lo = std::min<uint64_t>((uint64_t)idx * bulk, count);
+      uint64_t hi = std::min<uint64_t>(lo + bulk, count);
+      return std::pair<uint64_t, uint64_t>(lo, hi - lo);
+    };
     o.local([&] { std::memcpy(dst, src, bytes); });
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    for (uint64_t off = 0; off < count; off += max_seg) {
-      uint64_t elems = std::min<uint64_t>(max_seg, count - off);
-      uint64_t bulk = (elems + cm.world - 1) / cm.world;
-      auto seg_chunk = [&](uint32_t idx) -> std::pair<uint64_t, uint64_t> {
-        uint64_t lo = std::min<uint64_t>(idx * bulk, elems);
-        uint64_t hi = std::min<uint64_t>(lo + bulk, elems);
-        return {lo, hi - lo};
-      };
-      uint8_t *seg = dst + off * eb;
-      // reduce-scatter: send chunk rank-1 first; hop-s arrival is chunk
-      // rank-2-s (same derivation as schedules.reduce_scatter_ring).
-      // The send is one single-shot op: it reads the region exactly once
-      // at execution time, before the allgather phase mutates it, and a
-      // replayed (completed) op never re-reads.
-      uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
-      auto [clo, cn] = seg_chunk(cidx);
-      if ((rc = o.op([&, clo = clo, cn = cn] {
-             return egr_send(nxt, seg + clo * eb, cn * eb, o.tag);
+    st.tmp.resize(bulk * eb);
+    // reduce-scatter: hop s sends chunk (rank-1-s) — combined locally at
+    // hop s-1 — and combines arriving chunk (rank-2-s), the same
+    // derivation as schedules.reduce_scatter_ring
+    for (uint32_t s = 0; s + 1 < cm.world; s++) {
+      uint32_t sidx = (cm.rank + cm.world - 1 - s) % cm.world;
+      uint32_t ridx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
+      auto [slo, sn] = chunk(sidx);
+      if ((rc = o.op([&, slo = slo, sn = sn] {
+             return egr_send(nxt, dst + slo * eb, sn * eb, o.tag,
+                             /*seg_bytes=*/1 << 20);
            })))
         return rc;
-      for (uint32_t s = 0; s < cm.world - 1; s++) {
-        uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
-        auto [lo, n] = seg_chunk(idx);
-        st.tmp.resize(n * eb);
-        if ((rc = o.recv(prv, st.tmp.data(), n * eb))) return rc;
-        if ((rc = o.op([&, lo = lo, n = n] {
-               return combine_buffers(dt, func, seg + lo * eb, st.tmp.data(),
-                                      n);
-             })))
-          return rc;
-        if (s + 1 < cm.world - 1 &&
-            (rc = o.send(nxt, seg + lo * eb, n * eb)))
-          return rc;
-      }
-      // ring allgather of reduced chunks (chunk `rank` now final)
-      uint32_t gidx = cm.rank;
-      for (uint32_t s = 0; s < cm.world - 1; s++) {
-        auto [glo, gn] = seg_chunk(gidx);
-        if ((rc = o.send(nxt, seg + glo * eb, gn * eb))) return rc;
-        uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
-        auto [olo, on] = seg_chunk(origin);
-        if ((rc = o.recv(prv, seg + olo * eb, on * eb))) return rc;
-        gidx = origin;
-      }
+      auto [rlo, rn] = chunk(ridx);
+      if ((rc = o.recv(prv, st.tmp.data(), rn * eb, /*strict=*/true,
+                       /*force_eager=*/true)))
+        return rc;
+      if ((rc = o.op([&, rlo = rlo, rn = rn] {
+             return combine_buffers(dt, func, dst + rlo * eb, st.tmp.data(),
+                                    rn);
+           })))
+        return rc;
+    }
+    // ring allgather of reduced chunks: hop s relays chunk (rank-s),
+    // receiving chunk (rank-1-s) directly into place
+    for (uint32_t s = 0; s + 1 < cm.world; s++) {
+      uint32_t sidx = (cm.rank + cm.world - s) % cm.world;
+      uint32_t ridx = (cm.rank + cm.world - 1 - s) % cm.world;
+      auto [slo, sn] = chunk(sidx);
+      if ((rc = o.op([&, slo = slo, sn = sn] {
+             return egr_send(nxt, dst + slo * eb, sn * eb, o.tag,
+                             /*seg_bytes=*/1 << 20);
+           })))
+        return rc;
+      auto [rlo, rn] = chunk(ridx);
+      if ((rc = o.recv(prv, dst + rlo * eb, rn * eb, /*strict=*/true,
+                       /*force_eager=*/true)))
+        return rc;
     }
     return NO_ERROR;
   }
@@ -1876,6 +1921,8 @@ struct accl_rt {
       }
       if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
         fprintf(stderr, "[r%u] exec scenario=%u count=%u\n", rank, c.desc[0], c.desc[1]);
+      uint64_t ev0 = rx_events.load(std::memory_order_acquire);
+      stat_passes++;
       uint32_t rc = execute(c);
       if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
         fprintf(stderr, "[r%u] done scenario=%u rc=%u\n", rank, c.desc[0], rc);
@@ -1884,9 +1931,21 @@ struct accl_rt {
           std::lock_guard<std::mutex> lk(call_mu);
           retry_q.push_back(std::move(c));
         }
-        // park briefly: progress needs a new rx segment, not a re-poll
+        // park until a NEW rx event (progress needs a segment/address/
+        // completion, not a re-poll) — but only if none arrived since
+        // this pass started, or the arrival gap costs a full timeout
         std::unique_lock<std::mutex> lk(rx_mu);
-        rx_cv.wait_for(lk, std::chrono::microseconds(200));
+        if (rx_events.load(std::memory_order_acquire) == ev0) {
+          stat_parks++;
+          auto t0 = std::chrono::steady_clock::now();
+          rx_cv.wait_for(lk, std::chrono::microseconds(200), [&] {
+            return stop.load() ||
+                   rx_events.load(std::memory_order_acquire) != ev0;
+          });
+          stat_park_ns += std::chrono::duration_cast<std::chrono::nanoseconds>(
+                              std::chrono::steady_clock::now() - t0)
+                              .count();
+        }
         continue;
       }
       // terminal (success OR error): any stream ownership this call holds
@@ -2102,6 +2161,15 @@ void accl_rt_destroy(accl_rt_t *rt) {
   for (auto &t : rt->rx_threads)
     if (t.joinable()) t.join();
   if (rt->seq_thread.joinable()) rt->seq_thread.join();
+  if (getenv("ACCL_RT_STATS"))
+    fprintf(stderr,
+            "[r%u] stats: passes=%llu parks=%llu park_ms=%.1f "
+            "seek_hit=%llu seek_miss=%llu\n",
+            rt->rank, (unsigned long long)rt->stat_passes.load(),
+            (unsigned long long)rt->stat_parks.load(),
+            rt->stat_park_ns.load() / 1e6,
+            (unsigned long long)rt->stat_seek_hit.load(),
+            (unsigned long long)rt->stat_seek_miss.load());
   delete rt;
 }
 

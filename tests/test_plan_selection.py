@@ -70,9 +70,12 @@ def test_allreduce_paths():
     assert ar.algorithm == Algorithm.EAGER_RING_RS_AG
     # .c:1898-1901: eager segment count world-aligned
     assert ar.seg_count % 8 == 0 or ar.seg_count == 100
+    # the ring serves EVERY size: the reference's rendezvous reduce+bcast
+    # composition measured 4x slower than bcast alone on the emulator
+    # (accl_log/emu_bench.csv), so this framework drops it
     assert (
         sel(Operation.allreduce, 1 << 20, world=8).algorithm
-        == Algorithm.RNDZV_REDUCE_BCAST
+        == Algorithm.EAGER_RING_RS_AG
     )
 
 

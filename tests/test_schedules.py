@@ -170,9 +170,12 @@ def test_allreduce(mesh8, func, count):
         np.testing.assert_allclose(out[r], expected, **tol(np.float32))
 
 
-def test_allreduce_rendezvous_path(mesh8):
+def test_allreduce_large_ring_path(mesh8):
+    """Above max_eager the allreduce still rides the segmented ring (the
+    rendezvous reduce+bcast composition was dropped — measured 4x slower
+    than bcast alone on the emulator, accl_log/emu_bench.csv)."""
     x, out, plan = run(mesh8, Operation.allreduce, 1 << 15)
-    assert plan.algorithm == Algorithm.RNDZV_REDUCE_BCAST
+    assert plan.algorithm == Algorithm.EAGER_RING_RS_AG
     expected = x.sum(0)
     for r in range(WORLD):
         np.testing.assert_allclose(out[r], expected, **tol(np.float32))
@@ -258,17 +261,16 @@ def test_compressed_domain_reduction(mesh8):
 
 
 def test_composed_stage_selection_respects_tuning(mesh8):
-    """Rendezvous allreduce stages re-select with live tuning registers
-    (.c:1878-1887): raising bcast_flat_tree_max_ranks must flip the bcast
-    stage from binary tree to flat."""
-    from accl_tpu.sequencer import Protocol
+    """Composed rendezvous stages re-select with live tuning registers
+    (.c:1768-1781): the reduce stage of a rendezvous reduce_scatter flips
+    from binary tree to flat when the reduce_flat_tree registers rise."""
     t = TuningParams.default()
-    p = select_algorithm(Operation.allreduce, 1 << 15, 4, WORLD,
+    p = select_algorithm(Operation.reduce_scatter, 1 << 15, 4, WORLD,
                          max_eager_size=1024, eager_rx_buf_size=1024, tuning=t)
-    assert p.stages[1].algorithm == Algorithm.RNDZV_BIN_TREE
-    t2 = TuningParams(bcast_flat_tree_max_ranks=8)
-    p2 = select_algorithm(Operation.allreduce, 1 << 15, 4, WORLD,
-                          max_eager_size=1024, eager_rx_buf_size=1024, tuning=t2)
-    assert p2.stages[1].algorithm == Algorithm.RNDZV_FLAT_TREE
-    # reduce stage honors reduce_flat_tree registers likewise
+    assert p.algorithm == Algorithm.RNDZV_REDUCE_SCATTER
     assert p.stages[0].algorithm == Algorithm.RNDZV_BIN_TREE
+    t2 = TuningParams(reduce_flat_tree_max_ranks=WORLD)
+    p2 = select_algorithm(Operation.reduce_scatter, 1 << 15, 4, WORLD,
+                          max_eager_size=1024, eager_rx_buf_size=1024,
+                          tuning=t2)
+    assert p2.stages[0].algorithm == Algorithm.RNDZV_FLAT_TREE

@@ -40,10 +40,17 @@ def test_coefficients_mirror_schedule_structure():
     assert p.algorithm == Algorithm.RNDZV_BIN_TREE
     m, b = coefficients(Operation.bcast, p, 50_000, 4, 8, rx_buf_bytes=RX)
     assert m == 2 * 3 and b == 3 * 200_000
-    # composition sums its resolved stages
+    # large allreduce stays on the segmented ring (the reduce+bcast
+    # composition was dropped — emulator-measured 4x slower than bcast)
     p = plan_for(Operation.allreduce, 50_000, 8)
-    assert p.algorithm == Algorithm.RNDZV_REDUCE_BCAST and len(p.stages) == 2
+    assert p.algorithm == Algorithm.EAGER_RING_RS_AG
     m, b = coefficients(Operation.allreduce, p, 50_000, 4, 8,
+                        rx_buf_bytes=RX)
+    assert m > 0 and b > 0
+    # composition sums its resolved stages (rendezvous reduce_scatter)
+    p = plan_for(Operation.reduce_scatter, 50_000, 8)
+    assert p.algorithm == Algorithm.RNDZV_REDUCE_SCATTER and len(p.stages) == 2
+    m, b = coefficients(Operation.reduce_scatter, p, 50_000, 4, 8,
                         rx_buf_bytes=RX)
     assert m > 0 and b > 0
     # world 1: free
