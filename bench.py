@@ -38,6 +38,21 @@ def _fetch(x):
     return np.asarray(x.ravel()[:4])
 
 
+def _fetch_checksum(x):
+    """Cross-check barrier: reduce a strided sample spanning the WHOLE
+    result on device, then pull the scalar. The read cannot complete
+    until every sampled element exists, so if `_fetch`'s 4-element read
+    ever returned before the full computation finished, timings taken
+    under this barrier would exceed `_fetch` timings by the missing
+    tail. tools/fetch_barrier_check.py times both and commits the
+    agreement note to accl_log/ (REPORT.md cites it)."""
+    import jax.numpy as jnp
+
+    r = x.ravel()
+    stride = max(1, int(r.shape[0]) // 4096)
+    return np.asarray(jnp.sum(r[::stride].astype(jnp.float32)))
+
+
 def _time_once(fn, *args, iters=2):
     times = []
     for _ in range(iters):
@@ -438,6 +453,15 @@ def main():
     # on the tunneled chip; the probe-loop payload runs it.
     if os.environ.get("ACCL_BENCH_FULL") == "1":
         full_sizes = [1 << k for k in range(12, 25, 6)]
+        # on the real chip, extend every w1 lane into the regime where
+        # datapath time (bytes / HBM rate) clearly exceeds the ~0.5 ms
+        # relay dispatch cost, so the timing model's TPU tier can resolve
+        # a finite datapath beta instead of clamping it to inf
+        # (reference: device-side cycle counter separates call overhead
+        # from wire time, xrtdevice.cpp:242-249)
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        if on_tpu:
+            full_sizes = full_sizes + [1 << 28]
         for op_name in ("bcast", "scatter", "gather", "allgather",
                         "reduce", "reduce_scatter", "alltoall"):
             rows += bench_collective(jax, op_name, full_sizes,
