@@ -287,17 +287,20 @@ class ACCL:
 
     def wait(self, req: BaseRequest):
         """Complete an async request (sync-out deferred at start time)."""
-        req.wait()
-        req.check()
-        for b in getattr(req, "_accl_sync_out", []):
-            b.sync_from_device()
-        # release the private placeholder a run_async stream form rode
-        # (fresh _scratch): it was registered like any user buffer and
-        # would otherwise leak one (world, count) array per async call
-        sc = getattr(req, "_accl_scratch", None)
-        if sc is not None:
-            self.free_buffer(sc)
-            req._accl_scratch = None
+        try:
+            req.wait()
+            req.check()
+            for b in getattr(req, "_accl_sync_out", []):
+                b.sync_from_device()
+        finally:
+            # release the private placeholder a run_async stream form rode
+            # (fresh _scratch) even when check() raises on a failed op:
+            # it was registered like any user buffer and would otherwise
+            # leak one (world, count) array per failed async call
+            sc = getattr(req, "_accl_scratch", None)
+            if sc is not None:
+                self.free_buffer(sc)
+                req._accl_scratch = None
         return req
 
     def get_duration_ns(self, req: BaseRequest | None = None) -> int:
@@ -647,7 +650,7 @@ class ACCL:
         return self.cclo.dump_eager_rx_buffers()
 
     def configure_tuning_parameters(self, tuning: TuningParams):
-        """Write the five algorithm-tuning registers to exchange memory
+        """Write the six algorithm-tuning registers to exchange memory
         (reference configure_tuning_parameters, accl.cpp:1198-1208); both
         executors read them per call."""
         dev = self.cclo
@@ -661,6 +664,8 @@ class ACCL:
                   tuning.reduce_flat_tree_max_ranks)
         dev.write(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT,
                   tuning.reduce_flat_tree_max_count)
+        dev.write(CCLOAddr.ALLREDUCE_COMPOSITION_MAX_COUNT,
+                  tuning.allreduce_composition_max_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator") -> TuningParams:

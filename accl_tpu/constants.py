@@ -287,12 +287,22 @@ class TuningParams:
         bcast_flat_tree_max_ranks: int = 3,
         reduce_flat_tree_max_ranks: int = 4,
         reduce_flat_tree_max_count: int = 32 * 1024,
+        allreduce_composition_max_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
         self.bcast_flat_tree_max_ranks = bcast_flat_tree_max_ranks
         self.reduce_flat_tree_max_ranks = reduce_flat_tree_max_ranks
         self.reduce_flat_tree_max_count = reduce_flat_tree_max_count
+        # Allreduce payloads in (max_eager, this] bytes run the reference's
+        # rendezvous reduce+bcast composition (.c:1878-1887); 0 — the
+        # default, backed by the emulator measurement in
+        # accl_log/emu_bench.csv where the ring beat the composition ~4x
+        # at 1 MB / 8 ranks — selects the streamed ring at every size.
+        # Runtime-tunable like the reference's algorithm registers
+        # (accl.cpp:1198-1208); the timing model arbitrates per
+        # (size, world) via tuning_crossovers.
+        self.allreduce_composition_max_count = allreduce_composition_max_count
 
     @classmethod
     def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
@@ -319,6 +329,13 @@ class TuningParams:
                 return max_count_cap
             return max(1, min(int(v), max_count_cap))
 
+        # the allreduce composition crossover may legitimately be 0
+        # ("ring always wins"), which as_reg would clamp to 1; NaN/inf
+        # cap like every other threshold
+        comp = cross.get("allreduce_composition_max_bytes", 0)
+        if comp != comp or comp == float("inf"):
+            comp = max_count_cap
+        comp = 0 if comp <= 0 else min(int(comp), max_count_cap)
         return cls(
             gather_flat_tree_max_count=as_reg(
                 cross["gather_flat_tree_max_count_bytes"]),
@@ -328,4 +345,5 @@ class TuningParams:
                 1, int(cross["reduce_flat_tree_max_ranks"])),
             reduce_flat_tree_max_count=as_reg(
                 cross["reduce_flat_tree_max_count_bytes"]),
+            allreduce_composition_max_count=comp,
         )

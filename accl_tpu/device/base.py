@@ -24,7 +24,11 @@ class CCLOAddr:
     PERFCNT = 0x1FF0
     SPARE3 = 0x1FE8
     SPARE2 = 0x1FE0
-    SPARE1 = 0x1FD8
+    # repurposed spare: allreduce payloads <= this many bytes (and above
+    # max_eager) run the rendezvous reduce+bcast composition
+    # (.c:1878-1887); 0 = streamed ring at every size (the measured
+    # default, accl_log/emu_bench.csv)
+    ALLREDUCE_COMPOSITION_MAX_COUNT = 0x1FD8
     REDUCE_FLAT_TREE_MAX_COUNT = 0x1FD4
     REDUCE_FLAT_TREE_MAX_RANKS = 0x1FD0
     BCAST_FLAT_TREE_MAX_RANKS = 0x1FCC

@@ -114,6 +114,10 @@ class TPUDevice(CCLODevice):
             or defaults.reduce_flat_tree_max_ranks,
             reduce_flat_tree_max_count=rd(CCLOAddr.REDUCE_FLAT_TREE_MAX_COUNT)
             or defaults.reduce_flat_tree_max_count,
+            # 0 is this register's meaningful default (ring everywhere),
+            # so no `or defaults` fallback
+            allreduce_composition_max_count=rd(
+                CCLOAddr.ALLREDUCE_COMPOSITION_MAX_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------

@@ -219,13 +219,34 @@ def select_algorithm(
 
     if scenario == Operation.allreduce:
         # Segmented ring reduce-scatter + ring allgather with world-aligned
-        # segments at EVERY size (.c:1888-2071): the ring moves the
-        # bandwidth-optimal 2*bytes*(P-1)/P per link with chunks pipelined
-        # down both phases, while the reference's rendezvous reduce+bcast
-        # composition (.c:1878-1887) serializes full payloads through tree
-        # combine nodes — measured 4x slower than bcast alone at
-        # 1 MB / 8 ranks on the native emulator (accl_log/emu_bench.csv),
-        # which is why this framework drops the composition.
+        # segments as the DEFAULT at every size (.c:1888-2071): the ring
+        # moves the bandwidth-optimal 2*bytes*(P-1)/P per link with chunks
+        # pipelined down both phases, while the reference's rendezvous
+        # reduce+bcast composition (.c:1878-1887) serializes full payloads
+        # through tree combine nodes — measured 4x slower than bcast alone
+        # at 1 MB / 8 ranks on the native emulator (accl_log/emu_bench.csv).
+        # The composition stays reachable through a tuning register (the
+        # reference's runtime-tunable-selection posture, accl.cpp:1198-1208)
+        # so the timing model can arbitrate per (size, world) on links
+        # where trees win; register 0 keeps the measured ring default.
+        if rndzv and bytes_count <= tuning.allreduce_composition_max_count:
+            sub = functools.partial(
+                select_algorithm,
+                dtype_nbytes=dtype_nbytes,
+                world_size=world_size,
+                compression=compression,
+                stream=stream,
+                max_eager_size=max_eager_size,
+                eager_rx_buf_size=eager_rx_buf_size,
+                tuning=tuning,
+            )
+            return rndzv_plan(
+                Algorithm.RNDZV_REDUCE_BCAST,
+                stages=(
+                    sub(Operation.reduce, count),
+                    sub(Operation.bcast, count),
+                ),
+            )
         return eager_plan(Algorithm.EAGER_RING_RS_AG, world_align=world_size)
 
     if scenario == Operation.alltoall:

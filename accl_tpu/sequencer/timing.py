@@ -210,10 +210,42 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
         else:
             break
 
+    # allreduce: ring RS+AG (the measured default) vs the reference's
+    # rendezvous reduce+bcast composition (.c:1878-1887), arbitrated by
+    # THIS model per (size, world) — the largest payload where the
+    # composition still predicts faster (0: ring wins everywhere, the
+    # emulator-measured outcome). Scanned through the real selection
+    # rules so the stage shapes match what would actually run.
+    from ..constants import Operation, TuningParams
+    from .plan import select_algorithm
+
+    comp_best = 0
+    force_comp = TuningParams(allreduce_composition_max_count=1 << 62)
+    ring_only = TuningParams()
+    max_eager = rx_buf_bytes
+    nbytes = max_eager * 2
+    while nbytes <= (1 << 24):
+        count = max(nbytes // elem_bytes, 1)
+        kw = dict(max_eager_size=max_eager, eager_rx_buf_size=rx_buf_bytes)
+        t_comp = predict(params, Operation.allreduce,
+                         select_algorithm(Operation.allreduce, count,
+                                          elem_bytes, P, tuning=force_comp,
+                                          **kw),
+                         count, elem_bytes, P, rx_buf_bytes=rx_buf_bytes)
+        t_ring = predict(params, Operation.allreduce,
+                         select_algorithm(Operation.allreduce, count,
+                                          elem_bytes, P, tuning=ring_only,
+                                          **kw),
+                         count, elem_bytes, P, rx_buf_bytes=rx_buf_bytes)
+        if t_comp < t_ring:
+            comp_best = nbytes
+        nbytes *= 2
+
     return {
         "bcast_flat_tree_max_ranks": bcast_max,
         "reduce_flat_tree_max_count_bytes": reduce_cross,
         "gather_flat_tree_max_count_bytes": gather_cross,
         "reduce_flat_tree_max_ranks": reduce_ranks,
+        "allreduce_composition_max_bytes": comp_best,
         "world": P,
     }

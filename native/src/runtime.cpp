@@ -59,6 +59,10 @@ enum Addr : uint32_t {
   IDCODE = 0x1FF8,
   CFGRDY = 0x1FF4,
   PERFCNT = 0x1FF0,
+  // repurposed spare: allreduce payloads <= this (and > max_eager) run
+  // the reference's rendezvous reduce+bcast composition (.c:1878-1887);
+  // 0 = streamed ring at every size (measured default, emu_bench.csv)
+  ALLREDUCE_COMPOSITION_MAX_COUNT = 0x1FD8,
   REDUCE_FLAT_TREE_MAX_COUNT = 0x1FD4,
   REDUCE_FLAT_TREE_MAX_RANKS = 0x1FD0,
   BCAST_FLAT_TREE_MAX_RANKS = 0x1FCC,
@@ -112,7 +116,12 @@ struct MsgHeader {
   uint64_t msg_off;
 };
 static_assert(sizeof(MsgHeader) == 64, "ACCL header is 64 bytes");
-constexpr uint32_t MSG_MAGIC = 0xACC17B01u;
+// Bumped (…02) when the header's pad bytes became msg_bytes/msg_off
+// framing: a mixed-build world (old sender, new receiver) would not
+// error on size/magic but silently never match (msg_bytes=0) and
+// surface as RECEIVE_TIMEOUT — the magic makes cross-version ranks
+// fail fast at frame decode instead.
+constexpr uint32_t MSG_MAGIC = 0xACC17B02u;
 
 // ---------------------------------------------------------------------------
 // dtype helpers: elementwise SUM/MAX incl. fp16/bf16 via uint16 conversion
@@ -327,6 +336,7 @@ struct CollState {
   uint64_t max_rndzv = 0;
   uint32_t tun_bcast_ranks = 0, tun_gather_fanin = 0, tun_gather_count = 0,
            tun_reduce_ranks = 0, tun_reduce_count = 0;
+  uint64_t tun_allred_comp = 0;
   int wire_bf16 = -1;  // compressed wire dtype, snapshotted like the rest
   // algorithm scratch that must survive requeues (reduce accumulators,
   // ring relay buffers, rendezvous landing slots, the reduce_scatter
@@ -1468,6 +1478,16 @@ struct accl_rt {
       o.local([&] { std::memcpy(dst, src, bytes); });
       return NO_ERROR;
     }
+    // Tuning-register escape hatch: rendezvous-size payloads up to the
+    // ALLREDUCE_COMPOSITION register run the reference's reduce+bcast
+    // composition (.c:1878-1887) — kept runtime-selectable (the
+    // accl.cpp:1198-1208 posture) so the timing model can arbitrate
+    // ring-vs-composition per (size, world); register 0 (default) keeps
+    // the measured ring below.
+    if (o.rndzv(bytes) && bytes <= st.tun_allred_comp) {
+      if ((rc = do_reduce(o, cm, dt, func, src, dst, count, 0))) return rc;
+      return do_bcast(o, cm, dst, bytes, 0);
+    }
     // Ring reduce-scatter + ring allgather at EVERY size (.c:1888-2071's
     // ring with streamed relay). The hop payload is the whole world-th
     // chunk as ONE eager message: egr_send streams its rx-buf segments
@@ -1478,11 +1498,12 @@ struct accl_rt {
     // per-segment op explosion (whose replay scan is quadratic in ops).
     // The receiver-side rx ring absorbs a whole in-flight chunk by
     // growing (land_eager allow_grow) and compacts when drained.
-    // The former rendezvous reduce+bcast composition (.c:1878-1887)
-    // measured 4x slower than bcast alone at 1 MB / 8 ranks
-    // (accl_log/emu_bench.csv): the tree reduce serializes full payloads
-    // through combine nodes, while the ring moves the bandwidth-optimal
-    // 2*bytes*(P-1)/P per link — so this framework drops the composition.
+    // The rendezvous reduce+bcast composition (.c:1878-1887) measured 4x
+    // slower than bcast alone at 1 MB / 8 ranks (accl_log/emu_bench.csv):
+    // the tree reduce serializes full payloads through combine nodes,
+    // while the ring moves the bandwidth-optimal 2*bytes*(P-1)/P per
+    // link — so the ring is the default and the composition rides the
+    // tuning register above.
     uint64_t bulk = (count + cm.world - 1) / cm.world;
     auto chunk = [&](uint32_t idx) {
       uint64_t lo = std::min<uint64_t>((uint64_t)idx * bulk, count);
@@ -1650,6 +1671,7 @@ struct accl_rt {
       st.tun_gather_count = tuning(GATHER_FLAT_TREE_MAX_COUNT, 32 * 1024);
       st.tun_reduce_ranks = tuning(REDUCE_FLAT_TREE_MAX_RANKS, 4);
       st.tun_reduce_count = tuning(REDUCE_FLAT_TREE_MAX_COUNT, 32 * 1024);
+      st.tun_allred_comp = tuning(ALLREDUCE_COMPOSITION_MAX_COUNT, 0);
     }
     if (!c.deadline_set) {
       c.deadline = std::chrono::steady_clock::now() +

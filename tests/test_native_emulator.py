@@ -216,6 +216,35 @@ def test_emu_allreduce(world4, count):
         np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
 
 
+def test_emu_allreduce_composition_register():
+    """ALLREDUCE_COMPOSITION_MAX_COUNT (0x1FD8) routes rendezvous-size
+    payloads through the reference's reduce+bcast composition
+    (.c:1878-1887) instead of the default ring — runtime-selectable like
+    every other algorithm register (accl.cpp:1198-1208)."""
+    w = EmuWorld(4)
+    try:
+        count = 50_000  # 200 KB >> max_eager, <= the register below
+        xs = RNG.standard_normal((4, count)).astype(np.float32)
+
+        def body(rank, i):
+            rank.write(0x1FD8, 1 << 20)
+            out = np.zeros(count, np.float32)
+            rank.allreduce(xs[i].copy(), out, count, ReduceFunction.SUM)
+            # with the register cleared the ring takes over again on the
+            # same runtime (snapshot is per call, not per process)
+            rank.write(0x1FD8, 0)
+            out2 = np.zeros(count, np.float32)
+            rank.allreduce(xs[i].copy(), out2, count, ReduceFunction.SUM)
+            return out, out2
+
+        for out, out2 in w.run(body):
+            np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(out2, xs.sum(0), rtol=1e-4,
+                                       atol=1e-4)
+    finally:
+        w.close()
+
+
 @pytest.mark.parametrize("count", [16, 3000])
 def test_emu_reduce_scatter(world4, count):
     xs = RNG.standard_normal((4, 4 * count)).astype(np.float32)

@@ -70,13 +70,39 @@ def test_allreduce_paths():
     assert ar.algorithm == Algorithm.EAGER_RING_RS_AG
     # .c:1898-1901: eager segment count world-aligned
     assert ar.seg_count % 8 == 0 or ar.seg_count == 100
-    # the ring serves EVERY size: the reference's rendezvous reduce+bcast
-    # composition measured 4x slower than bcast alone on the emulator
-    # (accl_log/emu_bench.csv), so this framework drops it
+    # the ring serves EVERY size by default: the reference's rendezvous
+    # reduce+bcast composition measured 4x slower than bcast alone on the
+    # emulator (accl_log/emu_bench.csv)
     assert (
         sel(Operation.allreduce, 1 << 20, world=8).algorithm
         == Algorithm.EAGER_RING_RS_AG
     )
+
+
+def test_allreduce_composition_register():
+    """The reference composition (.c:1878-1887) stays reachable through
+    the ALLREDUCE_COMPOSITION tuning register (runtime-tunable selection,
+    accl.cpp:1198-1208): payloads in (max_eager, register] compose
+    reduce+bcast with stage plans re-selected under the same registers."""
+    tun = TuningParams(allreduce_composition_max_count=1 << 22)
+    p = select_algorithm(Operation.allreduce, 1 << 18, 4, 8,
+                         max_eager_size=1024, eager_rx_buf_size=1024,
+                         tuning=tun)
+    assert p.algorithm == Algorithm.RNDZV_REDUCE_BCAST
+    assert len(p.stages) == 2
+    # 1 MB / 8 ranks: reduce takes the binomial tree, bcast the binary
+    # tree — both stages re-derived from the live registers
+    assert p.stages[0].algorithm == Algorithm.RNDZV_BIN_TREE
+    assert p.stages[1].algorithm == Algorithm.RNDZV_BIN_TREE
+    # above the register (and at eager sizes) the ring keeps serving
+    big = select_algorithm(Operation.allreduce, 1 << 21, 4, 8,
+                           max_eager_size=1024, eager_rx_buf_size=1024,
+                           tuning=tun)
+    assert big.algorithm == Algorithm.EAGER_RING_RS_AG
+    small = select_algorithm(Operation.allreduce, 64, 4, 8,
+                             max_eager_size=1024, eager_rx_buf_size=1024,
+                             tuning=tun)
+    assert small.algorithm == Algorithm.EAGER_RING_RS_AG
 
 
 def test_reduce_scatter_paths():

@@ -171,14 +171,36 @@ def test_allreduce(mesh8, func, count):
 
 
 def test_allreduce_large_ring_path(mesh8):
-    """Above max_eager the allreduce still rides the segmented ring (the
-    rendezvous reduce+bcast composition was dropped — measured 4x slower
-    than bcast alone on the emulator, accl_log/emu_bench.csv)."""
+    """Above max_eager the allreduce still rides the segmented ring by
+    default (the rendezvous reduce+bcast composition measured 4x slower
+    than bcast alone on the emulator, accl_log/emu_bench.csv; it stays
+    reachable only through the ALLREDUCE_COMPOSITION tuning register)."""
     x, out, plan = run(mesh8, Operation.allreduce, 1 << 15)
     assert plan.algorithm == Algorithm.EAGER_RING_RS_AG
     expected = x.sum(0)
     for r in range(WORLD):
         np.testing.assert_allclose(out[r], expected, **tol(np.float32))
+
+
+def test_allreduce_composition_register_lowering(mesh8):
+    """The RNDZV_REDUCE_BCAST lowering branch stays live behind the
+    tuning register: force it through select_algorithm and check the
+    composed reduce+bcast schedule against the oracle (.c:1878-1887)."""
+    count = 1 << 14  # 64 KB: rendezvous-size, under the register
+    opts = CallOptions(scenario=Operation.allreduce, count=count,
+                       function=int(ReduceFunction.SUM),
+                       data_type=DataType.float32)
+    plan = select_algorithm(
+        Operation.allreduce, count, 4, WORLD,
+        max_eager_size=1024, eager_rx_buf_size=1024,
+        tuning=TuningParams(allreduce_composition_max_count=1 << 20),
+    )
+    assert plan.algorithm == Algorithm.RNDZV_REDUCE_BCAST
+    fn = ScheduleCompiler(mesh8).lower(opts, plan)
+    x = RNG.standard_normal((WORLD, count)).astype(np.float32)
+    out = np.asarray(fn(x))
+    for r in range(WORLD):
+        np.testing.assert_allclose(out[r], x.sum(0), **tol(np.float32))
 
 
 @pytest.mark.parametrize("count", [4, 50])
