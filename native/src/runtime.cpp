@@ -285,6 +285,10 @@ struct RxSlot {
   uint32_t src = 0, tag = 0, seqn = 0;
   uint64_t msg_bytes = 0;  // total length of the message this segment is of
   uint64_t msg_off = 0;    // this segment's byte offset inside that message
+  // landing time: a strict recv meeting a MISMATCHED head defers while
+  // the head is young (another consumer's traffic interleaved on the
+  // link) and only fails fast once it has provably gone unclaimed
+  std::chrono::steady_clock::time_point t_land{};
   std::vector<uint8_t> data;
 };
 
@@ -500,6 +504,28 @@ struct accl_rt {
   std::atomic<uint64_t> stat_passes{0}, stat_parks{0}, stat_park_ns{0},
       stat_seek_miss{0}, stat_seek_hit{0};
 
+  // ACCL_RT_SHAPE=ring|logp overrides the hop-shape auto rule for
+  // allreduce/allgather (0 auto, 1 ring, 2 recursive halving/doubling):
+  // the benchmark harness sweeps both to calibrate the crossover
+  // (tools/rt_stats_sweep.py --shape).
+  int shape_override = 0;
+
+  // BFM-style wire-fault injection (the reference test strategy drives
+  // its DUT through a bus-functional model that can corrupt/delay
+  // streams — SURVEY.md §4; tests/test_fault_injection.py):
+  //   ACCL_RT_FAULT_DELAY_TAIL_MS=N  the FIRST multi-segment eager
+  //     message sent delays its final segment by N ms (a slow tail: the
+  //     consumer's recv dies mid-message and must orphan-drain);
+  //   ACCL_RT_FAULT_DROP_TAIL=1      the FIRST multi-segment eager
+  //     message loses its final segment outright (datagram-transport
+  //     loss semantics: the seqn gap must surface as a clean timeout).
+  // One-shot by design: the fault arms once per runtime.
+  int fault_delay_tail_ms = 0;
+  bool fault_drop_tail = false;
+  std::atomic<bool> fault_armed{false};
+  std::vector<std::thread> fault_threads;
+  std::mutex fault_mu;
+
   // Generation counter of rx-side progress events (eager landings,
   // rendezvous addresses/completions): the sequencer snapshots it before
   // an execute pass and parks a NOT_READY call ONLY if no event arrived
@@ -667,6 +693,7 @@ struct accl_rt {
     slot.seqn = h.seqn;
     slot.msg_bytes = h.msg_bytes;
     slot.msg_off = h.msg_off;
+    slot.t_land = std::chrono::steady_clock::now();
     slot.data = std::move(payload);
     src_valid_count[h.src]++;
     rx_event();
@@ -813,10 +840,36 @@ struct accl_rt {
     if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
     uint64_t seg_max = seg_bytes ? seg_bytes : rx_buf_bytes;
     if (udp_mode) seg_max = std::min<uint64_t>(seg_max, rx_buf_bytes);
+    // one-shot fault arming: this message's final segment is delayed or
+    // lost (see the fault-injection block above)
+    bool fault_this = false;
+    if ((fault_delay_tail_ms > 0 || fault_drop_tail) && bytes > seg_max &&
+        !fault_armed.exchange(true))
+      fault_this = true;
     uint64_t off = 0;
     while (off < bytes || bytes == 0) {
       uint64_t seg = std::min<uint64_t>(seg_max, bytes - off);
       uint32_t seqn = outbound_seq[dst]++;
+      bool last = (off + seg >= bytes);
+      if (fault_this && last) {
+        if (fault_drop_tail) return NO_ERROR;  // lost on the wire
+        // slow tail: deliver from a helper thread after the delay (the
+        // caller must not send MORE traffic to dst before it lands, or
+        // wire order breaks — acceptable for a test lever)
+        std::vector<uint8_t> payload(ptr + off, ptr + off + seg);
+        std::lock_guard<std::mutex> g(fault_mu);
+        fault_threads.emplace_back([this, dst, tag, seqn, seg, bytes, off,
+                                    payload = std::move(payload)] {
+          for (int waited = 0; waited < fault_delay_tail_ms && !stop.load();
+               waited += 10)
+            std::this_thread::sleep_for(std::chrono::milliseconds(10));
+          if (!stop.load())
+            frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, payload.data(),
+                      seg, /*host=*/0, /*msg_bytes=*/bytes,
+                      /*msg_off=*/off);
+        });
+        return NO_ERROR;
+      }
       if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg,
                      /*host=*/0, /*msg_bytes=*/bytes, /*msg_off=*/off))
         return RECEIVE_TIMEOUT_ERROR;
@@ -859,19 +912,44 @@ struct accl_rt {
     stat_seek_hit++;
     size_t i = it->second;
     RxSlot &s = rx_slots[i];
-    if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY))
-      return strict_tag ? DMA_TAG_MISMATCH_ERROR : NOT_READY;
+    // Strict (collective) recvs meeting a MISMATCHED head (wrong tag or
+    // message length) defer instead of erroring while the head may
+    // legitimately belong to OTHER traffic interleaved on the link —
+    // p2p messages share links with collective chunks, and the parked
+    // p2p recv will consume its head and unblock ours (the reference's
+    // rx pool matches out of order by (tag, src), so interleaved
+    // traffic never faults there at all). Fail-fast is preserved for
+    // provably stray heads: no outstanding recv pairs with it AND it
+    // has sat unclaimed past the grace window.
+    auto head_is_claimable = [&]() -> bool {
+      auto age = std::chrono::steady_clock::now() - s.t_land;
+      if (age < std::chrono::milliseconds(250)) return true;
+      for (const auto &r : outstanding_recvs)
+        if (r.src == src &&
+            (r.tag == TAG_ANY || s.tag == TAG_ANY || r.tag == s.tag) &&
+            r.bytes == s.msg_bytes)
+          return true;
+      return false;
+    };
+    if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY)) {
+      if (strict_tag)
+        return head_is_claimable() ? NOT_READY : DMA_TAG_MISMATCH_ERROR;
+      return NOT_READY;
+    }
     // Message-boundary match at the head of a NEW message (msg_start):
     // the head segment must BE a message head (msg_off == 0) and its
     // total-message length must equal what this recv expects. Consuming a
     // shorter head message as "partial fill" of a larger recv would
-    // concatenate two messages into one buffer; inside a collective
-    // (strict) a length mismatch is a protocol fault, on the SC_RECV
-    // retry path another parked recv with the matching length may
-    // legally consume this head first, so defer with NOT_READY and let
-    // the deadline turn an unmatched recv into RECEIVE_TIMEOUT.
-    if (msg_start && (s.msg_bytes != want_msg || s.msg_off != 0))
-      return strict_tag ? DMA_SIZE_ERROR : NOT_READY;
+    // concatenate two messages into one buffer; on the SC_RECV retry
+    // path another parked recv with the matching length may legally
+    // consume this head first, so defer with NOT_READY and let the
+    // deadline turn an unmatched recv into RECEIVE_TIMEOUT; strict
+    // recvs apply the claimable-head rule above.
+    if (msg_start && (s.msg_bytes != want_msg || s.msg_off != 0)) {
+      if (strict_tag)
+        return head_is_claimable() ? NOT_READY : DMA_SIZE_ERROR;
+      return NOT_READY;
+    }
     // Mid-message continuation must line up exactly with the progress the
     // resuming recv has already landed — anything else is a framing fault.
     if (!msg_start && (s.msg_bytes != want_msg || s.msg_off != want_msg - cap))
@@ -1114,6 +1192,54 @@ struct accl_rt {
     // regardless of size (the ring collectives' whole-chunk messages) —
     // the protocol split would otherwise post a rendezvous address for a
     // write that never comes.
+    // ----- streamed whole-chunk helpers (the ring/tree internal hops) --
+    // One logical chunk as eagerly-streamed jumbo-segment message(s):
+    // on the session transport a single message (egr_send pipelines its
+    // segments without waiting; the receiver drains incrementally inside
+    // one resumable recv op); on the datagram POE the chunk splits into
+    // messages <= max_rndzv — the configured datagram-mode message
+    // ceiling — so large collectives no longer DMA_SIZE_ERROR there
+    // (both sides compute the identical split from the snapshotted
+    // config). Always paired: recv_stream on the peer, never a plain
+    // recv/rendezvous post.
+    uint64_t stream_cap(uint64_t n) const {
+      return rt.udp_mode ? std::min<uint64_t>(st.max_rndzv, n ? n : 1) : n;
+    }
+    uint32_t send_stream(uint32_t gdst, const uint8_t *p, uint64_t n) {
+      uint64_t cap = stream_cap(n);
+      uint64_t off = 0;
+      do {
+        uint64_t m = n ? std::min<uint64_t>(cap, n - off) : 0;
+        uint32_t rc = op([&, off = off, m = m] {
+          return rt.egr_send(gdst, p + off, m, tag, /*seg_bytes=*/1 << 20);
+        });
+        if (rc != NO_ERROR) return rc;
+        off += m;
+      } while (off < n);
+      return NO_ERROR;
+    }
+    uint32_t recv_stream(uint32_t gsrc, uint8_t *p, uint64_t n) {
+      uint64_t cap = stream_cap(n);
+      uint64_t off = 0;
+      do {
+        uint64_t m = n ? std::min<uint64_t>(cap, n - off) : 0;
+        uint32_t rc = recv(gsrc, p ? p + off : nullptr, m, /*strict=*/true,
+                           /*force_eager=*/true);
+        if (rc != NO_ERROR) return rc;
+        off += m;
+      } while (off < n);
+      return NO_ERROR;
+    }
+    // protocol-aware pair: rendezvous keeps its one-sided write; the
+    // eager side (any size in udp_mode, <= max_eager on sessions) rides
+    // the streamed helpers so large datagram-transport collectives split
+    // under the message ceiling instead of failing DMA_SIZE_ERROR
+    uint32_t send_x(uint32_t gdst, const uint8_t *p, uint64_t n) {
+      return rndzv(n) ? send(gdst, p, n) : send_stream(gdst, p, n);
+    }
+    uint32_t recv_x(uint32_t gsrc, uint8_t *p, uint64_t n) {
+      return rndzv(n) ? recv(gsrc, p, n) : recv_stream(gsrc, p, n);
+    }
     uint32_t recv(uint32_t gsrc, uint8_t *p, uint64_t n, bool strict = true,
                   bool force_eager = false) {
       return op([&]() -> uint32_t {
@@ -1229,9 +1355,9 @@ struct accl_rt {
     // flat fan-out, eager or rendezvous (.c:868-988)
     if (cm.rank == root) {
       for (uint32_t i = 0; i < cm.world; i++)
-        if (i != root && (rc = o.send(cm.g(i), buf, bytes))) return rc;
+        if (i != root && (rc = o.send_x(cm.g(i), buf, bytes))) return rc;
     } else {
-      if ((rc = o.recv(cm.g(root), buf, bytes))) return rc;
+      if ((rc = o.recv_x(cm.g(root), buf, bytes))) return rc;
     }
     return NO_ERROR;
   }
@@ -1242,12 +1368,12 @@ struct accl_rt {
     if (cm.rank == root) {
       for (uint32_t i = 0; i < cm.world; i++) {
         if (i == root) continue;
-        if ((rc = o.send(cm.g(i), src + (uint64_t)i * bytes, bytes)))
+        if ((rc = o.send_x(cm.g(i), src + (uint64_t)i * bytes, bytes)))
           return rc;
       }
       o.local([&] { std::memcpy(dst, src + (uint64_t)root * bytes, bytes); });
     } else {
-      if ((rc = o.recv(cm.g(root), dst, bytes))) return rc;
+      if ((rc = o.recv_x(cm.g(root), dst, bytes))) return rc;
     }
     return NO_ERROR;
   }
@@ -1261,11 +1387,11 @@ struct accl_rt {
     if (!o.rndzv(bytes)) {
       uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
       uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-      st.tmp.resize(bytes);  // relay buffer survives requeues
+      st.tmp.resize(bytes + 1);  // relay buffer survives requeues
       if (cm.rank == root) {
         o.local([&] { std::memcpy(dst + (uint64_t)root * bytes, src, bytes); });
         for (uint32_t s = 0; s < cm.world - 1; s++) {
-          if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
+          if ((rc = o.recv_stream(prv, st.tmp.data(), bytes))) return rc;
           uint32_t origin = (root + cm.world - 1 - s) % cm.world;
           o.local([&] {
             std::memcpy(dst + (uint64_t)origin * bytes, st.tmp.data(), bytes);
@@ -1275,11 +1401,11 @@ struct accl_rt {
         // relay: own data first, then forward everything originating
         // farther from root than us — world-1-dist(rank) messages, where
         // dist is the +1-direction hop count to root.
-        if ((rc = o.send(nxt, src, bytes))) return rc;
+        if ((rc = o.send_stream(nxt, src, bytes))) return rc;
         uint32_t dist = (root + cm.world - cm.rank) % cm.world;
         for (uint32_t s = 0; s + 1 + dist < cm.world; s++) {
-          if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
-          if ((rc = o.send(nxt, st.tmp.data(), bytes))) return rc;
+          if ((rc = o.recv_stream(prv, st.tmp.data(), bytes))) return rc;
+          if ((rc = o.send_stream(nxt, st.tmp.data(), bytes))) return rc;
         }
       }
       return NO_ERROR;
@@ -1356,28 +1482,43 @@ struct accl_rt {
 
   uint32_t do_allgather(Ops &o, const CommView &cm, const uint8_t *src,
                         uint8_t *dst, uint64_t bytes) {
-    // ring allgather in both protocols (.c:1297-1499). send_ptr rotates
-    // deterministically through dst regions already final, so the replay
-    // recomputes it.
-    uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
-    uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
+    // Streamed-eager allgather at EVERY size (.c:1297-1499's role). The
+    // former per-hop rendezvous handshake paid two extra wire round
+    // trips per hop and measured SLOWER than the allreduce that moves
+    // twice its bytes (emu_bench.csv r4: 0.023 vs 0.083 GB/s at
+    // 1 MB / 8w); whole-chunk jumbo-segment streaming replaces it.
+    //  - power-of-two worlds: recursive doubling — block sizes double
+    //    every step, log2(P) latency steps instead of P-1. Before step
+    //    d each rank holds the contiguous d-chunk block of its aligned
+    //    group; partners' blocks are adjacent and merge.
+    //  - other worlds: the ring, hop payloads streamed whole.
     uint32_t rc;
     o.local([&] { std::memcpy(dst + (uint64_t)cm.rank * bytes, src, bytes); });
-    const uint8_t *send_ptr = src;
+    if ((cm.world & (cm.world - 1)) == 0 &&
+        (shape_override == 2 ||
+         (shape_override == 0 &&
+          bytes * cm.world <= logp_ag_max_bytes(cm.world)))) {
+      for (uint32_t d = 1; d < cm.world; d <<= 1) {
+        uint32_t peer = cm.g(cm.rank ^ d);
+        uint64_t mine = (uint64_t)(cm.rank & ~(d - 1)) * bytes;
+        uint64_t theirs = (uint64_t)((cm.rank ^ d) & ~(d - 1)) * bytes;
+        if ((rc = o.send_stream(peer, dst + mine, (uint64_t)d * bytes)))
+          return rc;
+        if ((rc = o.recv_stream(peer, dst + theirs, (uint64_t)d * bytes)))
+          return rc;
+      }
+      return NO_ERROR;
+    }
+    uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
+    uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
+    const uint8_t *send_ptr = dst + (uint64_t)cm.rank * bytes;
     for (uint32_t s = 0; s < cm.world - 1; s++) {
       uint32_t origin = (cm.rank + cm.world - 1 - s) % cm.world;
       uint8_t *recv_ptr = dst + (uint64_t)origin * bytes;
-      // post our landing first, then send (the peer's address for our
-      // write arrives symmetrically); eager sends before receives, socket
-      // buffering absorbing the send so the ring cannot deadlock
-      if (o.rndzv(bytes)) {
-        if ((rc = o.post(prv, recv_ptr, bytes))) return rc;
-        if ((rc = o.send(nxt, send_ptr, bytes))) return rc;
-        if ((rc = o.completion(prv, recv_ptr, bytes))) return rc;
-      } else {
-        if ((rc = o.send(nxt, send_ptr, bytes))) return rc;
-        if ((rc = o.recv(prv, recv_ptr, bytes))) return rc;
-      }
+      // eager sends before receives, socket buffering absorbing the
+      // send so the ring cannot deadlock
+      if ((rc = o.send_stream(nxt, send_ptr, bytes))) return rc;
+      if ((rc = o.recv_stream(prv, recv_ptr, bytes))) return rc;
       send_ptr = recv_ptr;
     }
     return NO_ERROR;
@@ -1398,17 +1539,17 @@ struct accl_rt {
       uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
       uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
       uint32_t l = (cm.rank + cm.world - root) % cm.world;  // root at 0
-      st.acc.resize(bytes);
+      st.acc.resize(bytes + 1);
       o.local([&] { std::memcpy(st.acc.data(), src, bytes); });
       if (l != 1) {  // everyone except the chain head receives a partial
-        if ((rc = o.recv(prv, st.acc.data(), bytes))) return rc;
+        if ((rc = o.recv_stream(prv, st.acc.data(), bytes))) return rc;
         if ((rc = o.op([&] {
                return combine_buffers(dt, func, st.acc.data(), src, count);
              })))
           return rc;
       }
       if (cm.rank != root) {
-        if ((rc = o.send(nxt, st.acc.data(), bytes))) return rc;
+        if ((rc = o.send_stream(nxt, st.acc.data(), bytes))) return rc;
       } else {
         o.local([&] { std::memcpy(dst, st.acc.data(), bytes); });
       }
@@ -1467,6 +1608,32 @@ struct accl_rt {
     return NO_ERROR;
   }
 
+  // Auto crossover between the log2(P)-hop recursive halving/doubling
+  // shapes and the 2(P-1)/(P-1)-hop rings: the log shape saves
+  // (hops_ring - hops_log) scheduling latencies but its larger per-hop
+  // messages overlap worse on a contended host, so it wins only while
+  // payloads are latency-dominated. Calibrated from the forced-shape
+  // sweep (accl_log/rt_stats_shape_*.csv, tools/rt_stats_sweep.py
+  // --shape): measured tie points sit at ~32 KB of payload per hop
+  // saved (w8: tie ~256 KB with 8 hops saved; w16: tie ~512-700 KB
+  // with 22 saved; allgather tie ~512 KB total with 4 saved).
+  static uint32_t log2_floor(uint32_t world) {
+    uint32_t r = 0;
+    while ((1u << (r + 1)) <= world) r++;
+    return r;
+  }
+  // allreduce: ring 2(P-1) hops vs halving-doubling 2*log2(P)
+  uint64_t logp_max_bytes(uint32_t world) const {
+    uint32_t hops_saved = 2 * (world - 1) - 2 * log2_floor(world);
+    return (uint64_t)hops_saved * 32 * 1024;
+  }
+  // allgather: ring P-1 hops vs doubling log2(P); threshold compares
+  // against the TOTAL gathered payload (world * chunk)
+  uint64_t logp_ag_max_bytes(uint32_t world) const {
+    uint32_t hops_saved = (world - 1) - log2_floor(world);
+    return (uint64_t)hops_saved * 128 * 1024;
+  }
+
   uint32_t do_allreduce(Ops &o, const CommView &cm, uint32_t dt,
                         uint32_t func, const uint8_t *src, uint8_t *dst,
                         uint64_t count) {
@@ -1488,22 +1655,83 @@ struct accl_rt {
       if ((rc = do_reduce(o, cm, dt, func, src, dst, count, 0))) return rc;
       return do_bcast(o, cm, dst, bytes, 0);
     }
-    // Ring reduce-scatter + ring allgather at EVERY size (.c:1888-2071's
-    // ring with streamed relay). The hop payload is the whole world-th
-    // chunk as ONE eager message: egr_send streams its rx-buf segments
+    // Two streamed-eager shapes, both moving the bandwidth-optimal
+    // ~2*bytes*(P-1)/P per link (hop payloads are whole chunks as
+    // jumbo-segment messages — egr_send pipelines rx-buf/jumbo segments
     // without waiting and the receiver drains them incrementally inside
-    // one resumable recv op, so the wire pipelines at segment granularity
-    // while the op program stays at 2(P-1) hops x O(1) ops — the
-    // reference's >2-moves-in-flight posture (.c:626-647) without a
-    // per-segment op explosion (whose replay scan is quadratic in ops).
-    // The receiver-side rx ring absorbs a whole in-flight chunk by
-    // growing (land_eager allow_grow) and compacts when drained.
+    // one resumable recv op, the reference's >2-moves-in-flight posture
+    // (.c:626-647) without a per-segment op explosion):
+    //
+    //  - power-of-two worlds: recursive vector halving-doubling
+    //    (Rabenseifner) — the same volume in 2*log2(P) latency steps
+    //    instead of the ring's 2(P-1). The emulator is scheduling-
+    //    latency-bound (single-core CI hosts: each serialized hop pays a
+    //    thread wakeup, ~0.5 ms measured — accl_log/rt_stats_*.csv), so
+    //    critical-path hop count is what the wall clock sees; on real
+    //    wires the same structure is the standard latency-optimal
+    //    midsize allreduce.
+    //  - other worlds: ring reduce-scatter + ring allgather
+    //    (.c:1888-2071's shape).
+    //
     // The rendezvous reduce+bcast composition (.c:1878-1887) measured 4x
-    // slower than bcast alone at 1 MB / 8 ranks (accl_log/emu_bench.csv):
-    // the tree reduce serializes full payloads through combine nodes,
-    // while the ring moves the bandwidth-optimal 2*bytes*(P-1)/P per
-    // link — so the ring is the default and the composition rides the
-    // tuning register above.
+    // slower than bcast alone at 1 MB / 8 ranks (emu_bench.csv), so it
+    // rides the tuning register above instead of a size rule.
+    bool pow2 = (cm.world & (cm.world - 1)) == 0;
+    bool logp = pow2 && (shape_override == 2 ||
+                         (shape_override == 0 &&
+                          bytes <= logp_max_bytes(cm.world)));
+    if (logp) {
+      o.local([&] { std::memcpy(dst, src, bytes); });
+      // phase 1: reduce-scatter by recursive halving. Pair (r, r^d)
+      // splits the shared window; the rank with bit d clear keeps the
+      // lower half. Windows are identical within every pair because
+      // they depend only on decisions at higher bits.
+      uint64_t lo = 0, hi = count;
+      for (uint32_t d = cm.world >> 1; d >= 1; d >>= 1) {
+        uint32_t peer = cm.g(cm.rank ^ d);
+        uint64_t mid = lo + (hi - lo) / 2;
+        uint64_t klo, khi, slo, shi;
+        if ((cm.rank & d) == 0) {
+          klo = lo; khi = mid; slo = mid; shi = hi;
+        } else {
+          klo = mid; khi = hi; slo = lo; shi = mid;
+        }
+        if ((rc = o.send_stream(peer, dst + slo * eb, (shi - slo) * eb)))
+          return rc;
+        st.tmp.resize((khi - klo) * eb + 1);  // +1: never moves for n=0
+        if ((rc = o.recv_stream(peer, st.tmp.data(), (khi - klo) * eb)))
+          return rc;
+        if ((rc = o.op([&, klo = klo, khi = khi] {
+               return combine_buffers(dt, func, dst + klo * eb,
+                                      st.tmp.data(), khi - klo);
+             })))
+          return rc;
+        lo = klo; hi = khi;
+      }
+      // phase 2: allgather by recursive doubling, merging sibling
+      // windows in reverse split order. window_at(r, d) replays r's
+      // halving decisions down to distance d — the pair's windows are
+      // complementary halves of their common parent.
+      auto window_at = [&](uint32_t r, uint32_t dstop) {
+        uint64_t wlo = 0, whi = count;
+        for (uint32_t d = cm.world >> 1; d >= dstop; d >>= 1) {
+          uint64_t mid = wlo + (whi - wlo) / 2;
+          if ((r & d) == 0) whi = mid; else wlo = mid;
+        }
+        return std::pair<uint64_t, uint64_t>(wlo, whi);
+      };
+      for (uint32_t d = 1; d < cm.world; d <<= 1) {
+        uint32_t peer = cm.g(cm.rank ^ d);
+        auto [plo, phi] = window_at(cm.rank ^ d, d);
+        if ((rc = o.send_stream(peer, dst + lo * eb, (hi - lo) * eb)))
+          return rc;
+        if ((rc = o.recv_stream(peer, dst + plo * eb, (phi - plo) * eb)))
+          return rc;
+        lo = std::min(lo, plo);
+        hi = std::max(hi, phi);
+      }
+      return NO_ERROR;
+    }
     uint64_t bulk = (count + cm.world - 1) / cm.world;
     auto chunk = [&](uint32_t idx) {
       uint64_t lo = std::min<uint64_t>((uint64_t)idx * bulk, count);
@@ -1513,7 +1741,7 @@ struct accl_rt {
     o.local([&] { std::memcpy(dst, src, bytes); });
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    st.tmp.resize(bulk * eb);
+    st.tmp.resize(bulk * eb + 1);
     // reduce-scatter: hop s sends chunk (rank-1-s) — combined locally at
     // hop s-1 — and combines arriving chunk (rank-2-s), the same
     // derivation as schedules.reduce_scatter_ring
@@ -1521,15 +1749,9 @@ struct accl_rt {
       uint32_t sidx = (cm.rank + cm.world - 1 - s) % cm.world;
       uint32_t ridx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
       auto [slo, sn] = chunk(sidx);
-      if ((rc = o.op([&, slo = slo, sn = sn] {
-             return egr_send(nxt, dst + slo * eb, sn * eb, o.tag,
-                             /*seg_bytes=*/1 << 20);
-           })))
-        return rc;
+      if ((rc = o.send_stream(nxt, dst + slo * eb, sn * eb))) return rc;
       auto [rlo, rn] = chunk(ridx);
-      if ((rc = o.recv(prv, st.tmp.data(), rn * eb, /*strict=*/true,
-                       /*force_eager=*/true)))
-        return rc;
+      if ((rc = o.recv_stream(prv, st.tmp.data(), rn * eb))) return rc;
       if ((rc = o.op([&, rlo = rlo, rn = rn] {
              return combine_buffers(dt, func, dst + rlo * eb, st.tmp.data(),
                                     rn);
@@ -1542,15 +1764,9 @@ struct accl_rt {
       uint32_t sidx = (cm.rank + cm.world - s) % cm.world;
       uint32_t ridx = (cm.rank + cm.world - 1 - s) % cm.world;
       auto [slo, sn] = chunk(sidx);
-      if ((rc = o.op([&, slo = slo, sn = sn] {
-             return egr_send(nxt, dst + slo * eb, sn * eb, o.tag,
-                             /*seg_bytes=*/1 << 20);
-           })))
-        return rc;
+      if ((rc = o.send_stream(nxt, dst + slo * eb, sn * eb))) return rc;
       auto [rlo, rn] = chunk(ridx);
-      if ((rc = o.recv(prv, dst + rlo * eb, rn * eb, /*strict=*/true,
-                       /*force_eager=*/true)))
-        return rc;
+      if ((rc = o.recv_stream(prv, dst + rlo * eb, rn * eb))) return rc;
     }
     return NO_ERROR;
   }
@@ -1576,25 +1792,23 @@ struct accl_rt {
         return rc;
       return do_scatter(o, cm, st.full.data(), dst, bytes, 0);
     }
-    // eager ring (.c:1782-1850)
+    // eager ring (.c:1782-1850), hop payloads streamed whole
     uint32_t nxt = cm.g((cm.rank + 1) % cm.world);
     uint32_t prv = cm.g((cm.rank + cm.world - 1) % cm.world);
-    st.tmp.resize(bytes);
+    st.tmp.resize(bytes + 1);
     uint32_t cidx = (cm.rank + cm.world - 1) % cm.world;
-    // single-shot op: reads src exactly once at execution time
-    if ((rc = o.op([&] {
-           return egr_send(nxt, src + (uint64_t)cidx * bytes, bytes, o.tag);
-         })))
+    if ((rc = o.send_stream(nxt, src + (uint64_t)cidx * bytes, bytes)))
       return rc;
     for (uint32_t s = 0; s < cm.world - 1; s++) {
       uint32_t idx = (cm.rank + 2 * cm.world - 2 - s) % cm.world;
-      if ((rc = o.recv(prv, st.tmp.data(), bytes))) return rc;
+      if ((rc = o.recv_stream(prv, st.tmp.data(), bytes))) return rc;
       if ((rc = o.op([&] {
              return combine_buffers(dt, func, st.tmp.data(),
                                     src + (uint64_t)idx * bytes, count);
            })))
         return rc;
-      if (s + 1 < cm.world - 1 && (rc = o.send(nxt, st.tmp.data(), bytes)))
+      if (s + 1 < cm.world - 1 &&
+          (rc = o.send_stream(nxt, st.tmp.data(), bytes)))
         return rc;
     }
     o.local([&] { std::memcpy(dst, st.tmp.data(), bytes); });
@@ -1622,9 +1836,10 @@ struct accl_rt {
           return rc;
         if ((rc = o.completion(cm.g(from), rptr, bytes))) return rc;
       } else {
-        if ((rc = o.send(cm.g(to), src + (uint64_t)to * bytes, bytes)))
+        if ((rc = o.send_stream(cm.g(to), src + (uint64_t)to * bytes,
+                                bytes)))
           return rc;
-        if ((rc = o.recv(cm.g(from), rptr, bytes))) return rc;
+        if ((rc = o.recv_stream(cm.g(from), rptr, bytes))) return rc;
       }
     }
     return NO_ERROR;
@@ -1960,7 +2175,14 @@ struct accl_rt {
         if (rx_events.load(std::memory_order_acquire) == ev0) {
           stat_parks++;
           auto t0 = std::chrono::steady_clock::now();
-          rx_cv.wait_for(lk, std::chrono::microseconds(200), [&] {
+          // The event-counter predicate makes this wait race-free (any
+          // rx progress notifies rx_cv and bumps rx_events), so the cap
+          // is a pure lost-wakeup backstop. 200 us proved far too eager
+          // on single-core CI hosts: with P sequencers parked, 5k
+          // spurious wakeups/s stole the core from the threads moving
+          // data (rt_stats parks ~= seek_miss signature); 2 ms keeps
+          // the backstop while the predicate does the real waking.
+          rx_cv.wait_for(lk, std::chrono::milliseconds(2), [&] {
             return stop.load() ||
                    rx_events.load(std::memory_order_acquire) != ev0;
           });
@@ -2021,6 +2243,14 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   rt->peer_fd.assign(world, -1);
   rt->tx_mu = std::vector<std::mutex>(world);
   rt->wr(IDCODE, 0xACC17B00u);
+  if (const char *s = getenv("ACCL_RT_SHAPE")) {
+    if (!strcmp(s, "ring")) rt->shape_override = 1;
+    else if (!strcmp(s, "logp")) rt->shape_override = 2;
+  }
+  if (const char *s = getenv("ACCL_RT_FAULT_DELAY_TAIL_MS"))
+    rt->fault_delay_tail_ms = atoi(s);
+  if (const char *s = getenv("ACCL_RT_FAULT_DROP_TAIL"))
+    rt->fault_drop_tail = atoi(s) != 0;
 
   if (transport == ACCL_RT_TRANSPORT_UDP) {
     // sessionless datagram POE: one SOCK_DGRAM socket, no connections.
@@ -2183,6 +2413,11 @@ void accl_rt_destroy(accl_rt_t *rt) {
   for (auto &t : rt->rx_threads)
     if (t.joinable()) t.join();
   if (rt->seq_thread.joinable()) rt->seq_thread.join();
+  {
+    std::lock_guard<std::mutex> g(rt->fault_mu);
+    for (auto &t : rt->fault_threads)
+      if (t.joinable()) t.join();
+  }
   if (getenv("ACCL_RT_STATS"))
     fprintf(stderr,
             "[r%u] stats: passes=%llu parks=%llu park_ms=%.1f "

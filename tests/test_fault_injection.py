@@ -1,0 +1,264 @@
+"""Wire-fault injection and framing/FIFO fuzz for the native runtime.
+
+The reference validates its datapath by driving the DUT through a
+bus-functional model that can delay or corrupt streams (SURVEY.md §4,
+test/model simulator/emulator harnesses); the TPU-native analog is the
+runtime's ACCL_RT_FAULT_* levers (native/src/runtime.cpp): the first
+multi-segment eager message can delay or lose its final segment, which
+is exactly the stimulus the r4 protocol machinery — message-boundary
+framing, orphan-segment drain, posted-order FIFO tickets — exists to
+survive. These tests drive the state space the single-scenario r4 tests
+pinned: mid-message recv death with live traffic after it, ticketed
+TAG_ANY pairing under concurrency, mixed jumbo/normal segment
+interleave on shared links, and the datagram message-ceiling split.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, CallOptions, ReduceFunction, TAG_ANY
+from accl_tpu.constants import CfgFunc, Operation, from_numpy_dtype
+from accl_tpu.device.emu_device import EmuWorld
+
+RNG = np.random.default_rng(77)
+F32 = from_numpy_dtype(np.dtype(np.float32))
+
+
+@pytest.fixture
+def fault_env(monkeypatch):
+    """Set/clear the fault levers around one test (env is read at
+    runtime creation)."""
+    def set_fault(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+    yield set_fault
+
+
+@pytest.mark.parametrize("segs,m2_count", [(3, 40), (6, 700), (9, 64)])
+def test_orphan_drain_after_mid_message_death(fault_env, segs, m2_count):
+    """A recv that dies mid-message (slow tail outlives its deadline)
+    must arm the orphan drain; when the stale tail finally lands, a
+    later recv on the same link discards it and receives the NEXT
+    message intact (runtime.cpp drain_orphans_locked). Parametrized
+    over segment counts and follow-up sizes."""
+    fault_env(ACCL_RT_FAULT_DELAY_TAIL_MS=700)
+    rx_buf = 256
+    count = (segs * rx_buf) // 4  # exactly `segs` wire segments
+    m1 = RNG.standard_normal(count).astype(np.float32)
+    m2 = RNG.standard_normal(m2_count).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf)
+    try:
+        def body(rank, i):
+            import time
+
+            if i == 1:
+                rank.send(m1.copy(), count, dst=0, tag=5)  # tail delayed
+                time.sleep(1.0)  # let the tail land before M2 (order)
+                rank.send(m2.copy(), m2_count, dst=0, tag=5)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=300))
+            buf = np.zeros(count, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.recv, count=count,
+                                       root_src_dst=1, tag=5,
+                                       data_type=F32), res=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)  # died mid-message: some segments consumed
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=5000))
+            out = np.zeros(m2_count, np.float32)
+            rank.recv(out, m2_count, src=1, tag=5)
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    np.testing.assert_allclose(res[0], m2, rtol=0)
+
+
+def test_udp_lost_tail_is_a_clean_timeout(fault_env):
+    """Datagram loss of a message's final segment: the seqn gap must
+    surface as RECEIVE_TIMEOUT on the consumer — never as corrupt data
+    or a misleading sequencing error (the datagram POE treats a gap as
+    possibly-in-flight until the deadline)."""
+    fault_env(ACCL_RT_FAULT_DROP_TAIL=1)
+    rx_buf = 256
+    count = (4 * rx_buf) // 4
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf,
+                 transport="udp", max_rndzv=1 << 20)
+    try:
+        def body(rank, i):
+            if i == 1:
+                rank.send(np.ones(count, np.float32), count, dst=0, tag=3)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=400))
+            buf = np.zeros(count, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.recv, count=count,
+                                       root_src_dst=1, tag=3,
+                                       data_type=F32), res=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            return True
+
+        res = w.run(body)
+        assert res[0] is True
+    finally:
+        w.close()
+
+
+TICKET_CONFIGS = 6
+
+
+@pytest.mark.parametrize("seed", range(TICKET_CONFIGS))
+@pytest.mark.parametrize("transport", ["tcp", "udp"])
+def test_ticketed_tag_any_fifo_under_concurrency(seed, transport):
+    """N TAG_ANY recvs posted async BEFORE any message arrives all park
+    with tickets; when the sends fire, pairing must follow posted order
+    within each eligible (length-matched) class — the posted-order FIFO
+    contract, fuzzed over message multisets that include same-length
+    duplicates (where only the ticket order decides)."""
+    rng = np.random.default_rng(900 + seed)
+    n_msgs = int(rng.integers(3, 7))
+    # sizes drawn from a small pool so duplicates are common
+    pool = [32, 32, 200, 1024]
+    counts = [int(rng.choice(pool)) for _ in range(n_msgs)]
+    payloads = [rng.standard_normal(c).astype(np.float32) for c in counts]
+    w = EmuWorld(2, max_eager=4096, rx_buf_bytes=1024, transport=transport)
+    try:
+        def body(rank, i):
+            import time
+
+            if i == 1:
+                time.sleep(0.3)  # recvs post (and ticket) first
+                for p, c in zip(payloads, counts):
+                    rank.send(p.copy(), c, dst=0, tag=TAG_ANY)
+                return None
+            outs = [np.zeros(c, np.float32) for c in counts]
+            handles = [rank.start(
+                CallOptions(scenario=Operation.recv, count=c,
+                            root_src_dst=1, tag=TAG_ANY, data_type=F32),
+                res=o) for c, o in zip(counts, outs)]
+            for h in handles:
+                rank.wait(h)
+            return outs
+
+        res = w.run(body)
+    finally:
+        w.close()
+    # FIFO within each length class: the k-th posted recv of length c
+    # gets the k-th sent message of length c
+    by_len = {}
+    for c, p in zip(counts, payloads):
+        by_len.setdefault(c, []).append(p)
+    taken = {c: 0 for c in by_len}
+    for c, out in zip(counts, res[0]):
+        expect = by_len[c][taken[c]]
+        taken[c] += 1
+        np.testing.assert_allclose(out, expect, rtol=0,
+                                   err_msg=f"seed {seed} len {c}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_mixed_jumbo_and_normal_segments_share_links(seed):
+    """A streamed collective (whole-chunk jumbo segments) interleaved
+    with small tagged p2p messages (rx-buf segments) on the SAME links:
+    message-boundary framing must keep both intact. The collective is
+    issued async so its chunks and the p2p traffic genuinely interleave
+    in the sequencer."""
+    rng = np.random.default_rng(1300 + seed)
+    world = 4
+    count = int(rng.integers(20_000, 120_000))  # rendezvous-size chunks
+    n_small = int(rng.integers(2, 5))
+    small_counts = [int(rng.integers(1, 900)) for _ in range(n_small)]
+    xs = rng.standard_normal((world, count)).astype(np.float32)
+    smalls = [rng.standard_normal(c).astype(np.float32)
+              for c in small_counts]
+    w = EmuWorld(world)
+    try:
+        def body(rank, i):
+            out = np.zeros(count, np.float32)
+            h = rank.start(
+                CallOptions(scenario=Operation.allreduce, count=count,
+                            function=int(ReduceFunction.SUM),
+                            data_type=F32), op0=xs[i].copy(), res=out)
+            # p2p to the next rank with a distinct tag while the
+            # collective's jumbo chunks stream on the same links
+            nxt, prv = (i + 1) % world, (i - 1) % world
+            got = []
+            for k, (c, p) in enumerate(zip(small_counts, smalls)):
+                sh = rank.start(
+                    CallOptions(scenario=Operation.send, count=c,
+                                root_src_dst=nxt, tag=0x7000 + k,
+                                data_type=F32), op0=p.copy())
+                rb = np.zeros(c, np.float32)
+                rh = rank.start(
+                    CallOptions(scenario=Operation.recv, count=c,
+                                root_src_dst=prv, tag=0x7000 + k,
+                                data_type=F32), res=rb)
+                rank.wait(sh)
+                rank.wait(rh)
+                got.append(rb)
+            rank.wait(h)
+            return out, got
+
+        res = w.run(body)
+    finally:
+        w.close()
+    for out, got in res:
+        np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+        for rb, p in zip(got, smalls):
+            np.testing.assert_allclose(rb, p, rtol=0)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_udp_ceiling_split_fuzz(seed):
+    """Datagram-transport collectives around the message-ceiling
+    boundary: counts at cap/4 +- 1 elements and far beyond, across
+    collectives — every chunk stream must split under max_rndzv and
+    reassemble exactly (the r4 advisory regression, fuzzed)."""
+    rng = np.random.default_rng(1700 + seed)
+    cap = int(rng.choice([4096, 65536]))
+    world = int(rng.choice([2, 4]))
+    cap_elems = cap // 4
+    count = int(rng.choice([cap_elems - 1, cap_elems, cap_elems + 1,
+                            cap_elems * world + 3, cap_elems * 7]))
+    op = str(rng.choice(["allreduce", "allgather", "alltoall"]))
+    xs = rng.standard_normal((world, count * (world if op == "alltoall"
+                                              else 1))).astype(np.float32)
+    w = EmuWorld(world, transport="udp", max_rndzv=cap)
+    try:
+        def body(rank, i):
+            if op == "allreduce":
+                out = np.zeros(count, np.float32)
+                rank.allreduce(xs[i].copy(), out, count, ReduceFunction.SUM)
+            elif op == "allgather":
+                out = np.zeros(count * world, np.float32)
+                rank.allgather(xs[i].copy(), out, count)
+            else:
+                out = np.zeros(count * world, np.float32)
+                rank.alltoall(xs[i].copy(), out, count)
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    for r, out in enumerate(res):
+        if op == "allreduce":
+            np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4,
+                                       atol=1e-4)
+        elif op == "allgather":
+            np.testing.assert_allclose(out, xs.ravel(), rtol=0)
+        else:
+            expect = xs.reshape(world, world, count)[:, r, :].ravel()
+            np.testing.assert_allclose(out, expect, rtol=0)
+
+
+if os.environ.get("ACCL_RT_FAULT_DELAY_TAIL_MS") or \
+        os.environ.get("ACCL_RT_FAULT_DROP_TAIL"):  # pragma: no cover
+    raise RuntimeError("fault levers must not leak into the environment")

@@ -216,6 +216,76 @@ def test_emu_allreduce(world4, count):
         np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("world,count", [
+    (2, 17),      # w2: one halving + one doubling step
+    (4, 329),     # odd count: uneven recursive windows
+    (4, 3),       # count < world: zero-size windows on some ranks
+    (8, 1 << 16), # above the logp crossover: ring at pow2 world
+    (3, 329),     # non-power-of-two world: ring fallback
+])
+def test_emu_allreduce_shapes(world, count):
+    """The recursive halving-doubling allreduce (pow2 worlds under the
+    latency crossover) and the streamed ring must agree with the oracle
+    across uneven windows, zero-size windows, and both shape regimes."""
+    w = EmuWorld(world)
+    try:
+        xs = RNG.standard_normal((world, count)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(count, np.float32)
+            rank.allreduce(xs[i].copy(), out, count, ReduceFunction.SUM)
+            return out
+
+        for out in w.run(body):
+            np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+    finally:
+        w.close()
+
+
+@pytest.mark.parametrize("world,count", [(4, 777), (8, 1 << 15), (3, 500)])
+def test_emu_allgather_shapes(world, count):
+    """Recursive-doubling (small pow2) and streamed-ring allgather at
+    rendezvous-size chunks (the former per-hop rendezvous handshake path
+    is gone: every size streams whole chunks eagerly)."""
+    w = EmuWorld(world)
+    try:
+        xs = RNG.standard_normal((world, count)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(count * world, np.float32)
+            rank.allgather(xs[i].copy(), out, count)
+            return out
+
+        for out in w.run(body):
+            np.testing.assert_allclose(out, xs.ravel(), rtol=0)
+    finally:
+        w.close()
+
+
+def test_emu_udp_large_collectives_split_under_ceiling():
+    """Datagram-transport collectives above max_rndzv split their chunk
+    streams into messages under the configured ceiling instead of
+    failing DMA_SIZE_ERROR (r4 advisory: the whole-chunk redesign had
+    regressed large UDP allreduces that the segmented path accepted)."""
+    count = 200_000  # 800 KB payload; 64 KB ceiling forces real splits
+    w = EmuWorld(4, transport="udp", max_rndzv=64 * 1024)
+    try:
+        xs = RNG.standard_normal((4, count)).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(count, np.float32)
+            rank.allreduce(xs[i].copy(), out, count, ReduceFunction.SUM)
+            ag = np.zeros(count * 4, np.float32)
+            rank.allgather(xs[i].copy(), ag, count)
+            return out, ag
+
+        for out, ag in w.run(body):
+            np.testing.assert_allclose(out, xs.sum(0), rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(ag, xs.ravel(), rtol=0)
+    finally:
+        w.close()
+
+
 def test_emu_allreduce_composition_register():
     """ALLREDUCE_COMPOSITION_MAX_COUNT (0x1FD8) routes rendezvous-size
     payloads through the reference's reduce+bcast composition

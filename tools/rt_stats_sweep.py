@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Per-config ACCL_RT_STATS counter sweep against the native emulator.
+
+VERDICT r4 asked for data, not guesses, on where the eager ring
+collectives spend their 2(P-1) hops: this driver runs ONE
+(collective, bytes, world, transport) config per child process with
+ACCL_RT_STATS=1, parses each rank runtime's counter line
+(passes/parks/park_ms/seek_hit/seek_miss, printed at destroy,
+native/src/runtime.cpp), and writes accl_log/rt_stats.csv with the
+measured per-call seconds alongside — so a regression or a fix shows up
+as counters AND time in the same row.
+
+Run before and after a data-plane change; commit the CSV with the sweep
+it explains.
+"""
+
+import argparse
+import csv
+import pathlib
+import re
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+CHILD = r"""
+import sys, time
+import numpy as np
+sys.path.insert(0, sys.argv[1])
+from accl_tpu import ReduceFunction
+from accl_tpu.device.emu_device import EmuWorld
+
+name, transport = sys.argv[2], sys.argv[5]
+nbytes, world, iters = int(sys.argv[3]), int(sys.argv[4]), int(sys.argv[6])
+count = nbytes // 4
+w = EmuWorld(world, max_eager=4096, rx_buf_bytes=4096, transport=transport)
+try:
+    def body(rank, i):
+        x = np.ones(count, np.float32)
+        out = np.zeros(count * (world if name == "allgather" else 1),
+                       np.float32)
+        rank.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            if name == "allreduce":
+                rank.allreduce(x, out, count, ReduceFunction.SUM)
+            elif name == "bcast":
+                rank.bcast(x, count, root=0)
+            elif name == "reduce":
+                rank.reduce(x, out, count, 0, ReduceFunction.SUM)
+            elif name == "gather":
+                gout = np.zeros(count * world, np.float32)
+                rank.gather(x, gout, count, 0)
+            elif name == "reduce_scatter":
+                rsout = np.zeros(max(count // world, 1), np.float32)
+                rank.reduce_scatter(x, rsout, max(count // world, 1),
+                                    ReduceFunction.SUM)
+            else:
+                rank.allgather(x, out, count)
+        return (time.perf_counter() - t0) / iters
+    secs = max(w.run(body))
+    print(f"SECONDS {secs:.6e}", file=sys.stderr)
+finally:
+    w.close()
+"""
+
+STAT_RE = re.compile(
+    r"\[r(\d+)\] stats: passes=(\d+) parks=(\d+) park_ms=([\d.]+) "
+    r"seek_hit=(\d+) seek_miss=(\d+)")
+
+
+def run_config(name, nbytes, world, transport, iters):
+    import os
+
+    env = dict(os.environ)
+    env["ACCL_RT_STATS"] = "1"
+    r = subprocess.run([sys.executable, "-c", CHILD, str(REPO), name,
+                        str(nbytes), str(world), transport, str(iters)],
+                       env=env, capture_output=True, text=True, timeout=600)
+    if r.returncode != 0:
+        print(f"  {name} {nbytes}B w{world} {transport}: FAILED\n"
+              f"{r.stderr[-2000:]}", file=sys.stderr)
+        return None
+    secs = None
+    ranks = []
+    for line in r.stderr.splitlines():
+        m = STAT_RE.search(line)
+        if m:
+            ranks.append(tuple(int(x) if i != 3 else float(x)
+                               for i, x in enumerate(m.groups())))
+        elif line.startswith("SECONDS"):
+            secs = float(line.split()[1])
+    if secs is None or not ranks:
+        print(f"  {name} {nbytes}B w{world}: no stats parsed",
+              file=sys.stderr)
+        return None
+    # aggregate across ranks: totals tell the story (parks and seek
+    # misses are the per-hop fixed costs; park_ms the latency paid)
+    tot = [sum(r[i] for r in ranks) for i in range(1, 6)]
+    return (name, nbytes, world, transport, iters, secs, *tot)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="rt_stats.csv")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--transport", default="tcp", choices=("tcp", "udp"))
+    ap.add_argument("--worlds", default="8")
+    ap.add_argument("--collectives", default="allreduce,bcast,allgather")
+    ap.add_argument("--sizes", default="65536,1048576,4194304")
+    ap.add_argument("--shape", default="", choices=("", "ring", "logp"),
+                    help="force the allreduce/allgather hop shape via "
+                         "ACCL_RT_SHAPE in the child (crossover "
+                         "calibration)")
+    args = ap.parse_args()
+
+    import os
+
+    if args.shape:
+        os.environ["ACCL_RT_SHAPE"] = args.shape
+
+    rows = []
+    for world in [int(w) for w in args.worlds.split(",")]:
+        for name in args.collectives.split(","):
+            for nbytes in [int(s) for s in args.sizes.split(",")]:
+                row = run_config(name, nbytes, world, args.transport,
+                                 args.iters)
+                if row:
+                    rows.append(row)
+                    (n, b, w, t, it, s, passes, parks, park_ms, hit,
+                     miss) = row
+                    print(f"  {n:13s} {b:>9d}B w{w} {s*1e3:9.2f} ms/call"
+                          f"  passes={passes} parks={parks}"
+                          f" park_ms={park_ms:.1f} seek_hit={hit}"
+                          f" seek_miss={miss}", file=sys.stderr)
+
+    out = REPO / "accl_log" / args.out
+    shape = args.shape or "auto"
+    with open(out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["Collective", "Bytes", "World", "Transport", "Iters",
+                    "SecondsPerCall", "Passes", "Parks", "ParkMs",
+                    "SeekHit", "SeekMiss", "Shape"])
+        w.writerows([(*r, shape) for r in rows])
+    print(f"wrote {out} ({len(rows)} rows)")
+
+
+if __name__ == "__main__":
+    main()
