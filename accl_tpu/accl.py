@@ -703,8 +703,19 @@ class ACCL:
                 link = LinkParams(alpha=t["dispatch_alpha_us"] * 1e-6,
                                   beta=t["hbm_stream_gbps"] * 1e9)
             else:
-                link = LinkParams(alpha=model["link"]["alpha_us"] * 1e-6,
-                                  beta=model["link"]["beta_gbps"] * 1e9)
+                # per-collective models tune from the bcast link (the
+                # root-serialized collective whose aggregate and
+                # critical-path shapes coincide, so its alpha/beta are
+                # genuine per-message/per-byte host costs); single-link
+                # models keep the legacy key
+                lk = (model.get("link_per_collective", {}).get("bcast")
+                      or model.get("link"))
+                if not lk:
+                    raise ValueError(
+                        "timing model has neither link_per_collective "
+                        "nor link; re-run tools/timing_model.py")
+                link = LinkParams(alpha=lk["alpha_us"] * 1e-6,
+                                  beta=lk["beta_gbps"] * 1e9)
         cross = tuning_crossovers(link, world=self.world)
         tuning = TuningParams.from_crossovers(cross)
         self.configure_tuning_parameters(tuning)

@@ -30,11 +30,18 @@ def plan_for(op, count, world, max_eager=4096):
 
 
 def test_coefficients_mirror_schedule_structure():
-    # eager ring allreduce: 2(P-1) chunk steps
+    # small pow2-world allreduce rides recursive halving-doubling on the
+    # native executor (runtime.cpp logp_max_bytes): 2*log2(P) exchange
+    # steps moving the same 2n(P-1)/P volume
     p = plan_for(Operation.allreduce, 512, 4)
     assert p.algorithm == Algorithm.EAGER_RING_RS_AG
     m, b = coefficients(Operation.allreduce, p, 512, 4, 4, rx_buf_bytes=RX)
-    assert m == 2 * 3 * 1 and b == pytest.approx(2 * 3 * 512)
+    assert m == 2 * 2 and b == pytest.approx(2 * 3 * 512)
+    # above the latency crossover the 2(P-1)-hop ring takes over
+    big = 1 << 18  # 1 MB > 8 hops saved x 32 KB
+    p = plan_for(Operation.allreduce, big, 4)
+    m, b = coefficients(Operation.allreduce, p, big, 4, 4, rx_buf_bytes=RX)
+    assert m == 2 * 3 and b == pytest.approx(2 * 3 * big)
     # rendezvous binary-tree bcast: ceil(log2 P) rounds of full payload
     p = plan_for(Operation.bcast, 50_000, 8)
     assert p.algorithm == Algorithm.RNDZV_BIN_TREE
