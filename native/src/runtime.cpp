@@ -17,6 +17,7 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -331,6 +332,9 @@ struct CollState {
   // call's first eager pass, dropped with the registry entry on terminal
   uint64_t ticket = 0;
   bool ticketed = false;
+  // direct-placement landing registered for the CURRENT recv op (see
+  // accl_rt::EagerLanding); cleared when the op completes
+  bool landing = false;
   // Config/tuning SNAPSHOT taken on the call's first pass: the replayed
   // op sequence must be deterministic, and a config call (or tuning
   // register write) executing between requeue passes of a parked
@@ -440,6 +444,53 @@ struct accl_rt {
   };
   std::vector<OutstandingRecv> outstanding_recvs;
   uint64_t recv_ticket_next = 0;
+
+  // Direct-placement eager landing (rxbuf bypass): a parked strict recv
+  // registers its destination so the rx thread reads subsequent
+  // segments of ITS message straight into the final buffer — no slot
+  // allocation, no staging copy. The eager-path analog of the
+  // reference's zero-copy rendezvous write (rendezvous lands at the
+  // posted vaddr), sized for the streamed whole-chunk collectives where
+  // the bytes are. TCP only: the ordered link guarantees the next
+  // segments are the message's continuation; datagram reordering keeps
+  // the slot path. Guarded by rx_mu; `in_use` pins the buffer while the
+  // rx thread is mid-read (revocation waits on it).
+  struct EagerLanding {
+    uint8_t *base = nullptr;
+    uint64_t want = 0, landed = 0;
+    uint32_t tag = 0;
+    bool in_use = false;  // rx thread mid-read into base
+    bool abort = false;   // revoker asked the rx thread to let go
+    const void *tok = nullptr;
+  };
+  std::unordered_map<uint32_t, EagerLanding> eager_landings;  // by src
+
+  // Remove a call's landings (rx_mu held via lk). An in-flight direct
+  // read is asked to let go via `abort`; the rx thread's read loop is
+  // poll-bounded (it re-checks under rx_mu at least every 100 ms even
+  // against a frozen peer), acknowledges by clearing in_use and
+  // diverting the rest of the segment to scratch, so this wait is
+  // BOUNDED — the sequencer cannot wedge behind a dead link the way an
+  // unbounded recv_all wait would. A partially-landed message arms the
+  // orphan drain for its tail. The cv wait releases the lock, so the
+  // scan restarts after every wakeup (iterators don't survive the gap).
+  void drop_landings_locked(std::unique_lock<std::mutex> &lk,
+                            const void *tok) {
+    for (;;) {
+      auto it = eager_landings.begin();
+      for (; it != eager_landings.end(); ++it)
+        if (it->second.tok == tok) break;
+      if (it == eager_landings.end()) return;
+      if (it->second.in_use) {
+        it->second.abort = true;
+        rx_cv.wait_for(lk, std::chrono::milliseconds(250));
+        continue;
+      }
+      if (it->second.landed > 0 && it->second.landed < it->second.want)
+        rx_drain_srcs.insert(it->first);
+      eager_landings.erase(it);
+    }
+  }
   // srcs whose seqn head may hold orphaned continuation segments of a
   // message whose recv died mid-consumption: seek discards segments with
   // msg_off != 0 until the next message head surfaces. Guarded by rx_mu.
@@ -450,7 +501,8 @@ struct accl_rt {
   // defer younger recvs forever). An ownership entry still present here
   // means the call died mid-message — arm the orphan drain for that src.
   void release_rx_ownership(const void *tok) {
-    std::lock_guard<std::mutex> lk(rx_mu);
+    std::unique_lock<std::mutex> lk(rx_mu);
+    drop_landings_locked(lk, tok);
     for (auto it = rx_stream_owner.begin(); it != rx_stream_owner.end();) {
       if (it->second == tok) {
         rx_drain_srcs.insert(it->first);
@@ -758,11 +810,102 @@ struct accl_rt {
           fprintf(stderr, "[r%u] RX BAD MAGIC peer=%u\n", rank, peer);
         return;
       }
+      // this is PEER's session socket: a frame claiming any other src is
+      // forged or corrupt — drop the link before any src-indexed state
+      // (inbound_seq, src_valid_count, landings) is touched
+      if (h.src != peer) {
+        if (getenv("ACCL_RT_DEBUG"))
+          fprintf(stderr, "[r%u] RX BAD SRC %u on link peer=%u\n", rank,
+                  h.src, peer);
+        return;
+      }
       if (getenv("ACCL_RT_DEBUG"))
         fprintf(stderr, "[r%u] rx mt=%u from=%u\n", rank, h.msg_type, h.src);
       size_t plen = 0;
       if (h.msg_type == MSG_EGR_DATA || h.msg_type == MSG_RNDZV_WRITE)
         plen = (size_t)h.bytes;
+      // Direct placement: a registered landing whose message this
+      // segment continues takes the payload straight off the socket
+      // into the final buffer — no slot, no staging copy. Eligible only
+      // when this segment is the next seqn with nothing queued before
+      // it (the ordered link makes that exact). `in_use` pins the
+      // destination across the unlocked read; revocation waits on it.
+      if (h.msg_type == MSG_EGR_DATA && plen) {
+        uint8_t *dest = nullptr;
+        std::unique_lock<std::mutex> lk(rx_mu);
+        auto lnd = eager_landings.find(h.src);
+        if (lnd != eager_landings.end() && !lnd->second.in_use &&
+            !lnd->second.abort &&
+            h.seqn == inbound_seq[h.src] && src_valid_count[h.src] == 0 &&
+            !rx_drain_srcs.count(h.src) &&
+            (lnd->second.tag == TAG_ANY || h.tag == TAG_ANY ||
+             lnd->second.tag == h.tag) &&
+            h.msg_bytes == lnd->second.want &&
+            h.msg_off == lnd->second.landed &&
+            h.bytes <= lnd->second.want - lnd->second.landed) {
+          lnd->second.in_use = true;
+          dest = lnd->second.base + lnd->second.landed;
+        }
+        if (dest) {
+          // Poll-bounded direct read: between slices the loop re-checks
+          // the landing under rx_mu, so a revoking sequencer is never
+          // blocked behind a frozen peer — on abort the destination
+          // diverts to scratch (the segment must still be consumed to
+          // keep the byte stream framed) and in_use clears immediately,
+          // releasing the caller's buffer.
+          lk.unlock();
+          std::vector<uint8_t> scratch;
+          bool diverted = false, dead = false;
+          size_t off = 0;
+          while (off < plen && !stop.load()) {
+            struct pollfd pf{peer_fd[peer], POLLIN, 0};
+            int pr = poll(&pf, 1, 100);
+            bool ack_needed;
+            {
+              std::lock_guard<std::mutex> g(rx_mu);
+              auto it2 = eager_landings.find(h.src);
+              ack_needed = !diverted &&
+                           (it2 == eager_landings.end() || it2->second.abort);
+            }
+            if (ack_needed) {
+              scratch.resize(plen);
+              if (off) std::memcpy(scratch.data(), dest, off);
+              diverted = true;
+              std::lock_guard<std::mutex> g(rx_mu);
+              auto it2 = eager_landings.find(h.src);
+              if (it2 != eager_landings.end()) it2->second.in_use = false;
+              rx_cv.notify_all();
+            }
+            if (pr <= 0) continue;
+            uint8_t *tgt = diverted ? scratch.data() : dest;
+            ssize_t r = ::recv(peer_fd[peer], tgt + off, plen - off, 0);
+            if (r <= 0) {
+              dead = true;
+              break;
+            }
+            off += (size_t)r;
+          }
+          lk.lock();
+          lnd = eager_landings.find(h.src);  // may have been erased
+          if (!diverted && lnd != eager_landings.end())
+            lnd->second.in_use = false;
+          if (dead || stop.load() || off < plen) {
+            rx_cv.notify_all();
+            return;
+          }
+          if (!diverted && lnd != eager_landings.end()) {
+            lnd->second.landed += plen;
+          } else if (diverted && h.msg_off + plen < h.msg_bytes) {
+            // consumed-to-void mid-message: the rest of the dying
+            // message is orphan tail whatever the revoker saw (it may
+            // have observed landed == 0 and skipped arming)
+            rx_drain_srcs.insert(h.src);
+          }
+          inbound_seq[h.src] = h.seqn + 1;
+          rx_event();
+          continue;
+        }
+      }
       payload.resize(plen);
       if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
       switch (h.msg_type) {
@@ -1300,7 +1443,17 @@ struct accl_rt {
             }
           }
         }
+        // Direct-placement sync: a registered landing accumulates
+        // progress from the rx thread; fold it into st.off (which also
+        // re-arms the call deadline) before falling through to the
+        // slot path — segments that landed in slots while the landing
+        // was ineligible (other traffic queued ahead) still merge here.
+        auto itl = st.landing ? rt.eager_landings.find(gsrc)
+                              : rt.eager_landings.end();
+        if (itl != rt.eager_landings.end() && itl->second.tok == tok)
+          st.off = itl->second.landed;
         for (;;) {
+          if (st.off >= n && n > 0) break;
           uint64_t got = 0;
           uint32_t rc = rt.seek_locked(gsrc, tag, p ? p + st.off : nullptr,
                                        n - st.off, &got, strict,
@@ -1309,10 +1462,35 @@ struct accl_rt {
           if (rc != NO_ERROR) {  // NOT_READY keeps st.off progress
             if (rc == NOT_READY && st.off > 0 && st.off < n)
               rt.rx_stream_owner[gsrc] = tok;  // mid-message: claim
+            if (rc == NOT_READY && strict && !rt.udp_mode && p && n > 0 &&
+                !st.landing &&
+                rt.eager_landings.find(gsrc) == rt.eager_landings.end() &&
+                n >= (64ull << 10)) {
+              // threshold: only chunks big enough that the saved
+              // staging copy + slot allocation outweigh the
+              // registration round trips (small logp hops measured
+              // slower with landings at 2*rx_buf)
+              // register direct placement for the remainder: the rx
+              // thread reads our message's further segments straight
+              // into p (rxbuf bypass; see EagerLanding)
+              rt.eager_landings[gsrc] =
+                  EagerLanding{p, n, st.off, tag, /*in_use=*/false,
+                               /*abort=*/false, tok};
+              st.landing = true;
+            }
             return rc;
           }
           st.off += got;
+          if (itl != rt.eager_landings.end() && itl->second.tok == tok)
+            itl->second.landed = st.off;  // keep the rx thread's
+                                          // msg_off expectation exact
           if (st.off >= n) break;  // n == 0: one zero-length segment
+        }
+        if (st.landing) {
+          auto drop = rt.eager_landings.find(gsrc);
+          if (drop != rt.eager_landings.end() && drop->second.tok == tok)
+            rt.eager_landings.erase(drop);
+          st.landing = false;
         }
         st.off = 0;
         auto own = rt.rx_stream_owner.find(gsrc);

@@ -80,6 +80,51 @@ def test_orphan_drain_after_mid_message_death(fault_env, segs, m2_count):
     np.testing.assert_allclose(res[0], m2, rtol=0)
 
 
+def test_landing_revocation_mid_message(fault_env):
+    """Direct-placement landing + mid-message death: a strict collective
+    recv big enough to register a landing (>= 64 KB) loses its delayed
+    tail past the deadline. The revocation path must drop the landing
+    without freeing the buffer under the rx thread, arm the orphan
+    drain for the stale tail, and leave the link usable for the next
+    collective on it."""
+    fault_env(ACCL_RT_FAULT_DELAY_TAIL_MS=700)
+    count = 400_000  # 1.6 MB: two jumbo segments, tail delayed
+    m2_count = 5000
+    x1 = RNG.standard_normal(count).astype(np.float32)
+    x2 = RNG.standard_normal(m2_count).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 24, rx_buf_bytes=4096)
+    try:
+        def body(rank, i):
+            import time
+
+            if i == 1:
+                rank.bcast(x1.copy(), count, root=1)  # tail delayed
+                time.sleep(1.0)  # tail lands (as orphan) before M2
+                rank.bcast(x2.copy(), m2_count, root=1)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=300))
+            buf = np.zeros(count, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.bcast,
+                                       count=count, root_src_dst=1,
+                                       data_type=F32), op0=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=5000))
+            out = np.zeros(m2_count, np.float32)
+            rank.call(CallOptions(scenario=Operation.bcast, count=m2_count,
+                                  root_src_dst=1, data_type=F32), op0=out)
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    np.testing.assert_allclose(res[0], x2, rtol=0)
+
+
 def test_udp_lost_tail_is_a_clean_timeout(fault_env):
     """Datagram loss of a message's final segment: the seqn gap must
     surface as RECEIVE_TIMEOUT on the consumer — never as corrupt data
