@@ -75,6 +75,34 @@ def _sample_configs():
         configs.append((N_CONFIGS + 4 + j, Operation.allreduce, 4, 900,
                         ReduceFunction.SUM, 1024, 32 * 1024, True, 0, "tcp",
                         np.float32, wire))
+    # pinned LARGE streamed lanes: the r5 native data plane switches
+    # shape with size (recursive halving-doubling under the latency
+    # crossover, whole-chunk streamed rings above it, >= 64 KB recvs
+    # through the zero-copy landing path) — the differential harness
+    # must cross those boundaries, not just the sub-10 KB random draws
+    # (op, world, count, max_eager): boundaries checked against the
+    # native routing rules — logp_max_bytes(8)=256 KiB, landing
+    # threshold 64 KiB per hop chunk, rndzv(n) = n > max_eager
+    for j, (op, world, count, max_eager) in enumerate([
+        # 600 KB > the w8 crossover -> streamed RING, 75 KB hop chunks
+        # > the 64 KB landing threshold -> zero-copy landings
+        (Operation.allreduce, 8, 150_000, 1024),
+        (Operation.allreduce, 4, 9_000, 1024),   # halving-doubling regime
+        (Operation.allreduce, 6, 90_000, 1024),  # non-pow2 ring
+        # 200 KB chunks: streamed ring + landings (above the 448 KB
+        # total doubling crossover at w8)
+        (Operation.allgather, 8, 50_000, 1024),
+        (Operation.allgather, 4, 3_000, 1024),   # recursive doubling
+        # large max_eager keeps these on the r5 EAGER streamed paths
+        # (with 1024 they would route rendezvous, which the random
+        # draws already cover)
+        (Operation.reduce_scatter, 4, 30_000, 1 << 24),
+        (Operation.alltoall, 4, 40_000, 1 << 24),
+        (Operation.gather, 4, 50_000, 1 << 24),  # streamed daisy chain
+    ]):
+        configs.append((N_CONFIGS + 6 + j, op, world, count,
+                        ReduceFunction.SUM, max_eager, 32 * 1024, False, 0,
+                        "tcp", np.float32, ""))
     return configs
 
 
