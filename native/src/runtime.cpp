@@ -26,6 +26,7 @@
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -299,6 +300,12 @@ struct RndzvAddr {
   uint64_t bytes;
   uint32_t tag;
   uint32_t host;
+  // one-sided writes land DIRECTLY at vaddr (no staging copy): in_use
+  // pins the target across the rx thread's poll-bounded read; abort is
+  // the revoker's bounded-wait handshake (same protocol as
+  // accl_rt::EagerLanding). Only meaningful inside posted_addrs.
+  bool in_use = false;
+  bool abort = false;
 };
 
 struct RndzvDone {
@@ -796,6 +803,42 @@ struct accl_rt {
     }
   }
 
+  // Poll-bounded pinned read shared by BOTH zero-copy landing paths
+  // (eager landings and rendezvous one-sided writes): read `plen` bytes
+  // from fd into `dest`, consulting `still_pinned()` between 100 ms
+  // slices — when it reports the pin is gone (revocation), the
+  // remainder diverts to scratch (the byte stream must stay framed) and
+  // `ack_divert()` runs exactly once to release the buffer and wake the
+  // bounded-waiting revoker. Returns false on link death / stop;
+  // `*diverted_out` reports whether the payload was consumed-to-void.
+  bool pinned_read(int fd, uint8_t *dest, size_t plen,
+                   const std::function<bool()> &still_pinned,
+                   const std::function<void()> &ack_divert,
+                   bool *diverted_out) {
+    std::vector<uint8_t> scratch;
+    bool diverted = false;
+    size_t off = 0;
+    while (off < plen && !stop.load()) {
+      struct pollfd pf{fd, POLLIN, 0};
+      int pr = poll(&pf, 1, 100);
+      if (!diverted && !still_pinned()) {
+        scratch.resize(plen);
+        diverted = true;
+        ack_divert();
+      }
+      if (pr <= 0) continue;
+      uint8_t *tgt = diverted ? scratch.data() : dest;
+      ssize_t r = ::recv(fd, tgt + off, plen - off, 0);
+      if (r <= 0) {
+        *diverted_out = diverted;
+        return false;
+      }
+      off += (size_t)r;
+    }
+    *diverted_out = diverted;
+    return off >= plen;
+  }
+
   void rx_loop(uint32_t peer) {
     std::vector<uint8_t> payload;
     while (!stop.load()) {
@@ -847,49 +890,27 @@ struct accl_rt {
           dest = lnd->second.base + lnd->second.landed;
         }
         if (dest) {
-          // Poll-bounded direct read: between slices the loop re-checks
-          // the landing under rx_mu, so a revoking sequencer is never
-          // blocked behind a frozen peer — on abort the destination
-          // diverts to scratch (the segment must still be consumed to
-          // keep the byte stream framed) and in_use clears immediately,
-          // releasing the caller's buffer.
           lk.unlock();
-          std::vector<uint8_t> scratch;
-          bool diverted = false, dead = false;
-          size_t off = 0;
-          while (off < plen && !stop.load()) {
-            struct pollfd pf{peer_fd[peer], POLLIN, 0};
-            int pr = poll(&pf, 1, 100);
-            bool ack_needed;
-            {
-              std::lock_guard<std::mutex> g(rx_mu);
-              auto it2 = eager_landings.find(h.src);
-              ack_needed = !diverted &&
-                           (it2 == eager_landings.end() || it2->second.abort);
-            }
-            if (ack_needed) {
-              scratch.resize(plen);
-              if (off) std::memcpy(scratch.data(), dest, off);
-              diverted = true;
-              std::lock_guard<std::mutex> g(rx_mu);
-              auto it2 = eager_landings.find(h.src);
-              if (it2 != eager_landings.end()) it2->second.in_use = false;
-              rx_cv.notify_all();
-            }
-            if (pr <= 0) continue;
-            uint8_t *tgt = diverted ? scratch.data() : dest;
-            ssize_t r = ::recv(peer_fd[peer], tgt + off, plen - off, 0);
-            if (r <= 0) {
-              dead = true;
-              break;
-            }
-            off += (size_t)r;
-          }
+          bool diverted = false;
+          bool ok = pinned_read(
+              peer_fd[peer], dest, plen,
+              [&] {
+                std::lock_guard<std::mutex> g(rx_mu);
+                auto it2 = eager_landings.find(h.src);
+                return it2 != eager_landings.end() && !it2->second.abort;
+              },
+              [&] {
+                std::lock_guard<std::mutex> g(rx_mu);
+                auto it2 = eager_landings.find(h.src);
+                if (it2 != eager_landings.end()) it2->second.in_use = false;
+                rx_cv.notify_all();
+              },
+              &diverted);
           lk.lock();
           lnd = eager_landings.find(h.src);  // may have been erased
           if (!diverted && lnd != eager_landings.end())
             lnd->second.in_use = false;
-          if (dead || stop.load() || off < plen) {
+          if (!ok || stop.load()) {
             rx_cv.notify_all();
             return;
           }
@@ -902,6 +923,75 @@ struct accl_rt {
             rx_drain_srcs.insert(h.src);
           }
           inbound_seq[h.src] = h.seqn + 1;
+          rx_event();
+          continue;
+        }
+      }
+      // One-sided writes land DIRECTLY at the posted vaddr — the
+      // zero-copy semantics the rendezvous protocol promises (the old
+      // path staged through `payload` then memcpy'd). Same poll-bounded
+      // pin/abort protocol as the eager landings: in_use pins the
+      // target, revocation flips abort and the read diverts to scratch
+      // within one 100 ms slice, so a timed-out caller's buffer is
+      // never written after revocation returns.
+      if (h.msg_type == MSG_RNDZV_WRITE && plen) {
+        uint8_t *dest = nullptr;
+        {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          for (auto &pa : posted_addrs) {
+            if (pa.vaddr == h.vaddr && pa.src == h.src &&
+                pa.bytes == h.bytes && !pa.in_use && !pa.abort) {
+              pa.in_use = true;
+              dest = (uint8_t *)(uintptr_t)h.vaddr;
+              break;
+            }
+          }
+        }
+        if (dest) {
+          auto find_mine = [&]() -> RndzvAddr * {
+            for (auto &pa : posted_addrs)
+              if (pa.vaddr == h.vaddr && pa.src == h.src &&
+                  pa.bytes == h.bytes && pa.in_use)
+                return &pa;
+            return nullptr;
+          };
+          bool diverted = false;
+          bool ok = pinned_read(
+              peer_fd[peer], dest, plen,
+              [&] {
+                std::lock_guard<std::mutex> g(rndzv_mu);
+                RndzvAddr *pa = find_mine();
+                return pa != nullptr && !pa->abort;
+              },
+              [&] {
+                std::lock_guard<std::mutex> g(rndzv_mu);
+                RndzvAddr *pa = find_mine();
+                if (pa) pa->in_use = false;
+                rndzv_cv.notify_all();
+              },
+              &diverted);
+          {
+            std::lock_guard<std::mutex> g(rndzv_mu);
+            RndzvAddr *pa = find_mine();
+            if (pa) pa->in_use = false;
+            if (!ok || stop.load()) {
+              rndzv_cv.notify_all();
+            } else if (!diverted && pa) {
+              // completed write: consume the posting, publish completion
+              for (auto it = posted_addrs.begin(); it != posted_addrs.end();
+                   ++it) {
+                if (&*it == pa) {
+                  posted_addrs.erase(it);
+                  break;
+                }
+              }
+              done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+              rndzv_cv.notify_all();
+            }
+            // diverted: revoked mid-write — consumed-to-void, no
+            // completion (the reference's late-write drop semantics)
+          }
+          if (!ok || stop.load()) return;
           rx_event();
           continue;
         }
@@ -1193,14 +1283,28 @@ struct accl_rt {
   // rndzv_mu HELD on timeout/error revocation, so a late write cannot
   // land in a buffer the caller is about to free. Erases at most one
   // entry so other in-flight recvs keep their postings.
-  void revoke_posted_locked(uint32_t src, uint64_t vaddr, uint64_t bytes,
-                            uint32_t tag) {
-    for (auto it = posted_addrs.begin(); it != posted_addrs.end(); ++it) {
-      if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
-          (tag == TAG_ANY || it->tag == tag)) {
-        posted_addrs.erase(it);
-        return;
+  // rndzv_mu held via lk. An in-flight direct write is asked to let go
+  // (abort) and the wait is BOUNDED: the rx thread's read loop
+  // re-checks the posting at least every 100 ms and acknowledges by
+  // clearing in_use, diverting the rest of the payload to scratch — the
+  // target buffer is never written after this returns. The cv wait
+  // drops the lock, so the scan restarts after each wakeup.
+  void revoke_posted_locked(std::unique_lock<std::mutex> &lk, uint32_t src,
+                            uint64_t vaddr, uint64_t bytes, uint32_t tag) {
+    for (;;) {
+      auto it = posted_addrs.begin();
+      for (; it != posted_addrs.end(); ++it)
+        if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
+            (tag == TAG_ANY || it->tag == tag))
+          break;
+      if (it == posted_addrs.end()) return;
+      if (it->in_use) {
+        it->abort = true;
+        rndzv_cv.wait_for(lk, std::chrono::milliseconds(250));
+        continue;
       }
+      posted_addrs.erase(it);
+      return;
     }
   }
 
@@ -2104,9 +2208,9 @@ struct accl_rt {
   // too, or a future recv posting the same (src, vaddr, bytes, tag)
   // would be falsely satisfied by stale data.
   void revoke_call_postings(Call &c) {
-    std::lock_guard<std::mutex> g(rndzv_mu);
+    std::unique_lock<std::mutex> g(rndzv_mu);
     for (auto &pa : c.cstate->posted) {
-      revoke_posted_locked(pa.src, pa.vaddr, pa.bytes, pa.tag);
+      revoke_posted_locked(g, pa.src, pa.vaddr, pa.bytes, pa.tag);
       for (auto it = done_q.begin(); it != done_q.end();) {
         if (it->src == pa.src && it->vaddr == pa.vaddr &&
             it->bytes == pa.bytes &&

@@ -89,8 +89,8 @@ def _sample_configs():
         (Operation.allreduce, 8, 150_000, 1024),
         (Operation.allreduce, 4, 9_000, 1024),   # halving-doubling regime
         (Operation.allreduce, 6, 90_000, 1024),  # non-pow2 ring
-        # 200 KB chunks: streamed ring + landings (above the 448 KB
-        # total doubling crossover at w8)
+        # 200 KB chunks: streamed ring + landings (above the 512 KiB
+        # total doubling crossover: logp_ag_max_bytes(8) = 4 * 128 KiB)
         (Operation.allgather, 8, 50_000, 1024),
         (Operation.allgather, 4, 3_000, 1024),   # recursive doubling
         # large max_eager keeps these on the r5 EAGER streamed paths
