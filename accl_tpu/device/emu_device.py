@@ -129,7 +129,10 @@ class EmuRank:
         self.rank = rank
         self.transport = transport
         arr = (ctypes.c_uint16 * world)(*ports)
-        tr = {"tcp": 0, "udp": 1}[transport]
+        # "local" is the intra-process POE (direct-call delivery, no
+        # sockets): the intra-node fast-path transport beside the TCP
+        # session mesh and the datagram POE
+        tr = {"tcp": 0, "udp": 1, "local": 2}[transport]
         self._rt = lib.accl_rt_create_ex(
             world, rank, arr, n_rx_bufs, rx_buf_bytes, max_eager, max_rndzv,
             tr,

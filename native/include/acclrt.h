@@ -35,6 +35,9 @@ typedef struct accl_rt accl_rt_t;
 enum accl_rt_transport {
   ACCL_RT_TRANSPORT_TCP = 0,
   ACCL_RT_TRANSPORT_UDP = 1,
+  /* intra-process POE: same-process ranks deliver frames by direct
+     call (no sockets) — the intra-node fast-path transport */
+  ACCL_RT_TRANSPORT_LOCAL = 2,
 };
 
 /* Create a rank runtime. ports[world] lists each rank's port on

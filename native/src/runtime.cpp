@@ -75,6 +75,7 @@ enum Addr : uint32_t {
 constexpr uint32_t TAG_ANY = 0xFFFFFFFFu;
 constexpr uint32_t EXCHMEM_BYTES = 8192;
 
+
 // Scenario ids (constants.hpp:190-216).
 enum Scenario : uint32_t {
   SC_CONFIG = 0, SC_COPY = 1, SC_COMBINE = 2, SC_SEND = 3, SC_RECV = 4,
@@ -395,6 +396,19 @@ struct Completion {
 
 }  // namespace
 
+// ----- local (intra-process) POE registry ----------------------------------
+// A third protocol-offload engine beside the TCP session mesh and the
+// sessionless datagram POE: ranks living in one process (EmuWorld's
+// threads — the emulator's normal shape) deliver frames by direct call
+// into the peer runtime, no sockets and no kernel copies — the
+// intra-node fast-path role NCCL fills with SHM/P2P transports. The
+// registry maps each rank's nominal port to its runtime; `local_refs`
+// pins a peer across one delivery so destroy cannot free it mid-call.
+struct accl_rt;
+static std::mutex g_local_mu;
+static std::condition_variable g_local_cv;
+static std::unordered_map<uint16_t, accl_rt *> g_local_ports;
+
 struct accl_rt {
   uint32_t world, rank;
   uint32_t rx_buf_bytes, max_eager;
@@ -585,6 +599,14 @@ struct accl_rt {
   std::vector<std::thread> fault_threads;
   std::mutex fault_mu;
 
+  // local (intra-process) POE state: my nominal port, the world's port
+  // map, and the pin count of in-flight deliveries INTO this runtime
+  // (guarded by g_local_mu)
+  bool local_mode = false;
+  uint16_t local_port = 0;
+  std::vector<uint16_t> local_ports_vec;
+  int local_refs = 0;
+
   // Generation counter of rx-side progress events (eager landings,
   // rendezvous addresses/completions): the sequencer snapshots it before
   // an execute pass and parks a NOT_READY call ONLY if no event arrived
@@ -665,6 +687,82 @@ struct accl_rt {
   }
 
   // ----- transport -----
+
+  // Local-POE ingress: the SENDER's thread runs this against the
+  // receiving runtime (no rx threads exist in local mode). The caller
+  // holds none of ITS OWN locks (every frame_out site releases first),
+  // so taking this runtime's rx/rndzv locks cannot deadlock.
+  bool local_deliver(const MsgHeader &h, const uint8_t *payload,
+                     size_t plen) {
+    if (stop.load()) return false;
+    switch (h.msg_type) {
+      case MSG_EGR_DATA: {
+        {
+          // direct landing (zero-copy for the consumer): same
+          // eligibility as the TCP rx path, but the copy happens right
+          // here under rx_mu — in-process memcpy, no staging
+          std::lock_guard<std::mutex> lk(rx_mu);
+          auto lnd = eager_landings.find(h.src);
+          if (lnd != eager_landings.end() && !lnd->second.in_use &&
+              !lnd->second.abort && h.seqn == inbound_seq[h.src] &&
+              src_valid_count[h.src] == 0 && !rx_drain_srcs.count(h.src) &&
+              (lnd->second.tag == TAG_ANY || h.tag == TAG_ANY ||
+               lnd->second.tag == h.tag) &&
+              h.msg_bytes == lnd->second.want &&
+              h.msg_off == lnd->second.landed &&
+              h.bytes <= lnd->second.want - lnd->second.landed) {
+            if (plen)
+              std::memcpy(lnd->second.base + lnd->second.landed, payload,
+                          plen);
+            lnd->second.landed += plen;
+            inbound_seq[h.src] = h.seqn + 1;
+            rx_event();
+            return true;
+          }
+        }
+        std::vector<uint8_t> copy(payload, payload + plen);
+        if (!land_eager(h, std::move(copy), /*allow_grow=*/true))
+          return false;
+        return true;
+      }
+      case MSG_RNDZV_ADDR: {
+        {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
+          rndzv_cv.notify_all();
+        }
+        rx_event();
+        return true;
+      }
+      case MSG_RNDZV_WRITE: {
+        // validate + land + complete in one critical section (the
+        // staged TCP path's semantics; in-process the copy IS direct)
+        bool posted = false;
+        {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          for (auto it = posted_addrs.begin(); it != posted_addrs.end();
+               ++it) {
+            if (it->vaddr == h.vaddr && it->src == h.src &&
+                it->bytes == h.bytes && !it->in_use && !it->abort) {
+              if (plen)
+                std::memcpy((void *)(uintptr_t)h.vaddr, payload, plen);
+              posted_addrs.erase(it);
+              done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+              rndzv_cv.notify_all();
+              posted = true;
+              break;
+            }
+          }
+        }
+        if (posted) rx_event();
+        // unposted/revoked: dropped (late-write semantics)
+        return true;
+      }
+      default:
+        return true;  // hello traffic has no meaning in-process
+    }
+  }
+
   bool frame_out(uint32_t dst, MsgType mt, uint32_t tag, uint32_t seqn,
                  uint64_t bytes, uint64_t vaddr, const void *payload,
                  size_t payload_len, uint32_t host = 0,
@@ -681,6 +779,43 @@ struct accl_rt {
     h.vaddr = vaddr;
     h.msg_bytes = msg_bytes;
     h.msg_off = msg_off;
+    if (local_mode) {
+      // resolve + pin the peer runtime, deliver on THIS thread, unpin.
+      // Bring-up is the registry itself: a peer not yet constructed
+      // registers within the creation barrier, so wait briefly.
+      // The two g_local_mu acquisitions per frame are deliberate: the
+      // registry lock is what makes peer TEARDOWN safe (destroy
+      // deregisters, then waits refs==0 — a lock-free cached-pointer
+      // pin would race destruction between load and increment). Streamed
+      // hops are jumbo segments, so big transfers take a handful of
+      // round trips, and the measured bottleneck on the CI host is
+      // scheduler parking, not this futex.
+      accl_rt *peer_rt = nullptr;
+      {
+        std::unique_lock<std::mutex> g(g_local_mu);
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::seconds(10);
+        for (;;) {
+          auto it = g_local_ports.find(local_ports_vec[dst]);
+          if (it != g_local_ports.end()) {
+            peer_rt = it->second;
+            peer_rt->local_refs++;
+            break;
+          }
+          if (stop.load() ||
+              g_local_cv.wait_until(g, deadline) == std::cv_status::timeout)
+            return false;
+        }
+      }
+      bool ok = peer_rt->local_deliver(
+          h, (const uint8_t *)payload, payload_len);
+      {
+        std::lock_guard<std::mutex> g(g_local_mu);
+        peer_rt->local_refs--;
+        g_local_cv.notify_all();
+      }
+      return ok;
+    }
     if (udp_mode) {
       // sessionless: header + payload in one datagram (udp_packetizer
       // analog — segment == packet)
@@ -1261,8 +1396,13 @@ struct accl_rt {
                                uint64_t *vaddr) {
     std::lock_guard<std::mutex> lk(rndzv_mu);
     for (auto it = addr_q.begin(); it != addr_q.end(); ++it) {
+      // wildcard on EITHER side matches, mirroring the eager seek's
+      // (tag==ANY || slot==ANY || equal) rule: a TAG_ANY recv's posted
+      // address must accept a tagged send (asymmetric wildcard — the
+      // eager path always allowed it; the rendezvous matchers used to
+      // honor the wildcard only on the send side)
       if (it->src == src && it->bytes == bytes &&
-          (tag == TAG_ANY || it->tag == tag)) {
+          (tag == TAG_ANY || it->tag == TAG_ANY || it->tag == tag)) {
         *vaddr = it->vaddr;
         addr_q.erase(it);
         return NO_ERROR;
@@ -1316,7 +1456,7 @@ struct accl_rt {
     std::lock_guard<std::mutex> lk(rndzv_mu);
     for (auto it = done_q.begin(); it != done_q.end(); ++it) {
       if (it->src == src && it->vaddr == vaddr && it->bytes == bytes &&
-          (tag == TAG_ANY || it->tag == tag)) {
+          (tag == TAG_ANY || it->tag == TAG_ANY || it->tag == tag)) {
         done_q.erase(it);
         return NO_ERROR;
       }
@@ -1334,7 +1474,9 @@ struct accl_rt {
                                           uint32_t *src, uint64_t *vaddr) {
     std::lock_guard<std::mutex> lk(rndzv_mu);
     for (auto it = done_q.begin(); it != done_q.end(); ++it) {
-      if (it->bytes != bytes || !(tag == TAG_ANY || it->tag == tag)) continue;
+      if (it->bytes != bytes ||
+          !(tag == TAG_ANY || it->tag == TAG_ANY || it->tag == tag))
+        continue;
       for (const auto &pa : posted) {
         if (pa.vaddr == it->vaddr && pa.src == it->src) {
           *src = it->src;
@@ -2212,9 +2354,14 @@ struct accl_rt {
     for (auto &pa : c.cstate->posted) {
       revoke_posted_locked(g, pa.src, pa.vaddr, pa.bytes, pa.tag);
       for (auto it = done_q.begin(); it != done_q.end();) {
+        // either-side wildcard, matching the completion seekers: a
+        // TAG_ANY write completing at the deadline edge of a tagged
+        // posting must be purged too, or a future recv reusing the
+        // buffer would be falsely satisfied by stale data
         if (it->src == pa.src && it->vaddr == pa.vaddr &&
             it->bytes == pa.bytes &&
-            (pa.tag == TAG_ANY || it->tag == pa.tag))
+            (pa.tag == TAG_ANY || it->tag == TAG_ANY ||
+             it->tag == pa.tag))
           it = done_q.erase(it);
         else
           ++it;
@@ -2534,6 +2681,26 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   if (const char *s = getenv("ACCL_RT_FAULT_DROP_TAIL"))
     rt->fault_drop_tail = atoi(s) != 0;
 
+  if (transport == ACCL_RT_TRANSPORT_LOCAL) {
+    // intra-process POE: no sockets, no rx threads — the sender's
+    // thread delivers straight into the peer runtime (local_deliver).
+    // Bring-up IS the registry: frame_out waits for a peer's entry.
+    rt->local_mode = true;
+    rt->local_port = ports[rank];
+    rt->local_ports_vec.assign(ports, ports + world);
+    {
+      std::lock_guard<std::mutex> g(g_local_mu);
+      if (g_local_ports.count(ports[rank])) {
+        delete rt;  // port collision: refuse rather than misroute
+        return nullptr;
+      }
+      g_local_ports[ports[rank]] = rt;
+    }
+    g_local_cv.notify_all();
+    rt->seq_thread = std::thread([rt] { rt->sequencer(); });
+    return rt;
+  }
+
   if (transport == ACCL_RT_TRANSPORT_UDP) {
     // sessionless datagram POE: one SOCK_DGRAM socket, no connections.
     // Segment must fit one datagram with its header.
@@ -2681,6 +2848,15 @@ void accl_rt_destroy(accl_rt_t *rt) {
   rt->rx_cv.notify_all();
   rt->rndzv_cv.notify_all();
   rt->hello_cv.notify_all();
+  if (rt->local_mode) {
+    // deregister, then drain in-flight deliveries pinned on this
+    // runtime (each is one bounded local_deliver call)
+    std::unique_lock<std::mutex> g(g_local_mu);
+    g_local_ports.erase(rt->local_port);
+    g_local_cv.notify_all();
+    while (rt->local_refs > 0)
+      g_local_cv.wait(g);
+  }
   for (int fd : rt->peer_fd)
     if (fd >= 0) { shutdown(fd, SHUT_RDWR); close(fd); }
   if (rt->udp_fd >= 0) {

@@ -1,0 +1,164 @@
+"""Intra-process ("local") POE: direct-call frame delivery, no sockets.
+
+The third protocol-offload engine beside the TCP session mesh and the
+sessionless datagram POE (native/src/runtime.cpp local_deliver /
+g_local_ports): same sequencer, same protocol split, same framing — only
+the wire is replaced by a registry dispatch into the peer runtime, the
+intra-node fast-path role NCCL fills with SHM/P2P transports. Everything
+the socket transports pass must pass here, including the failure
+semantics (timeouts, late-write drops).
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, CallOptions, ReduceFunction, TAG_ANY
+from accl_tpu.constants import CfgFunc, Operation, from_numpy_dtype
+from accl_tpu.device.emu_device import EmuWorld
+
+RNG = np.random.default_rng(55)
+F32 = from_numpy_dtype(np.dtype(np.float32))
+
+
+@pytest.fixture(scope="module")
+def local4():
+    w = EmuWorld(4, transport="local")
+    yield w
+    w.close()
+
+
+@pytest.mark.parametrize("count", [17, 3000, 60_000])
+def test_local_every_collective(local4, count):
+    """All nine collectives against numpy oracles across eager,
+    halving-doubling, and streamed-ring/rendezvous regimes."""
+    world = 4
+    xs = RNG.standard_normal((world, count * world)).astype(np.float32)
+
+    def body(rank, i):
+        out = {}
+        x = xs[i, :count].copy()
+        b = xs[0, :count].copy() if i == 0 else np.zeros(count, np.float32)
+        rank.bcast(b, count, root=0)
+        out["bcast"] = b
+        sc = np.zeros(count, np.float32)
+        rank.scatter(xs[0].copy(), sc, count, 0)
+        out["scatter"] = sc
+        g = np.zeros(count * world, np.float32)
+        rank.gather(x.copy(), g, count, 0)
+        out["gather"] = g if i == 0 else None
+        ag = np.zeros(count * world, np.float32)
+        rank.allgather(x.copy(), ag, count)
+        out["allgather"] = ag
+        r = np.zeros(count, np.float32)
+        rank.reduce(x.copy(), r, count, 0, ReduceFunction.SUM)
+        out["reduce"] = r if i == 0 else None
+        ar = np.zeros(count, np.float32)
+        rank.allreduce(x.copy(), ar, count, ReduceFunction.SUM)
+        out["allreduce"] = ar
+        rs = np.zeros(count, np.float32)
+        rank.reduce_scatter(xs[i].copy(), rs, count, ReduceFunction.SUM)
+        out["reduce_scatter"] = rs
+        a2a = np.zeros(count * world, np.float32)
+        rank.alltoall(xs[i].copy(), a2a, count)
+        out["alltoall"] = a2a
+        rank.barrier()
+        return out
+
+    res = local4.run(body)
+    partial = xs[:, :count]
+    full_sum = xs.sum(0)
+    for r, out in enumerate(res):
+        np.testing.assert_allclose(out["bcast"], xs[0, :count], rtol=0)
+        np.testing.assert_allclose(
+            out["scatter"], xs[0, r * count:(r + 1) * count], rtol=0)
+        np.testing.assert_allclose(out["allgather"], partial.ravel(),
+                                   rtol=0)
+        np.testing.assert_allclose(out["allreduce"], partial.sum(0),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            out["reduce_scatter"], full_sum[r * count:(r + 1) * count],
+            rtol=1e-4, atol=1e-4)
+        expect_a2a = xs.reshape(4, 4, count)[:, r, :].ravel()
+        np.testing.assert_allclose(out["alltoall"], expect_a2a, rtol=0)
+    np.testing.assert_allclose(res[0]["gather"], partial.ravel(), rtol=0)
+    np.testing.assert_allclose(res[0]["reduce"], partial.sum(0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_local_p2p_both_protocols(local4):
+    """Eager (small) and rendezvous (large) send/recv, plus TAG_ANY."""
+    small = RNG.standard_normal(64).astype(np.float32)
+    big = RNG.standard_normal(200_000).astype(np.float32)
+
+    def body(rank, i):
+        if i == 0:
+            rank.send(small.copy(), 64, dst=1, tag=7)
+            rank.send(big.copy(), 200_000, dst=1, tag=8)
+            return None
+        if i == 1:
+            s = np.zeros(64, np.float32)
+            rank.recv(s, 64, src=0, tag=7)
+            b = np.zeros(200_000, np.float32)
+            rank.recv(b, 200_000, src=0, tag=TAG_ANY)
+            return s, b
+        return None
+
+    res = local4.run(body)
+    np.testing.assert_allclose(res[1][0], small, rtol=0)
+    np.testing.assert_allclose(res[1][1], big, rtol=0)
+
+
+def test_local_recv_timeout_is_clean():
+    """No matching send: the housekeeping timeout fires exactly as on
+    the socket transports (the sequencer's deadline machinery is
+    transport-independent)."""
+    w = EmuWorld(2, transport="local")
+    try:
+        def body(rank, i):
+            if i == 1:
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=300))
+            buf = np.zeros(32, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.recv, count=32,
+                                       root_src_dst=1, tag=3,
+                                       data_type=F32), res=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            return True
+
+        res = w.run(body)
+        assert res[0] is True
+    finally:
+        w.close()
+
+
+def test_local_compressed_and_int_lanes():
+    """Wire compression and non-float dtypes ride the same datapath."""
+    from accl_tpu import CompressionFlags, DataType
+
+    w = EmuWorld(4, transport="local")
+    try:
+        xs = RNG.standard_normal((4, 900)).astype(np.float32)
+        ints = RNG.integers(-100, 100, (4, 500)).astype(np.int32)
+
+        def body(rank, i):
+            out = np.zeros(900, np.float32)
+            rank.call(CallOptions(
+                scenario=Operation.allreduce, count=900,
+                function=int(ReduceFunction.SUM),
+                compression_flags=CompressionFlags.ETH_COMPRESSED,
+                data_type=DataType.float32),
+                op0=xs[i].copy(), res=out)
+            iout = np.zeros(500, np.int32)
+            rank.allreduce(ints[i].copy(), iout, 500, ReduceFunction.MAX)
+            return out, iout
+
+        for out, iout in w.run(body):
+            h = xs.astype(np.float16)
+            np.testing.assert_allclose(
+                out, h.sum(0).astype(np.float32), rtol=2e-2, atol=2e-1)
+            np.testing.assert_array_equal(iout, ints.max(0))
+    finally:
+        w.close()

@@ -153,6 +153,38 @@ def test_recv_fifo_pairing_same_signature():
         np.testing.assert_allclose(res[0][1], b, rtol=0)
 
 
+@pytest.mark.parametrize("send_tag,recv_tag", [(8, 0xFFFFFFFF),
+                                               (0xFFFFFFFF, 8)])
+def test_rendezvous_asymmetric_wildcard(send_tag, recv_tag):
+    """A TAG_ANY rendezvous recv must accept a tagged send and vice
+    versa — the eager seek always honored the wildcard on either side,
+    but the rendezvous addr/completion matchers only honored it on the
+    send side (exposed by the local-POE suite; the gap was
+    transport-independent)."""
+    from accl_tpu import CallOptions
+    from accl_tpu.constants import Operation, from_numpy_dtype
+
+    f32 = from_numpy_dtype(np.dtype(np.float32))
+    n = 120_000  # 480 KB >> max_eager -> rendezvous
+    x = RNG.standard_normal(n).astype(np.float32)
+    w = EmuWorld(2)
+    try:
+        def body(rank, i):
+            if i == 0:
+                rank.send(x.copy(), n, dst=1, tag=send_tag)
+                return None
+            out = np.zeros(n, np.float32)
+            rank.call(CallOptions(scenario=Operation.recv, count=n,
+                                  root_src_dst=0, tag=recv_tag,
+                                  data_type=f32), res=out)
+            return out
+
+        res = w.run(body)
+    finally:
+        w.close()
+    np.testing.assert_allclose(res[1], x, rtol=0)
+
+
 def test_recv_length_mismatch_defers_not_corrupts():
     """A parked recv whose count mismatches the head message must NOT
     consume it as partial fill (the wire's msg_bytes boundary): it times

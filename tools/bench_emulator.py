@@ -24,8 +24,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--world", type=int, default=4)
     ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--transport", choices=("tcp", "udp"), default="tcp",
-                    help="session TCP mesh or sessionless datagram POE")
+    ap.add_argument("--transport", choices=("tcp", "udp", "local"),
+                    default="tcp",
+                    help="session TCP mesh, sessionless datagram POE, or "
+                         "the intra-process direct-call POE")
     args = ap.parse_args()
 
     from accl_tpu import ReduceFunction
@@ -67,8 +69,8 @@ def main():
 
     outdir = REPO / "accl_log"
     outdir.mkdir(exist_ok=True)
-    csv = outdir / ("emu_bench.csv" if args.transport == "tcp"
-                    else "emu_bench_udp.csv")
+    csv = outdir / {"tcp": "emu_bench.csv", "udp": "emu_bench_udp.csv",
+                    "local": "emu_bench_local.csv"}[args.transport]
     # merge by world: a run at one world size refreshes only its own rows,
     # so the committed artifact can accumulate a multi-world sweep
     kept = []

@@ -85,15 +85,20 @@ def section_tpu(out: list[str]) -> None:
 
 def section_emulator(out: list[str]) -> None:
     for name, title in (("emu_bench.csv", "session TCP mesh"),
-                        ("emu_bench_udp.csv", "sessionless datagram POE")):
+                        ("emu_bench_udp.csv", "sessionless datagram POE"),
+                        ("emu_bench_local.csv",
+                         "intra-process direct-call POE")):
         rows = _read_csv(name)
         out.append(f"## Native emulator sweep — {title} (`{name}`)\n")
         if not rows:
             out.append("*absent*\n")
             continue
         worlds = sorted({int(r["World"]) for r in rows})
+        wire = ("direct-call delivery between in-process ranks, no "
+                "sockets" if "local" in name else "real sockets on one "
+                "host")
         out.append(f"Worlds swept: {worlds}. Functional-CI numbers "
-                   "(real sockets on one host), not hardware.\n")
+                   f"({wire}), not hardware.\n")
         out.append("| Collective | Protocol | Bytes | World | GB/s |\n"
                    "|---|---|---|---|---|")
         for r in rows:

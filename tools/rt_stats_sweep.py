@@ -104,7 +104,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="rt_stats.csv")
     ap.add_argument("--iters", type=int, default=5)
-    ap.add_argument("--transport", default="tcp", choices=("tcp", "udp"))
+    ap.add_argument("--transport", default="tcp",
+                    choices=("tcp", "udp", "local"))
     ap.add_argument("--worlds", default="8")
     ap.add_argument("--collectives", default="allreduce,bcast,allgather")
     ap.add_argument("--sizes", default="65536,1048576,4194304")
