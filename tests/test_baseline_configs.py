@@ -153,6 +153,41 @@ def test_config4_thirtytwo_rank_collective_suite():
         w.close()
 
 
+def test_config5_native_sixtyfour_rank_compressed_local_poe():
+    """BASELINE config 5's world size on the NATIVE runtime: 64 ranks,
+    fp16 wire-compressed allreduce plus an uncompressed allgather, over
+    the intra-process POE (the socket mesh at w64 would need 64*63
+    connections + rx threads; the direct-call transport brings the full
+    world up instantly, which is exactly the intra-node fast path's
+    job)."""
+    from accl_tpu import CallOptions, CompressionFlags, DataType
+    from accl_tpu.constants import Operation
+
+    w = EmuWorld(64, transport="local")
+    try:
+        xs = (RNG.standard_normal((64, 512)) * 0.1).astype(np.float32)
+
+        def body(rank, i):
+            out = np.zeros(512, np.float32)
+            rank.call(CallOptions(
+                scenario=Operation.allreduce, count=512,
+                function=int(ReduceFunction.SUM),
+                compression_flags=CompressionFlags.ETH_COMPRESSED,
+                data_type=DataType.float32),
+                op0=xs[i].copy(), res=out)
+            ag = np.zeros(64 * 64, np.float32)
+            rank.allgather(xs[i, :64].copy(), ag, 64)
+            return out, ag
+
+        res = w.run(body)
+    finally:
+        w.close()
+    exp = xs.astype(np.float16).sum(0).astype(np.float32)
+    for out, ag in res:
+        np.testing.assert_allclose(out, exp, rtol=5e-2, atol=5e-1)
+        np.testing.assert_allclose(ag, xs[:, :64].ravel(), rtol=0)
+
+
 def test_config5_sixtyfour_rank_streamed_compressed_allreduce():
     """64 virtual devices: allreduce with fp16 wire compression, plus a
     kernel-streamed producer (stream_put) feeding a rank. Runs in a

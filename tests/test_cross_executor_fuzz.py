@@ -51,7 +51,7 @@ def _sample_configs():
         compressed = bool(rng.integers(2)) and op in (
             Operation.allreduce, Operation.bcast, Operation.reduce)
         root = int(rng.integers(world))
-        transport = str(rng.choice(["tcp", "udp"]))
+        transport = str(rng.choice(["tcp", "udp", "local"]))
         # wire dtype for compressed calls: the default fp16 pair or the
         # TPU-native bf16 row (arithconfig is dtype-pair generic,
         # reference arithconfig.hpp:102-119)
@@ -288,7 +288,7 @@ def _sample_p2p():
             counts = [int(rng.integers(1, 1200)) for _ in range(n_msgs)]
             groups.append([src, dst, mode, counts])
         max_eager = int(rng.choice([256, 4096]))
-        transport = str(rng.choice(["tcp", "udp"]))
+        transport = str(rng.choice(["tcp", "udp", "local"]))
         # recv posting order per group, decided HERE so both executors
         # mirror it. Out-of-order recvs make not-yet-wanted eager
         # messages park in the bounded rx ring (the unexpected-message

@@ -231,6 +231,21 @@ def section_timing(out: list[str]) -> None:
             v_s = _fmt_bytes(int(v)) if "bytes" in k else v
             out.append(f"- {k}: {v_s}")
         out.append("")
+    for key, title in (("local_poe_tier", "Local-POE tier"),
+                       ("udp_poe_tier", "Datagram-POE tier")):
+        lp = tm.get(key)
+        if not lp:
+            continue
+        links = ", ".join(
+            f"{name} alpha {lk['alpha_us']:.1f} us / beta "
+            f"{lk['beta_gbps']:.2f} GB/s"
+            for name, lk in lp.get("link_per_collective", {}).items())
+        med = lp.get("fit", {}).get("median_pred_over_meas")
+        out.append(
+            f"**{title}** (from `{lp.get('source', '?')}`): {links}"
+            f" — median predicted/measured "
+            + (f"{med:.2f}" if med else "n/a")
+            + f" over {lp.get('fit', {}).get('rows', '?')} rows.\n")
     tpu = tm.get("tpu_tier")
     if tpu:
         beta = tpu.get("dispatch_beta_gbps")

@@ -199,6 +199,44 @@ def main() -> int:
     med_holdout = (holdout_ratios[len(holdout_ratios) // 2]
                    if holdout_ratios else None)
 
+    # per-POE tiers: each transport has its own link parameters (the
+    # datagram POE pays per-packet costs, the intra-process POE has no
+    # sockets at all) — fit each sweep that exists separately, the
+    # per-calibration-target posture of the reference's simulator/hw
+    # split
+    def fit_tier(csv_name: str) -> dict | None:
+        src = REPO / "accl_log" / csv_name
+        if not src.exists():
+            return None
+        tmeta = []
+        for op, nbytes, secs, world in load_rows(src, args.world):
+            count = nbytes // 4
+            plan = select_algorithm(op, count, 4, world,
+                                    max_eager_size=MAX_EAGER,
+                                    eager_rx_buf_size=RX_BUF,
+                                    tuning=tuning)
+            tmeta.append((op, plan, count, nbytes, secs, world))
+        if not tmeta:
+            return None
+        tfits = _fit_per_collective(tmeta)
+        tratios = sorted(
+            _predict_row(tfits, op, plan, count, nbytes, world) / secs
+            for op, plan, count, nbytes, secs, world in tmeta if secs)
+        return {
+            "source": csv_name,
+            "link_per_collective": {
+                name: {"alpha_us": p.alpha * 1e6,
+                       "beta_gbps": p.beta / 1e9}
+                for name, p in sorted(tfits.items())
+            },
+            "fit": {"rows": len(tmeta),
+                    "median_pred_over_meas":
+                        (tratios[len(tratios) // 2] if tratios else None)},
+        }
+
+    local_fits = fit_tier("emu_bench_local.csv")
+    udp_fits = fit_tier("emu_bench_udp.csv")
+
     # Crossovers reason over CRITICAL-PATH shapes (the parallel-hardware
     # posture the registers exist for); feed them the bcast link — the
     # root-serialized collective whose aggregate and critical shapes
@@ -222,6 +260,8 @@ def main() -> int:
                 "holdout": "leave-one-world-out",
                 "worlds": worlds},
         "rows": report,
+        "local_poe_tier": local_fits,
+        "udp_poe_tier": udp_fits,
         "tuning_crossovers": cross,
         "tpu_tier": tpu,
         "reference_defaults": {
