@@ -36,19 +36,24 @@ def fault_env(monkeypatch):
     yield set_fault
 
 
+@pytest.mark.parametrize("transport", ["tcp", "local"])
 @pytest.mark.parametrize("segs,m2_count", [(3, 40), (6, 700), (9, 64)])
-def test_orphan_drain_after_mid_message_death(fault_env, segs, m2_count):
+def test_orphan_drain_after_mid_message_death(fault_env, segs, m2_count,
+                                              transport):
     """A recv that dies mid-message (slow tail outlives its deadline)
     must arm the orphan drain; when the stale tail finally lands, a
     later recv on the same link discards it and receives the NEXT
     message intact (runtime.cpp drain_orphans_locked). Parametrized
-    over segment counts and follow-up sizes."""
+    over segment counts, follow-up sizes, and the session vs
+    intra-process transports (the fault lever delivers the delayed tail
+    through whichever wire is active)."""
     fault_env(ACCL_RT_FAULT_DELAY_TAIL_MS=700)
     rx_buf = 256
     count = (segs * rx_buf) // 4  # exactly `segs` wire segments
     m1 = RNG.standard_normal(count).astype(np.float32)
     m2 = RNG.standard_normal(m2_count).astype(np.float32)
-    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf,
+                 transport=transport)
     try:
         def body(rank, i):
             import time
