@@ -83,6 +83,8 @@ def load_native():
         lib.accl_rt_read.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.accl_rt_write.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                       ctypes.c_uint32]
+        lib.accl_rt_get_stats.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_uint64)]
         lib.accl_rt_dump_rxbufs.restype = ctypes.c_size_t
         lib.accl_rt_dump_rxbufs.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                             ctypes.c_size_t]
@@ -161,6 +163,17 @@ class EmuRank:
 
     def write(self, addr: int, value: int):
         self._lib.accl_rt_write(self._rt, addr, value)
+
+    def sequencer_stats(self) -> dict:
+        """Cumulative sequencer counters of this rank's runtime —
+        execute passes, event-counter parks, nanoseconds parked, rx-seek
+        hits/misses. The live form of the ACCL_RT_STATS destroy-time
+        dump: diff two snapshots to profile one phase of a run
+        (tools/rt_stats_sweep.py automates the per-config version)."""
+        buf = (ctypes.c_uint64 * 5)()
+        self._lib.accl_rt_get_stats(self._rt, buf)
+        return {"passes": buf[0], "parks": buf[1], "park_ns": buf[2],
+                "seek_hit": buf[3], "seek_miss": buf[4]}
 
     def dump_eager_rx_buffers(self) -> str:
         """Slot-by-slot rx-ring snapshot from the native runtime

@@ -83,6 +83,9 @@ void accl_rt_release(accl_rt_t *rt, int64_t handle);
 /* Exchange-memory MMIO (byte-addressed words, 8 KB). */
 uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr);
 void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value);
+/* cumulative sequencer counters: {passes, parks, park_ns, seek_hit,
+   seek_miss} — live profiling access to the ACCL_RT_STATS counters */
+void accl_rt_get_stats(accl_rt_t *rt, uint64_t out[5]);
 
 /* Eager-rx-ring snapshot (dump_eager_rx_buffers analog): NUL-terminated
  * report into out (truncated at cap); returns the untruncated length. */

@@ -2954,6 +2954,18 @@ void accl_rt_release(accl_rt_t *rt, int64_t handle) {
 
 uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr) { return rt->rd(addr); }
 
+// Cumulative sequencer counters (execute passes, event-counter parks,
+// nanoseconds parked, rx-seek hits/misses): the always-on form of the
+// ACCL_RT_STATS destroy-time dump, so callers can profile phases of a
+// live run — the observability sibling of the per-call PERFCNT word.
+void accl_rt_get_stats(accl_rt_t *rt, uint64_t out[5]) {
+  out[0] = rt->stat_passes.load();
+  out[1] = rt->stat_parks.load();
+  out[2] = rt->stat_park_ns.load();
+  out[3] = rt->stat_seek_hit.load();
+  out[4] = rt->stat_seek_miss.load();
+}
+
 void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value) {
   rt->wr(addr, value);
 }

@@ -30,23 +30,55 @@ def main():
                          "the intra-process direct-call POE")
     args = ap.parse_args()
 
-    from accl_tpu import ReduceFunction
+    from accl_tpu import Operation, ReduceFunction, TuningParams
     from accl_tpu.device.emu_device import EmuWorld
+    from accl_tpu.sequencer import Protocol, select_algorithm
 
-    w = EmuWorld(args.world, max_eager=4096, rx_buf_bytes=4096,
+    # the full per-collective sweep shape of the reference's bench.cpp
+    # (every collective, 2^k element points); `nbytes` is the per-rank
+    # payload of the named collective's natural unit
+    COLLECTIVES = ("allreduce", "bcast", "allgather", "reduce", "scatter",
+                   "gather", "reduce_scatter", "alltoall")
+
+    # one eager/rx geometry shared by the world AND the labeler — a
+    # drifting pair would silently mislabel the Protocol column
+    MAX_EAGER = RX_BUF = 4096
+
+    def protocol_label(name: str, count: int) -> str:
+        """Which protocol regime the row actually exercised, from the
+        shared selection rules (plan.py) — NOT a size threshold: the
+        datagram POE is eager-only, and allreduce rides the streamed
+        eager ring/halving-doubling at every size by default."""
+        if args.transport == "udp":
+            return "eager"
+        plan = select_algorithm(
+            Operation[name], count, 4, args.world,
+            max_eager_size=MAX_EAGER, eager_rx_buf_size=RX_BUF,
+            tuning=TuningParams.default())
+        return "rndzv" if plan.protocol == Protocol.RENDEZVOUS else "eager"
+
+    w = EmuWorld(args.world, max_eager=MAX_EAGER, rx_buf_bytes=RX_BUF,
                  transport=args.transport)
     rows = []
     try:
         for nbytes in (1024, 4096, 65536, 1 << 20, 4 << 20):
             count = nbytes // 4
-            # the datagram POE is eager-only (no rendezvous message types)
-            proto = ("eager" if nbytes <= 4096 or args.transport == "udp"
-                     else "rndzv")
-            for name in ("allreduce", "bcast", "allgather"):
+            for name in COLLECTIVES:
+                proto = protocol_label(name, count)
+
                 def body(rank, i, _name=name, _n=count):
-                    x = np.ones(_n, np.float32)
-                    out = np.zeros(_n * (args.world if _name == "allgather"
-                                         else 1), np.float32)
+                    W = args.world
+                    # only the named collective's operands, and wide
+                    # buffers only where the rank's ROLE reads/writes
+                    # them (a 4 MB point at w16 would otherwise
+                    # allocate ~136 MB per rank for every collective)
+                    wide_in = (_name in ("reduce_scatter", "alltoall")
+                               or (_name == "scatter" and i == 0))
+                    wide_out = (_name in ("alltoall", "allgather")
+                                or (_name == "gather" and i == 0))
+                    x = np.ones(_n * (W if wide_in else 1), np.float32)
+                    out = np.zeros(_n * (W if wide_out else 1),
+                                   np.float32)
                     rank.barrier()
                     t0 = time.perf_counter()
                     for _ in range(args.iters):
@@ -54,14 +86,25 @@ def main():
                             rank.allreduce(x, out, _n, ReduceFunction.SUM)
                         elif _name == "bcast":
                             rank.bcast(x, _n, root=0)
-                        else:
+                        elif _name == "allgather":
                             rank.allgather(x, out, _n)
+                        elif _name == "reduce":
+                            rank.reduce(x, out, _n, 0, ReduceFunction.SUM)
+                        elif _name == "scatter":
+                            rank.scatter(x, out, _n, 0)
+                        elif _name == "gather":
+                            rank.gather(x, out, _n, 0)
+                        elif _name == "reduce_scatter":
+                            rank.reduce_scatter(x, out, _n,
+                                                ReduceFunction.SUM)
+                        else:
+                            rank.alltoall(x, out, _n)
                     return (time.perf_counter() - t0) / args.iters
 
                 secs = max(w.run(body))
                 gbps = nbytes / secs / 1e9
                 rows.append((name, proto, nbytes, secs, gbps))
-                print(f"{name:10s} {proto:6s} {nbytes:>9d} B "
+                print(f"{name:14s} {proto:6s} {nbytes:>9d} B "
                       f"{secs*1e6:10.1f} us  {gbps:7.3f} GB/s",
                       file=sys.stderr)
     finally:
