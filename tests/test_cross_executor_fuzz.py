@@ -34,7 +34,7 @@ OPS = [Operation.bcast, Operation.scatter, Operation.gather,
        Operation.allgather, Operation.reduce, Operation.allreduce,
        Operation.reduce_scatter, Operation.alltoall]
 
-N_CONFIGS = 32
+N_CONFIGS = 56
 SEED = 1234
 
 

@@ -56,6 +56,16 @@ def test_ring_attention_matches_full(world, causal):
                           causal=causal)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_long_sequence(causal):
+    """Long-context check: 2048 tokens ring-sharded across sp=8 (256
+    per shard, 7 ring hops) against the full fp64 oracle — the
+    flagship's long-sequence claim at a context length where the
+    log-sum-exp accumulation across hops actually has to work."""
+    run_sharded_attention(ring_attention, 8, B=1, T=2048, H=2, D=32,
+                          causal=causal)
+
+
 @pytest.mark.parametrize("world", [2, 4])
 @pytest.mark.parametrize("hkv", [1, 2])
 def test_ring_attention_gqa_matches_full(world, hkv):

@@ -19,6 +19,12 @@ import numpy as np
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
+# THE eager/rx geometry of the emulator sweep, single-sourced: the
+# in-file protocol labeler, the EmuWorld bring-up, and the timing-model
+# calibration (tools/timing_model.py) must all agree or rows near the
+# eager/rendezvous boundary get mislabeled / misfitted silently.
+MAX_EAGER = RX_BUF = 4096
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -39,10 +45,6 @@ def main():
     # payload of the named collective's natural unit
     COLLECTIVES = ("allreduce", "bcast", "allgather", "reduce", "scatter",
                    "gather", "reduce_scatter", "alltoall")
-
-    # one eager/rx geometry shared by the world AND the labeler — a
-    # drifting pair would silently mislabel the Protocol column
-    MAX_EAGER = RX_BUF = 4096
 
     def protocol_label(name: str, count: int) -> str:
         """Which protocol regime the row actually exercised, from the

@@ -35,9 +35,9 @@ OPS = {"allreduce": Operation.allreduce, "bcast": Operation.bcast,
        "alltoall": Operation.alltoall,
        "reduce_scatter": Operation.reduce_scatter}
 
-# the emulator bench's fixed eager configuration (tools/bench_emulator.py)
-MAX_EAGER = 4096
-RX_BUF = 4096
+# the emulator bench's eager/rx geometry, single-sourced from the sweep
+# tool so calibration can never drift from what the sweep actually ran
+from tools.bench_emulator import MAX_EAGER, RX_BUF  # noqa: E402
 
 
 def load_rows(path: pathlib.Path, default_world: int):
