@@ -24,6 +24,13 @@ sys.path.insert(0, str(REPO))
 # calibration (tools/timing_model.py) must all agree or rows near the
 # eager/rendezvous boundary get mislabeled / misfitted silently.
 MAX_EAGER = RX_BUF = 4096
+MAX_RNDZV = 64 * 1024 * 1024  # passed to EmuWorld AND the skip guard
+
+# Calibration domain of the timing model (tools/timing_model.py):
+# worlds past this stay in the CSVs as scale evidence but are excluded
+# from alpha/beta fits — 32 threads on the single CI core enter a
+# superlinear scheduling regime no linear link model spans.
+FIT_MAX_WORLD = 16
 
 
 def main():
@@ -60,13 +67,37 @@ def main():
         return "rndzv" if plan.protocol == Protocol.RENDEZVOUS else "eager"
 
     w = EmuWorld(args.world, max_eager=MAX_EAGER, rx_buf_bytes=RX_BUF,
-                 transport=args.transport)
+                 max_rndzv=MAX_RNDZV, transport=args.transport)
     rows = []
     try:
+        # large worlds move gigabytes of aggregate wire bytes through
+        # one CI core per 4 MB config; raise the housekeeping timeout
+        # (the reference's runtime-configurable knob) so a slow sweep
+        # point is measured, not killed
+        from accl_tpu import CallOptions
+        from accl_tpu.constants import CfgFunc, Operation as _Op
+
+        def _cfg(rank, i):
+            rank.call(CallOptions(scenario=_Op.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=180_000))
+        w.run(_cfg)
         for nbytes in (1024, 4096, 65536, 1 << 20, 4 << 20):
             count = nbytes // 4
             for name in COLLECTIVES:
                 proto = protocol_label(name, count)
+                # the rendezvous reduce_scatter composition reduces the
+                # FULL world x count payload in one message; past the
+                # configured max_rndzv ceiling (64 MB emulator default)
+                # the runtime correctly refuses with DMA_SIZE_ERROR —
+                # skip the config and say so (no silent caps)
+                if (name == "reduce_scatter" and proto == "rndzv"
+                        and nbytes * args.world > MAX_RNDZV):
+                    print(f"{name:14s} {proto:6s} {nbytes:>9d} B "
+                          f"SKIPPED (composition message "
+                          f"{nbytes * args.world >> 20} MB > max_rndzv)",
+                          file=sys.stderr)
+                    continue
 
                 def body(rank, i, _name=name, _n=count):
                     W = args.world

@@ -37,19 +37,30 @@ OPS = {"allreduce": Operation.allreduce, "bcast": Operation.bcast,
 
 # the emulator bench's eager/rx geometry, single-sourced from the sweep
 # tool so calibration can never drift from what the sweep actually ran
-from tools.bench_emulator import MAX_EAGER, RX_BUF  # noqa: E402
+from tools.bench_emulator import (  # noqa: E402
+    FIT_MAX_WORLD,
+    MAX_EAGER,
+    RX_BUF,
+)
 
 
 def load_rows(path: pathlib.Path, default_world: int):
+    """Rows inside the calibration domain (worlds <= FIT_MAX_WORLD —
+    see tools/bench_emulator.py: larger worlds are scale evidence, not
+    fit input), plus the count of rows excluded by the domain."""
     rows = []
+    beyond = 0
     with open(path) as f:
         for r in csv.DictReader(f):
             op = OPS.get(r["Collective"])
             if op is None:
                 continue
             world = int(r.get("World") or default_world)
+            if world > FIT_MAX_WORLD:
+                beyond += 1
+                continue
             rows.append((op, int(r["Bytes"]), float(r["Seconds"]), world))
-    return rows
+    return rows, beyond
 
 
 def tpu_tier(profile: pathlib.Path) -> dict | None:
@@ -147,7 +158,7 @@ def main() -> int:
         print(f"no {src}; run tools/bench_emulator.py first",
               file=sys.stderr)
         return 1
-    rows = load_rows(src, args.world)
+    rows, main_beyond = load_rows(src, args.world)
     if not rows:
         print(f"{src} has no usable collective rows; re-run "
               "tools/bench_emulator.py", file=sys.stderr)
@@ -208,8 +219,13 @@ def main() -> int:
         src = REPO / "accl_log" / csv_name
         if not src.exists():
             return None
+        # the calibration domain (worlds <= FIT_MAX_WORLD) is enforced
+        # by load_rows, shared with the main fit: w32 local rows fit at
+        # ~1.6x median when pooled — superlinear scheduling at 32
+        # threads on one core — so they stay out of every tier
+        trows, skipped = load_rows(src, args.world)
         tmeta = []
-        for op, nbytes, secs, world in load_rows(src, args.world):
+        for op, nbytes, secs, world in trows:
             count = nbytes // 4
             plan = select_algorithm(op, count, 4, world,
                                     max_eager_size=MAX_EAGER,
@@ -230,6 +246,8 @@ def main() -> int:
                 for name, p in sorted(tfits.items())
             },
             "fit": {"rows": len(tmeta),
+                    "rows_beyond_domain": skipped,
+                    "calibration_domain": f"worlds <= {FIT_MAX_WORLD}",
                     "median_pred_over_meas":
                         (tratios[len(tratios) // 2] if tratios else None)},
         }
@@ -258,7 +276,9 @@ def main() -> int:
         "fit": {"rows": len(report), "median_pred_over_meas": med,
                 "median_holdout_pred_over_meas": med_holdout,
                 "holdout": "leave-one-world-out",
-                "worlds": worlds},
+                "worlds": worlds,
+                "rows_beyond_domain": main_beyond,
+                "calibration_domain": f"worlds <= {FIT_MAX_WORLD}"},
         "rows": report,
         "local_poe_tier": local_fits,
         "udp_poe_tier": udp_fits,
