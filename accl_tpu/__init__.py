@@ -8,7 +8,9 @@ a native C++ multi-rank emulator preserves the reference's CPU-only test
 topology. See SURVEY.md for the structural analysis of the reference.
 """
 
-from .constants import (  # noqa: F401
+from .utils import compat as _compat  # imports no jax itself
+_compat.install_if_jax_loaded()  # shims only when jax is already resident
+from .constants import (  # noqa: F401,E402
     ACCLError,
     CfgFunc,
     CompressionFlags,
@@ -26,18 +28,24 @@ from .constants import (  # noqa: F401
 )
 from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG  # noqa: F401
 from .communicator import Communicator, Rank, generate_ranks  # noqa: F401
-from .descriptor import CallOptions  # noqa: F401
-from .sequencer import Algorithm, Plan, Protocol, select_algorithm  # noqa: F401
+from .descriptor import CallOptions, SequenceDescriptor  # noqa: F401
+from .sequencer import (  # noqa: F401
+    Algorithm,
+    Plan,
+    Protocol,
+    SequencePlan,
+    select_algorithm,
+)
 
 __version__ = "0.1.0"
 
 
 def __getattr__(name):
     # Lazy import of the driver facade to keep `import accl_tpu` light.
-    if name == "ACCL":
+    if name in ("ACCL", "SequenceRecorder"):
         try:
-            from .accl import ACCL
+            from . import accl as _accl_mod
         except ImportError as e:
             raise AttributeError(f"ACCL facade unavailable: {e}") from e
-        return ACCL
+        return getattr(_accl_mod, name)
     raise AttributeError(name)

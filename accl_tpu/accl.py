@@ -250,28 +250,23 @@ class ACCL:
             compress_dtype=compress_dtype or DataType.none,
         )
 
-    def _execute(
-        self,
-        opts: CallOptions,
-        sync_in: list[BaseBuffer],
-        sync_out: list[BaseBuffer],
-        from_device: bool,
-        to_device: bool,
-        run_async: bool,
-    ):
+    def _stage_in(self, sync_in: list[BaseBuffer], from_device: bool):
+        """Pre-launch host->HBM staging: host-only operands always stage;
+        device buffers only when the caller didn't claim from_device
+        residence."""
         for b in sync_in:
-            # host-only operands always stage to HBM; device buffers only
-            # when the caller didn't claim from_device residence
             if not from_device or getattr(b, "host_only", False):
                 b.sync_to_device()
-        Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
-                  opts.count, int(opts.compression_flags),
-                  int(opts.stream_flags))
-        req = self.cclo.start(opts)
+
+    def _complete(self, req, sync_out: list[BaseBuffer], to_device: bool,
+                  run_async: bool):
+        """Post-launch completion contract shared by single calls and
+        recorded sequences: async defers sync-out to wait() (host-only
+        results still need their copy-back even under to_device), sync
+        waits/checks and pulls results."""
         self._last_request = req
         if run_async:
             if to_device:
-                # host-only results still need their copy-back on wait
                 req._accl_sync_out = [
                     b for b in sync_out if getattr(b, "host_only", False)
                 ]
@@ -284,6 +279,22 @@ class ACCL:
             if not to_device or getattr(b, "host_only", False):
                 b.sync_from_device()
         return req
+
+    def _execute(
+        self,
+        opts: CallOptions,
+        sync_in: list[BaseBuffer],
+        sync_out: list[BaseBuffer],
+        from_device: bool,
+        to_device: bool,
+        run_async: bool,
+    ):
+        self._stage_in(sync_in, from_device)
+        Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
+                  opts.count, int(opts.compression_flags),
+                  int(opts.stream_flags))
+        req = self.cclo.start(opts)
+        return self._complete(req, sync_out, to_device, run_async)
 
     def wait(self, req: BaseRequest):
         """Complete an async request (sync-out deferred at start time)."""
@@ -536,6 +547,31 @@ class ACCL:
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
+    # ------------------------------------------------------------------ #
+    # call sequences: record a batch, dispatch ONE fused program
+    # ------------------------------------------------------------------ #
+
+    def sequence(self, comm: Communicator | None = None) -> "SequenceRecorder":
+        """Start recording a call sequence: collective/copy/combine calls
+        on the returned recorder queue descriptors host-side (nothing
+        executes), then `run()` lowers the WHOLE batch into one compiled
+        device program — a single dispatch, intermediates threaded
+        on-device between stages, stream endpoints spliced at the seams.
+        Usable as a context manager (the batch runs on clean exit)::
+
+            with accl.sequence() as seq:
+                seq.reduce_scatter(a, b, n, ReduceFunction.SUM)
+                seq.allgather(b, c, n)
+            # one dispatch happened; results are in b and c
+
+        Results are bitwise-identical to issuing the same calls eagerly
+        back to back (the cross-executor fuzz pins this)."""
+        if not hasattr(self.cclo, "start_sequence"):
+            raise NotImplementedError(
+                f"{type(self.cclo).__name__} does not support call "
+                "sequences")
+        return SequenceRecorder(self, comm)
+
     def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
         multi-communicator support: the firmware caches the addressed
@@ -739,3 +775,154 @@ class ACCL:
                  for i in range(n_words)]
         return Communicator.from_exchmem_words(
             words, exchmem_addr=comm.exchmem_addr).ranks
+
+
+class SequenceRecorder:
+    """Records a batch of collective/copy/combine descriptors host-side
+    (the thin-client half of the device-resident call-sequence contract):
+    each method queues the SAME descriptor its eager ACCL counterpart
+    would dispatch, and `run()` hands the whole batch to the device for
+    one fused compile+dispatch (TPUDevice.start_sequence). Collective
+    methods return the recorder, so chains compose fluently; send/recv
+    and barrier cannot ride a sequence (host-paired / payload-free)."""
+
+    def __init__(self, accl: ACCL, comm: Communicator | None = None):
+        self._accl = accl
+        self._comm = comm
+        self.calls: list[CallOptions] = []
+        self._reads: list[BaseBuffer] = []  # per-step operand buffers
+        self._writes: list[BaseBuffer] = []  # per-step result buffers
+        self._ran = False
+
+    def __len__(self) -> int:
+        return len(self.calls)
+
+    def __enter__(self) -> "SequenceRecorder":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None and self.calls and not self._ran:
+            self.run()
+        return False
+
+    def _record(self, opts: CallOptions, reads, writes) -> "SequenceRecorder":
+        if self._ran:
+            raise RuntimeError("sequence already executed; record a new one")
+        self.calls.append(opts)
+        self._reads.append(list(reads))
+        self._writes.append(list(writes))
+        return self
+
+    def _prep(self, scenario, op0, op1, res, count, **kw):
+        return self._accl._prepare(scenario, op0, op1, res, count,
+                                   comm=self._comm, **kw)
+
+    # -- recorded forms of the facade's data-plane calls -------------------
+
+    def copy(self, srcbuf, dstbuf, count):
+        opts = self._prep(Operation.copy, srcbuf, None, dstbuf, count)
+        return self._record(opts, [srcbuf], [dstbuf])
+
+    def combine(self, count, function, op0, op1, res):
+        opts = self._prep(Operation.combine, op0, op1, res, count,
+                          function=int(function))
+        return self._record(opts, [op0, op1], [res])
+
+    def bcast(self, buf, count, root, *, compress_dtype=None,
+              op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.bcast, buf, None, buf, count,
+                          root_src_dst=root, compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [buf], [buf])
+
+    def scatter(self, sendbuf, recvbuf, count, root, *, compress_dtype=None,
+                op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.scatter, sendbuf, None, recvbuf, count,
+                          root_src_dst=root, compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def gather(self, sendbuf, recvbuf, count, root, *, compress_dtype=None,
+               op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.gather, sendbuf, None, recvbuf, count,
+                          root_src_dst=root, compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def allgather(self, sendbuf, recvbuf, count, *, compress_dtype=None,
+                  op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.allgather, sendbuf, None, recvbuf, count,
+                          compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def reduce(self, sendbuf, recvbuf, count, root, function, *,
+               compress_dtype=None, op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.reduce, sendbuf, None, recvbuf, count,
+                          root_src_dst=root, function=int(function),
+                          compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def allreduce(self, sendbuf, recvbuf, count, function, *,
+                  compress_dtype=None, op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.allreduce, sendbuf, None, recvbuf, count,
+                          function=int(function),
+                          compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def reduce_scatter(self, sendbuf, recvbuf, count, function, *,
+                       compress_dtype=None, op0_stream=None,
+                       res_stream=None):
+        opts = self._prep(Operation.reduce_scatter, sendbuf, None, recvbuf,
+                          count, function=int(function),
+                          compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    def alltoall(self, sendbuf, recvbuf, count, *, compress_dtype=None,
+                 op0_stream=None, res_stream=None):
+        opts = self._prep(Operation.alltoall, sendbuf, None, recvbuf, count,
+                          compress_dtype=compress_dtype)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
+    # -- execution ---------------------------------------------------------
+
+    def _sync_sets(self):
+        """(sync_in, sync_out): external inputs = buffers read before any
+        in-sequence write (intermediates chain on-device); outputs =
+        every written buffer, first-write order — the same sets eager
+        back-to-back calls would sync."""
+        written: set[int] = set()
+        sync_in: list[BaseBuffer] = []
+        sync_out: list[BaseBuffer] = []
+        for reads, writes in zip(self._reads, self._writes):
+            for b in reads:
+                if id(b) not in written and all(b is not x for x in sync_in):
+                    sync_in.append(b)
+            for b in writes:
+                written.add(id(b))
+                if all(b is not x for x in sync_out):
+                    sync_out.append(b)
+        return sync_in, sync_out
+
+    def run(self, *, from_device=False, to_device=False, run_async=False):
+        """Dispatch the recorded batch as ONE compiled device program.
+        from_device/to_device skip the host<->HBM syncs around the WHOLE
+        sequence (per-call syncs between stages never happen: that seam
+        is what the fusion removes); run_async returns the request, to be
+        completed with accl.wait()."""
+        if self._ran:
+            raise RuntimeError("sequence already executed; record a new one")
+        if not self.calls:
+            raise ValueError("empty sequence: record at least one call")
+        self._ran = True
+        accl = self._accl
+        sync_in, sync_out = self._sync_sets()
+        accl._stage_in(sync_in, from_device)
+        Log.debug("sequence of %d: %s", len(self.calls),
+                  "+".join(o.scenario.name for o in self.calls))
+        req = accl.cclo.start_sequence(self.calls)
+        return accl._complete(req, sync_out, to_device, run_async)

@@ -270,6 +270,26 @@ DMA_MAX_BTT = 8 * 1024 * 1024 - 64
 # Max bytes per wire segment (reference MAX_PACKETSIZE, ccl_offload_control.h:51)
 MAX_SEG_SIZE = 4096
 
+# ---------------------------------------------------------------------------
+# Hop-shape constants shared by the native executor and the timing model.
+# These are the SINGLE SOURCE for the logp crossover rules and the streamed
+# ring's jumbo-segment size: native/src/runtime.cpp (logp_max_bytes,
+# logp_ag_max_bytes, the egr_send jumbo seg_bytes) hard-codes the same
+# values, and tests/test_timing.py pins the two sources together so the
+# timing model cannot silently drift from the executor it models.
+# ---------------------------------------------------------------------------
+
+# allreduce: recursive halving-doubling wins while the payload is under
+# ~this many bytes per ring hop saved (measured tie points,
+# accl_log/rt_stats_shape_*.csv)
+LOGP_ALLREDUCE_HOP_BYTES = 32 * 1024
+# allgather: recursive doubling threshold per hop saved, against the TOTAL
+# gathered payload
+LOGP_ALLGATHER_HOP_BYTES = 128 * 1024
+# jumbo-segment size for streamed whole-chunk ring/tree hop messages
+# (runtime.cpp egr_send seg_bytes at its ring-collective call sites)
+STREAM_SEG_BYTES = 1 << 20
+
 EXCHMEM_SIZE = 8192  # bytes of emulated exchange memory per rank
 
 

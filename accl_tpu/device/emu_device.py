@@ -92,15 +92,25 @@ def load_native():
         return lib
 
 
-def free_ports(n: int) -> list[int]:
+def free_ports(n: int, hold: bool = False):
     """Reserve n free localhost ports (emulator launch helper, the role of
-    test/model/emulator/run.py's port allocation)."""
+    test/model/emulator/run.py's port allocation).
+
+    hold=True returns (ports, sockets) with the reserving sockets still
+    bound: the "local" POE never binds these ports itself (they are pure
+    registry keys into the native g_local_ports map), so without a live
+    reservation the OS may hand the same numbers to a second
+    concurrently-alive world and the native registry refuses the
+    collision at bring-up — the caller keeps the sockets open for the
+    world's lifetime."""
     socks, ports = [], []
     for _ in range(n):
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         socks.append(s)
         ports.append(s.getsockname()[1])
+    if hold:
+        return ports, socks
     for s in socks:
         s.close()
     return ports
@@ -317,29 +327,57 @@ class EmuWorld:
     run.py launching N emulator processes; rank bring-up is concurrent
     because link establishment blocks on peers)."""
 
+    # worlds whose bring-up failed (a socket-transport port lost to a
+    # colliding process, a refused link) are retried with FRESH ports —
+    # bounded, so an environment-level flake costs a retry instead of a
+    # failed run
+    BRINGUP_ATTEMPTS = 3
+
     def __init__(self, world: int, **kw):
-        ports = free_ports(world)
         self.ranks: list[EmuRank | None] = [None] * world
-        errs: list[Exception] = []
+        self._port_holds: list = []
+        last: Exception | None = None
+        for _attempt in range(self.BRINGUP_ATTEMPTS):
+            if kw.get("transport") == "local":
+                # local mode uses port numbers only as registry keys —
+                # hold the reserving sockets for the world's lifetime so
+                # a second live world can never be assigned the same keys
+                # (the port-registry collision that used to flake
+                # concurrent local worlds)
+                ports, self._port_holds = free_ports(world, hold=True)
+            else:
+                ports, self._port_holds = free_ports(world), []
+            self.ports = list(ports)
+            self.ranks = [None] * world
+            errs: list[Exception] = []
 
-        def mk(r):
-            try:
-                self.ranks[r] = EmuRank(world, r, ports, **kw)
-            except Exception as e:  # pragma: no cover
-                errs.append(e)
+            def mk(r):
+                try:
+                    self.ranks[r] = EmuRank(world, r, ports, **kw)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
 
-        threads = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errs:
-            raise errs[0]
+            threads = [threading.Thread(target=mk, args=(r,))
+                       for r in range(world)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if not errs:
+                return
+            last = errs[0]
+            self.close()  # tear down the half-up world before retrying
+        raise last
 
     def close(self):
         for r in self.ranks:
             if r is not None:
                 r.close()
+        # release the local-mode port reservations only after every rank
+        # has unregistered from the native registry
+        for s in self._port_holds:
+            s.close()
+        self._port_holds = []
 
     def run(self, fn):
         """Execute fn(rank_obj, rank_idx) on every rank concurrently and

@@ -180,6 +180,7 @@ class TPUDevice(CCLODevice):
             sub_mesh, self.axis_name,
             arith_table=self.compiler.arith_table,
             use_pallas_ring=self.compiler.use_pallas_ring,
+            pallas_ring_overlap=self.compiler.pallas_ring_overlap,
         )
         return _CommCtx(len(rows), sub_mesh, compiler, rows)
 
@@ -250,8 +251,12 @@ class TPUDevice(CCLODevice):
             return self._match_recv(options)
         return self._launch(options)
 
-    def _launch(self, options: CallOptions) -> BaseRequest:
-        ctx = self._comm_ctx(options.comm_addr)
+    def _resolve_step(self, options: CallOptions, ctx: "_CommCtx",
+                      tuning: TuningParams | None = None):
+        """Per-descriptor plan selection + stream-endpoint resolution —
+        ONE source for both the eager path and the call-sequence path, so
+        the fused program can never silently diverge from what eager
+        execution would run. Returns (plan, producer, consumer)."""
         plan = select_algorithm(
             options.scenario,
             options.count,
@@ -261,21 +266,26 @@ class TPUDevice(CCLODevice):
             options.stream_flags,
             max_eager_size=self.max_eager_size,
             eager_rx_buf_size=self.eager_rx_buf_size,
-            tuning=self.tuning(),
+            tuning=tuning if tuning is not None else self.tuning(),
         )
-        if options.stream_flags:
-            # streamed call: stream ids ride dedicated descriptor bytes
-            # (word 8), so the tag stays available for matching. send/recv
-            # arrive here already PAIRED (start() routes the raw halves
-            # through the parking maps; _pair merged their endpoint ids)
-            from ..constants import StreamFlags
+        # stream ids ride dedicated descriptor bytes (word 8), so the tag
+        # stays available for matching
+        from ..constants import StreamFlags
 
-            producer = consumer = None
-            if options.stream_flags & StreamFlags.OP0_STREAM:
-                producer = self.streams.producer(options.op0_stream_id)
-            if options.stream_flags & StreamFlags.RES_STREAM:
-                consumer = self.streams.consumer(options.res_stream_id,
-                                                 strict=True)
+        producer = consumer = None
+        if options.stream_flags & StreamFlags.OP0_STREAM:
+            producer = self.streams.producer(options.op0_stream_id)
+        if options.stream_flags & StreamFlags.RES_STREAM:
+            consumer = self.streams.consumer(options.res_stream_id,
+                                             strict=True)
+        return plan, producer, consumer
+
+    def _launch(self, options: CallOptions) -> BaseRequest:
+        ctx = self._comm_ctx(options.comm_addr)
+        # send/recv arrive here already PAIRED (start() routes the raw
+        # halves through the parking maps; _pair merged their endpoint ids)
+        plan, producer, consumer = self._resolve_step(options, ctx)
+        if options.stream_flags:
             fn = ctx.compiler.lower_streamed(options, plan, producer, consumer)
         else:
             fn = ctx.compiler.lower(options, plan)
@@ -284,13 +294,12 @@ class TPUDevice(CCLODevice):
         op1 = self._buf(options.addr_1)
         res = self._buf(options.addr_2)
         args = []
-        n = options.count
         scen = options.scenario
-        in_n = n * ctx.world if scen in (
-            Operation.scatter,
-            Operation.reduce_scatter,
-            Operation.alltoall,
-        ) else n
+        # single source for the wide-operand width rule, shared with the
+        # call-sequence dataflow resolution
+        from ..sequencer.sequence import step_in_elems
+
+        in_n = step_in_elems(options, ctx.world)
         if scen == Operation.barrier:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -320,6 +329,65 @@ class TPUDevice(CCLODevice):
 
         req = TPURequest(options.scenario.name, [out], on_complete=place)
         req.plan = plan
+        return req
+
+    # -- call sequences (device-resident descriptor batches) ---------------
+
+    def start_sequence(self, options_list) -> BaseRequest:
+        """Execute a recorded batch of call descriptors as ONE compiled
+        device program (sequencer.sequence.SequencePlan): a single
+        dispatch for the whole chain, intermediate results threaded
+        on-device between stages instead of re-crossing the host. Plans
+        are selected per step with the live tuning registers, exactly as
+        the eager path would."""
+        from ..descriptor import SequenceDescriptor
+        from ..request import SequenceRequest
+        from ..sequencer.sequence import SequencePlan
+
+        desc = SequenceDescriptor(tuple(options_list))
+        ctx = self._comm_ctx(desc.comm_addr)
+        tuning = self.tuning()  # read the registers once for the batch
+        plans = []
+        endpoints = []
+        for opts in desc.steps:
+            plan, producer, consumer = self._resolve_step(opts, ctx, tuning)
+            plans.append(plan)
+            endpoints.append((producer, consumer))
+
+        seq = SequencePlan(desc, plans, ctx.world, endpoints)
+        bufs = {addr: self._buf(addr) for addr in seq.buffer_addrs}
+        for addr, need in seq.min_widths().items():
+            have = bufs[addr].shape[-1]
+            if have < need:
+                raise ValueError(
+                    f"sequence needs {need} elements in buffer "
+                    f"{addr:#x}, which holds {have}")
+        fn = ctx.compiler.compile_sequence(seq)
+
+        args = []
+        for addr in seq.buffer_addrs:
+            buf = bufs[addr]
+            if buf.device is None:  # host-only buffer not yet staged
+                buf.sync_to_device()
+            arr = buf.device
+            if ctx.rows is None:
+                args.append(arr)
+            else:
+                args.append(self._rows_to_submesh(arr, ctx, arr.shape[-1]))
+        outs = fn(*args)
+
+        out_bufs = [bufs[a] for a in seq.out_addrs]
+
+        def place(req):
+            for buf, out in zip(out_bufs, outs):
+                if buf.device is None:  # host-only result: materialize
+                    buf.sync_to_device()
+                if ctx.rows is None:
+                    buf.device = _place_into(buf.device, out)
+                else:
+                    buf.device = self._scatter_rows(buf.device, ctx, out)
+
+        req = SequenceRequest(list(outs), plans, on_complete=place)
         return req
 
     # -- send/recv pairing ------------------------------------------------

@@ -8,7 +8,11 @@ gradient sync all run through the framework's own schedule bodies inside
 one compiled training step.
 """
 
-from .transformer import (  # noqa: F401
+from ..utils import compat as _compat
+
+_compat.install()  # jax version shims, before the jax-heavy modules load
+
+from .transformer import (  # noqa: F401,E402
     TransformerConfig,
     init_kv_cache,
     init_params,

@@ -31,6 +31,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..constants import ReduceFunction
 
+# Per-kernel segment slots: each slot owns a distinct collective_id, so
+# its neighbor-barrier semaphore (and, in interpret mode, every piece of
+# collective_id-keyed shared state) is private to the slot. Consecutive
+# large-payload segments then double-buffer across slots — the
+# segmenter/rx-ring overlap of the reference — instead of serializing on
+# one shared id. collective_id layout: unidirectional kernel slots take
+# the even ids (2*slot), the bidirectional kernel the odd (2*slot + 1).
+NUM_RING_SLOTS = 2
+
+
+def _slot_id(slot: int, bidir: bool) -> int:
+    if not 0 <= slot < NUM_RING_SLOTS:
+        raise ValueError(f"ring slot {slot} outside 0..{NUM_RING_SLOTS - 1}")
+    return 2 * slot + (1 if bidir else 0)
+
 
 def _sublane(dtype) -> int:
     """Rows of the dtype's VMEM tile (fp32 (8,128), bf16 (16,128), int8
@@ -141,14 +156,18 @@ def ring_allreduce_pallas(
     func: ReduceFunction = ReduceFunction.SUM,
     interpret=None,
     detect_races: bool = False,
+    slot: int = 0,
 ):
     """Per-device body (call inside shard_map): fused ring allreduce of a
-    flat (n,) buffer. Pads n up to a world-aligned, lane-aligned chunk."""
+    flat (n,) buffer. Pads n up to a world-aligned, lane-aligned chunk.
+    `slot` selects an independent semaphore/comm-buffer set (see
+    NUM_RING_SLOTS) so segmented launches can overlap."""
     f16_detour = _compiled_f16_detour(x, interpret)
     if f16_detour is not None:
         return f16_detour(
             ring_allreduce_pallas, axis_name=axis_name, world=world,
-            func=func, interpret=interpret, detect_races=detect_races)
+            func=func, interpret=interpret, detect_races=detect_races,
+            slot=slot)
     n = x.shape[-1]
     tile = _sublane(x.dtype) * 128
     chunk = -(-n // world)
@@ -181,7 +200,8 @@ def ring_allreduce_pallas(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),  # slot release credits
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=0),
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_slot_id(slot, bidir=False)),
         interpret=interpret,
     )(x2)
     return out.reshape(padded)[:n]
@@ -288,13 +308,17 @@ def ring_allreduce_pallas_bidir(
     func: ReduceFunction = ReduceFunction.SUM,
     interpret=None,
     detect_races: bool = False,
+    slot: int = 0,
 ):
-    """Bidirectional fused ring allreduce of a flat (n,) buffer."""
+    """Bidirectional fused ring allreduce of a flat (n,) buffer. `slot`
+    selects an independent semaphore/comm-buffer set (NUM_RING_SLOTS) so
+    segmented launches can double-buffer instead of serializing."""
     f16_detour = _compiled_f16_detour(x, interpret)
     if f16_detour is not None:
         return f16_detour(
             ring_allreduce_pallas_bidir, axis_name=axis_name, world=world,
-            func=func, interpret=interpret, detect_races=detect_races)
+            func=func, interpret=interpret, detect_races=detect_races,
+            slot=slot)
     n = x.shape[-1]
     # pad so n splits into 2 * world whole-tile chunks
     tile = _sublane(x.dtype) * 128
@@ -332,7 +356,8 @@ def ring_allreduce_pallas_bidir(
             pltpu.SemaphoreType.REGULAR((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(collective_id=1),
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_slot_id(slot, bidir=True)),
         interpret=interpret,
     )(x2)
     return out.reshape(padded)[:n]

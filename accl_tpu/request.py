@@ -116,6 +116,23 @@ class TPURequest(BaseRequest):
         return False
 
 
+class SequenceRequest(TPURequest):
+    """Request for a fused call sequence: ONE device dispatch covering a
+    recorded batch of descriptors. Completion is the readiness of the
+    batch's written buffers (the single program's outputs); `plans` and
+    `num_steps` expose what the one dispatch covered, the sequence analog
+    of TPURequest.plan."""
+
+    def __init__(self, outputs, plans, on_complete=None):
+        super().__init__("sequence", outputs, on_complete=on_complete)
+        self.plans = list(plans)
+        self.num_steps = len(self.plans)
+        # exactly one device dispatch happened for the whole batch — the
+        # observable inversion the sequence layer exists for (bench.py's
+        # sequence_fused_vs_eager row and the cache-hit test read this)
+        self.num_dispatches = 1
+
+
 class ParkedRecvRequest(BaseRequest):
     """A recv issued before its matching send: parks until the send
     arrives (then mirrors the launched pair program) or the device's

@@ -15,7 +15,12 @@ move instructions at runtime, we split the same logic into:
                   collective on-device, preserving ACCL's host-only-
                   supervises property);
   - lowering.py   descriptor -> compiled program, with a schedule cache
-                  keyed by the descriptor's static signature.
+                  keyed by the descriptor's static signature;
+  - sequence.py   recorded descriptor BATCHES -> one fused program (the
+                  device-resident call-sequence layer: one dispatch for a
+                  whole collective chain, cached under a composite
+                  signature).
 """
 
 from .plan import Algorithm, Plan, Protocol, select_algorithm  # noqa: F401
+from .sequence import SequencePlan  # noqa: F401

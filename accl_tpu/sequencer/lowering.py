@@ -18,7 +18,11 @@ from __future__ import annotations
 import functools
 from typing import Callable
 
-import jax
+from ..utils import compat as _compat
+
+_compat.install()  # jax version shims, before any jax.shard_map use
+
+import jax  # noqa: E402
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
@@ -51,6 +55,7 @@ class ScheduleCompiler:
         axis_name: str = "ccl",
         arith_table: dict | None = None,
         use_pallas_ring: bool | None = None,
+        pallas_ring_overlap: bool | None = None,
     ):
         self.mesh = mesh
         self.axis_name = axis_name
@@ -62,6 +67,15 @@ class ScheduleCompiler:
 
             use_pallas_ring = _on_tpu()
         self.use_pallas_ring = use_pallas_ring
+        if pallas_ring_overlap is None:
+            # segment-slot double-buffering for the large-payload pallas
+            # ring (see _body's allreduce branch); the env knob keeps the
+            # serialized baseline reachable for A/B measurement
+            import os
+
+            pallas_ring_overlap = (
+                os.environ.get("ACCL_PALLAS_RING_SERIALIZE") != "1")
+        self.pallas_ring_overlap = pallas_ring_overlap
         self._cache: dict = {}
 
     # Per-device payload ceiling for the VMEM-resident fused ring kernel;
@@ -101,7 +115,8 @@ class ScheduleCompiler:
         plan: Plan,
         arithcfg: ArithConfig | None = None,
     ) -> Callable:
-        key = (options.signature(), plan, self.axis_name, self.use_pallas_ring)
+        key = (options.signature(), plan, self.axis_name,
+               self.use_pallas_ring, self.pallas_ring_overlap)
         fn = self._cache.get(key)
         if fn is None:
             from ..utils.logging import Log
@@ -154,7 +169,8 @@ class ScheduleCompiler:
         # strong reference prevents id-reuse after GC from resurrecting a
         # stale compiled program when an endpoint is re-registered
         key = (options.signature(), plan, self.axis_name,
-               self.use_pallas_ring, "streamed", producer, consumer)
+               self.use_pallas_ring, self.pallas_ring_overlap,
+               "streamed", producer, consumer)
         fn = self._cache.get(key)
         if fn is None:
             body, n_in = self._body(options, plan, arithcfg)
@@ -323,28 +339,44 @@ class ScheduleCompiler:
                     and (not eth_active or compressed_domain)
                     and mosaic_ok
                 ):
-                    from ..ops.ring_allreduce import ring_allreduce_pallas_bidir
+                    from ..ops.ring_allreduce import (
+                        NUM_RING_SLOTS,
+                        ring_allreduce_pallas_bidir,
+                    )
 
                     # Kernel-resource chunking: the VMEM-resident kernel
                     # caps per-launch payload, so larger buffers run it per
-                    # segment. Segments are SERIALIZED by an explicit data
-                    # dependency: the fused kernel's neighbor barrier and
-                    # credit semaphores are keyed by one collective_id, so
-                    # overlapping instances would cross-talk. (Protocol
+                    # segment. The kernel's neighbor-barrier/credit
+                    # semaphores and comm buffers are keyed per SEGMENT
+                    # SLOT (collective_id per slot, ring_allreduce
+                    # NUM_RING_SLOTS), so consecutive segments
+                    # double-buffer and overlap like the reference's
+                    # segmenter/rx-ring; only slot reuse is ordered
+                    # (segmented_apply overlap_slots). The serialized
+                    # baseline stays reachable for A/B measurement via
+                    # ACCL_PALLAS_RING_SERIALIZE=1. (Protocol
                     # segmentation — plan.seg_count — stays plan-owned and
                     # governs the lax path.)
                     seg_elems = max(self.PALLAS_RING_MAX_BYTES // elem_bytes, 1)
 
-                    def one_seg(y, *, _c=common, _f=func):
+                    def one_seg(y, slot=0, *, _c=common, _f=func):
                         return ring_allreduce_pallas_bidir(
-                            y, axis_name=_c["axis"], world=_c["world"], func=_f
+                            y, axis_name=_c["axis"], world=_c["world"],
+                            func=_f, slot=slot,
                         )
 
-                    def body(x, *, _c=common, _seg=seg_elems):
+                    def body(x, *, _c=common, _seg=seg_elems,
+                             _overlap=self.pallas_ring_overlap):
                         y = _c["wire"].send(x)  # wire compression outside
-                        out = schedules.segmented_apply(
-                            one_seg, y, _seg, serialize=True
-                        )
+                        if _overlap:
+                            out = schedules.segmented_apply(
+                                one_seg, y, _seg,
+                                overlap_slots=NUM_RING_SLOTS,
+                            )
+                        else:
+                            out = schedules.segmented_apply(
+                                one_seg, y, _seg, serialize=True
+                            )
                         return _c["wire"].recv(out, x.dtype)
 
                 else:
@@ -388,6 +420,48 @@ class ScheduleCompiler:
         return functools.partial(
             schedules.reduce_flat_schedule, root=root, func=func, **common
         )
+
+    # -- call sequences ----------------------------------------------------
+
+    def compile_sequence(self, seq) -> Callable:
+        """Lower a SequencePlan into ONE compiled device program: every
+        step's schedule body composed over the batch's buffer table inside
+        a single jit(shard_map(...)). Cached under the batch's composite
+        signature alongside the per-call entries, so re-recording the same
+        shapes+dataflow compiles nothing."""
+        key = seq.cache_key(self.axis_name, self.use_pallas_ring,
+                            self.pallas_ring_overlap)
+        fn = self._cache.get(key)
+        if fn is None:
+            from ..utils.logging import Log
+
+            Log.info(
+                "compiling sequence of %d steps: %s world=%d",
+                len(seq.steps),
+                "+".join(s.options.scenario.name for s in seq.steps),
+                self.world,
+            )
+            body, n_in = seq.build(self)
+            fn = self._finalize_sequence(body, n_in)
+            self._cache[key] = fn
+        return fn
+
+    def _finalize_sequence(self, body, n_in: int) -> Callable:
+        spec = PartitionSpec(self.axis_name)
+
+        def wrapped(*args):
+            flat = [a.reshape(a.shape[-1]) for a in args]
+            outs = body(*flat)
+            return tuple(o.reshape(1, o.shape[-1]) for o in outs)
+
+        shmapped = jax.shard_map(
+            wrapped,
+            mesh=self.mesh,
+            in_specs=(spec,) * n_in,
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(shmapped)
 
     # -- convenience: full pipeline from descriptor ------------------------
 

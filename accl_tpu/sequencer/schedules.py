@@ -328,41 +328,78 @@ def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):
 
 
 def segmented_apply(one_segment, x, seg_count, unroll_limit: int = 8,
-                    serialize: bool = False):
+                    serialize: bool = False, overlap_slots: int = 0):
     """Apply a per-segment schedule over a flat buffer in seg_count-element
     pieces (the eager segmentation substrate, .c:626-647). Independent
     segments are unrolled up to unroll_limit so XLA can software-pipeline
     their permutes (>2 outstanding moves); beyond that, lax.map bounds
     compile time. serialize=True threads a data dependency between
     segments for bodies that share stateful device resources (e.g. pallas
-    kernels with a fixed collective_id)."""
+    kernels with a fixed collective_id).
+
+    overlap_slots=k pipelines bodies whose device resources come in k
+    independent slots (the reference's double-buffered rx ring): segment
+    i runs in slot i%k and is called as one_segment(seg, slot). Only
+    slot REUSE is ordered — segment i depends on segment i-k, so up to k
+    segments double-buffer in flight while same-slot instances can never
+    cross-talk (the de-serialized form of serialize=True for the
+    slot-keyed pallas ring)."""
     count = x.shape[-1]
     if count <= seg_count:
-        return one_segment(x)
+        return one_segment(x, 0) if overlap_slots else one_segment(x)
     num_bulk = count // seg_count
     tail = count - num_bulk * seg_count
     bulk = x[: num_bulk * seg_count].reshape(num_bulk, seg_count)
+    if overlap_slots:
+        outs = []
+        for i in range(num_bulk):
+            seg_in = bulk[i]
+            if i >= overlap_slots:
+                # order-only dependency on the previous occupant of this
+                # slot: its resources must be drained before reuse
+                seg_in = _ordered_after(seg_in, outs[i - overlap_slots])
+            outs.append(one_segment(seg_in, i % overlap_slots))
+        if tail:
+            tail_in = x[num_bulk * seg_count :]
+            if num_bulk >= overlap_slots:
+                tail_in = _ordered_after(
+                    tail_in, outs[num_bulk - overlap_slots])
+            outs.append(one_segment(tail_in, num_bulk % overlap_slots))
+        return jnp.concatenate(outs)
     if serialize or num_bulk <= unroll_limit:
         outs = []
         carry = None
         for i in range(num_bulk):
             seg_in = bulk[i]
             if serialize and carry is not None:
-                seg_in = seg_in + carry * 0  # order-only dependency
+                seg_in = _ordered_after(seg_in, carry)
             out_i = one_segment(seg_in)
             if serialize:
-                carry = out_i[0]
+                carry = out_i
             outs.append(out_i)
         bulk_out = jnp.concatenate(outs)
     else:
         bulk_out = lax.map(one_segment, bulk).reshape(num_bulk * seg_count)
     if tail:
         tail_in = x[num_bulk * seg_count :]
-        if serialize and num_bulk:
-            tail_in = tail_in + bulk_out[-1] * 0
+        if serialize and carry is not None:
+            # order on the LAST segment's output itself — a slice of the
+            # concatenation would simplify to a slice of the FIRST
+            # operand, quietly dropping the dependency on segments 2..N
+            tail_in = _ordered_after(tail_in, carry)
         tail_out = one_segment(tail_in)
         return jnp.concatenate([bulk_out, tail_out])
     return bulk_out
+
+
+def _ordered_after(seg_in, prev_out):
+    """Order-only dependency: seg_in becomes unusable until prev_out has
+    been computed, without changing its value. optimization_barrier (not
+    `+ prev*0`) because the algebraic simplifier folds mul-by-zero away
+    for integer dtypes, which would silently drop the serialization the
+    slot-keyed kernel semaphores rely on."""
+    seg_in, _ = lax.optimization_barrier((seg_in, prev_out[:1]))
+    return seg_in
 
 
 def _pad_to_multiple(x, m):

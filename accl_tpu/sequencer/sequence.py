@@ -1,0 +1,236 @@
+"""Device-resident call sequences: one compiled program per descriptor batch.
+
+Every facade call used to dispatch its own jitted program, so a
+reduce-scatter -> allgather -> compute chain paid a host round-trip and an
+HBM materialization at every seam. A SequencePlan lowers a RECORDED batch
+of call descriptors (SequenceDescriptor) through the same schedule bodies
+the per-call path uses into ONE jax.jit(shard_map(...)) device program:
+one dispatch for the whole chain, XLA free to fuse across collective
+seams, stream producers/consumers spliced between stages — the composed
+form of ACCL's host-only-issues-the-call inversion (HiCCL's fused-schedule
+observation applied to the descriptor batch).
+
+Dataflow: buffers referenced by the batch become program inputs (one per
+unique address, full buffer width); an environment threads each step's
+result to later operands by address, mirroring what chained eager calls
+with from_device/to_device would observe — so a recorded sequence is
+bitwise-identical to the same calls issued eagerly (the cross-executor
+fuzz pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ..constants import DataType, Operation
+from ..descriptor import SequenceDescriptor
+
+# ops that read `count * world` elements per rank (stacked chunk inputs,
+# tpu_device._launch's in_n rule)
+_WIDE_IN = (Operation.scatter, Operation.reduce_scatter, Operation.alltoall)
+# ops whose per-rank result is `count * world` elements
+_WIDE_OUT = (Operation.gather, Operation.allgather, Operation.alltoall)
+
+# the descriptor kinds a sequence can carry: data-plane steps with static
+# operand/result addresses. send/recv pair through the host-side parking
+# maps and barrier carries no payload — none of them belongs in a fused
+# data-flow program.
+SEQUENCE_OPS = (
+    Operation.copy,
+    Operation.combine,
+    Operation.bcast,
+    Operation.scatter,
+    Operation.gather,
+    Operation.allgather,
+    Operation.reduce,
+    Operation.allreduce,
+    Operation.reduce_scatter,
+    Operation.alltoall,
+)
+
+
+def step_in_elems(options, world: int) -> int:
+    return options.count * world if options.scenario in _WIDE_IN \
+        else options.count
+
+
+def step_out_elems(options, world: int) -> int:
+    return options.count * world if options.scenario in _WIDE_OUT \
+        else options.count
+
+
+@dataclasses.dataclass(frozen=True)
+class _Step:
+    """One lowered stage: its descriptor/plan plus the resolved dataflow
+    (buffer-table indices and static element counts)."""
+
+    options: object  # CallOptions
+    plan: object  # Plan
+    in_idx: tuple[int, ...]
+    res_idx: int
+    in_elems: int
+    out_elems: int
+    producer: Callable | None
+    consumer: Callable | None
+
+
+class SequencePlan:
+    """The lowered form of a recorded descriptor batch.
+
+    Construction resolves the batch's dataflow (which addresses feed
+    which steps) against per-step Plans; `build()` composes the per-step
+    schedule bodies into one traced callable over the buffer table, and
+    `cache_key()` is the composite signature the ScheduleCompiler caches
+    the compiled program under, alongside its per-call entries.
+    """
+
+    def __init__(
+        self,
+        descriptor: SequenceDescriptor,
+        plans: list,
+        world: int,
+        endpoints: list[tuple[Callable | None, Callable | None]] | None = None,
+    ):
+        if len(plans) != len(descriptor.steps):
+            raise ValueError("one Plan per descriptor step required")
+        if endpoints is None:
+            endpoints = [(None, None)] * len(descriptor.steps)
+        self.descriptor = descriptor
+        self.world = world
+        addr_order: dict[int, int] = {}
+
+        def idx(addr: int) -> int:
+            return addr_order.setdefault(addr, len(addr_order))
+
+        steps: list[_Step] = []
+        written: list[int] = []
+        for opts, plan, (prod, cons) in zip(descriptor.steps, plans,
+                                            endpoints):
+            if opts.scenario not in SEQUENCE_OPS:
+                raise ValueError(
+                    f"{opts.scenario.name} cannot ride a call sequence "
+                    "(host-paired or payload-free descriptor)")
+            if opts.addr_0 == 0 or opts.addr_2 == 0:
+                raise ValueError(
+                    f"sequence step {opts.scenario.name} needs operand and "
+                    "result buffers")
+            in_idx = [idx(opts.addr_0)]
+            if opts.scenario == Operation.combine:
+                if opts.addr_1 == 0:
+                    raise ValueError("combine step needs a second operand")
+                in_idx.append(idx(opts.addr_1))
+            res_idx = idx(opts.addr_2)
+            if res_idx not in written:
+                written.append(res_idx)
+            steps.append(_Step(
+                options=opts,
+                plan=plan,
+                in_idx=tuple(in_idx),
+                res_idx=res_idx,
+                in_elems=step_in_elems(opts, world),
+                out_elems=step_out_elems(opts, world),
+                producer=prod,
+                consumer=cons,
+            ))
+        self.steps = tuple(steps)
+        # buffer table: unique addresses in first-appearance order (the
+        # same canonical order descriptor.signature() renames by)
+        self.buffer_addrs = tuple(addr_order)
+        # program outputs: every written buffer, in first-write order
+        self.out_idx = tuple(written)
+        self.out_addrs = tuple(self.buffer_addrs[i] for i in written)
+
+    @property
+    def n_in(self) -> int:
+        return len(self.buffer_addrs)
+
+    def min_widths(self) -> dict[int, int]:
+        """Per-address minimum buffer width (elements) the batch needs —
+        execution-time validation against the registered buffers."""
+        need: dict[int, int] = {}
+        for st in self.steps:
+            for i in st.in_idx:
+                a = self.buffer_addrs[i]
+                need[a] = max(need.get(a, 0), st.in_elems)
+            a = self.buffer_addrs[st.res_idx]
+            need[a] = max(need.get(a, 0), st.out_elems)
+        return need
+
+    def cache_key(self, axis_name: str, use_pallas_ring: bool,
+                  pallas_ring_overlap: bool) -> tuple:
+        # endpoint callables ride the key by identity, with strong refs
+        # held (same id-reuse hazard as lower_streamed)
+        eps = tuple((st.producer, st.consumer) for st in self.steps)
+        return (
+            self.descriptor.signature(),
+            tuple(st.plan for st in self.steps),
+            eps,
+            axis_name,
+            use_pallas_ring,
+            pallas_ring_overlap,
+        )
+
+    # -- construction ------------------------------------------------------
+
+    def build(self, compiler) -> tuple[Callable, int]:
+        """Compose the per-step schedule bodies into one traced callable:
+        (flat per-rank buffer views...) -> (written buffer views...).
+        Returns (body, n_in) for the compiler's shard_map finalization."""
+        from jax import lax
+
+        from ..ops.streams import splice_consumer, splice_producer
+        from .lowering import _arithcfg_for
+
+        lowered = []
+        for st in self.steps:
+            arithcfg = None
+            if st.options.data_type != DataType.none:
+                arithcfg = _arithcfg_for(compiler.arith_table, st.options)
+            body, n_in = compiler._body(st.options, st.plan, arithcfg)
+            if st.producer is not None:
+                if n_in != 1:
+                    raise ValueError(
+                        "OP0_STREAM unsupported for "
+                        f"{st.options.scenario.name}")
+                body = splice_producer(body, st.producer, st.in_elems)
+            if st.consumer is not None:
+                body = splice_consumer(body, st.consumer)
+            # steps that may lower to the pallas ring share its slot-keyed
+            # collective_ids: two such steps with no dataflow between them
+            # must still be ORDERED, or concurrent kernel instances would
+            # cross-talk on the shared semaphores (conservative: an
+            # allreduce that actually took the lax branch is ordered too,
+            # which costs nothing but a scheduling edge)
+            uses_ring = (st.options.scenario == Operation.allreduce
+                         and compiler.use_pallas_ring)
+            lowered.append((body, uses_ring))
+
+        steps = self.steps
+        out_idx = self.out_idx
+
+        def fused(*bufs):
+            from .schedules import _ordered_after
+
+            env = list(bufs)
+            prev_ring = None
+            for st, (body, uses_ring) in zip(steps, lowered):
+                ins = [env[i][..., : st.in_elems] for i in st.in_idx]
+                if uses_ring and prev_ring is not None:
+                    ins[0] = _ordered_after(ins[0], prev_ring)
+                out = body(*ins)
+                if uses_ring:
+                    prev_ring = out
+                cur = env[st.res_idx]
+                if out.shape[-1] == cur.shape[-1]:
+                    # full-width result replaces the value outright (the
+                    # eager path's res.device = out)
+                    env[st.res_idx] = out
+                else:
+                    # partial-width result prefixes the buffer, keeping
+                    # the tail (the eager _place_into shape)
+                    env[st.res_idx] = lax.dynamic_update_slice_in_dim(
+                        cur, out.astype(cur.dtype), 0, axis=-1)
+            return tuple(env[i] for i in out_idx)
+
+        return fused, self.n_in

@@ -30,7 +30,12 @@ from __future__ import annotations
 import dataclasses
 import math
 
-from ..constants import Operation
+from ..constants import (
+    LOGP_ALLGATHER_HOP_BYTES,
+    LOGP_ALLREDUCE_HOP_BYTES,
+    Operation,
+    STREAM_SEG_BYTES,
+)
 from .plan import Algorithm, Plan, Protocol
 
 
@@ -52,28 +57,29 @@ def _segs(nbytes: int, rx_buf_bytes: int) -> int:
 
 
 # The native runtime streams ring/tree hop payloads as jumbo-segment
-# messages (seg_bytes = 1 MB, runtime.cpp egr_send callers): one message
-# latency per hop regardless of the rx-buffer geometry.
-_STREAM_SEG = 1 << 20
+# messages (runtime.cpp egr_send callers): one message latency per hop
+# regardless of the rx-buffer geometry. Single-sourced with the executor
+# in constants.py (tests/test_timing.py pins them to the C++ source).
+_STREAM_SEG = STREAM_SEG_BYTES
 
 
 def _logp_allreduce(world: int, nbytes: int) -> bool:
     """Mirror of the native hop-shape auto rule (runtime.cpp
     logp_max_bytes): power-of-two worlds run recursive halving-doubling
-    while the payload is under ~32 KB per scheduling latency saved."""
+    while the payload is under the crossover bytes per hop saved."""
     if world & (world - 1):
         return False
     r = int(math.log2(world))
-    return nbytes <= (2 * (world - 1) - 2 * r) * 32 * 1024
+    return nbytes <= (2 * (world - 1) - 2 * r) * LOGP_ALLREDUCE_HOP_BYTES
 
 
 def _logp_allgather(world: int, total_bytes: int) -> bool:
     """Native logp_ag_max_bytes rule: recursive doubling for small total
-    payloads on power-of-two worlds (~128 KB per hop saved)."""
+    payloads on power-of-two worlds."""
     if world & (world - 1):
         return False
     r = int(math.log2(world))
-    return total_bytes <= ((world - 1) - r) * 128 * 1024
+    return total_bytes <= ((world - 1) - r) * LOGP_ALLGATHER_HOP_BYTES
 
 
 def coefficients(
@@ -272,6 +278,56 @@ def predict(
     m, b = fn(op, plan, count, elem_bytes, world,
               rx_buf_bytes=rx_buf_bytes)
     return params.seconds(m, b)
+
+
+def sequence_coefficients(
+    calls: list[tuple[Operation, Plan, int, int]],
+    world: int,
+    *,
+    rx_buf_bytes: int,
+    aggregate: bool = False,
+) -> tuple[float, float]:
+    """(messages, bytes) for a recorded call sequence: the per-call cost
+    shapes summed back to back (stages of a sequence serialize on their
+    data dependencies, like the composed-collective shapes above).
+    `calls` entries are (op, plan, count, elem_bytes)."""
+    fn = coefficients_aggregate if aggregate else coefficients
+    tm = tb = 0.0
+    for op, plan, count, elem_bytes in calls:
+        m, b = fn(op, plan, count, elem_bytes, world,
+                  rx_buf_bytes=rx_buf_bytes)
+        tm += m
+        tb += b
+    return tm, tb
+
+
+def predict_sequence(
+    params: LinkParams,
+    calls: list[tuple[Operation, Plan, int, int]],
+    world: int,
+    *,
+    rx_buf_bytes: int,
+    aggregate: bool = False,
+    dispatch_alpha: float = 0.0,
+    fused: bool = True,
+) -> float:
+    """Expected seconds for a recorded sequence of calls.
+
+    The wire work is identical either way; what fusion buys is the host
+    seam: an eager sequence pays one program dispatch (plus the HBM
+    materialization XLA cannot fuse across) PER CALL, a fused sequence
+    pays exactly one for the whole batch. `dispatch_alpha` is that
+    per-dispatch host cost (the timing model's dispatch_alpha_us tier
+    or a measured per-call floor); fused=False models the eager chain
+    so callers can evaluate fusion as a PERFORMANCE choice:
+
+        gain = predict_sequence(..., fused=False) - predict_sequence(...)
+             = (len(calls) - 1) * dispatch_alpha
+    """
+    m, b = sequence_coefficients(calls, world, rx_buf_bytes=rx_buf_bytes,
+                                 aggregate=aggregate)
+    n_dispatch = 1 if fused else max(len(calls), 1)
+    return params.seconds(m, b) + dispatch_alpha * n_dispatch
 
 
 def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:

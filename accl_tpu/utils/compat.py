@@ -1,0 +1,75 @@
+"""Version-compatibility shims for the jax API surface we depend on.
+
+The framework targets current jax (`jax.shard_map` with `check_vma`,
+`lax.axis_size`); older toolchains still ship the experimental entry
+point (`jax.experimental.shard_map.shard_map` with `check_rep`) and no
+axis_size. Installing the shims keeps every call site — including tests
+that drive `jax.shard_map` directly — on one spelling without forking
+the codebase per jax version.
+
+This module itself imports NO jax: `install()` is called from the
+modules that already pay for jax (sequencer.lowering, models, parallel),
+and from the package root only when jax is already loaded — so
+`import accl_tpu` stays light for constants/descriptor-only consumers.
+"""
+
+from __future__ import annotations
+
+_installed = False
+
+
+def install() -> None:
+    """Install the shims (idempotent). Imports jax."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    import jax
+
+    _install_shard_map_shim(jax)
+    _install_axis_size_shim(jax)
+
+
+def install_if_jax_loaded() -> None:
+    """Install only when the process has already imported jax — the
+    package-root hook: free where jax is resident (test suites, the
+    container's sitecustomize), weightless everywhere else."""
+    import sys
+
+    if "jax" in sys.modules:
+        install()
+
+
+def _install_shard_map_shim(jax) -> None:
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # check_vma (current jax) maps onto check_rep (older jax): both
+        # gate the varying-across-mesh analysis the pallas-lowered bodies
+        # cannot satisfy.
+        if check_vma is not None:
+            kw.setdefault("check_rep", bool(check_vma))
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size_shim(jax) -> None:
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        import jax._src.core as _core
+
+        frame = _core.axis_frame(axis_name)
+        # older jax returns the bare int; newer frame objects carry .size
+        return getattr(frame, "size", frame)
+
+    lax.axis_size = axis_size

@@ -39,18 +39,19 @@ def _fetch(x):
 
 
 def _fetch_checksum(x):
-    """Cross-check barrier: reduce a strided sample spanning the WHOLE
-    result on device, then pull the scalar. The read cannot complete
-    until every sampled element exists, so if `_fetch`'s 4-element read
-    ever returned before the full computation finished, timings taken
-    under this barrier would exceed `_fetch` timings by the missing
-    tail. tools/fetch_barrier_check.py times both and commits the
-    agreement note to accl_log/ (REPORT.md cites it)."""
+    """Cross-check barrier: reduce the WHOLE result on device, then pull
+    the scalar. The read cannot complete until every element exists, so
+    if `_fetch`'s 4-element read ever returned before the full
+    computation finished, timings taken under this barrier would exceed
+    `_fetch` timings by the missing tail. (A strided sample would leave
+    the unsampled elements unordered relative to the fetch — the full
+    sum is the only read that provably orders after the whole result.)
+    tools/fetch_barrier_check.py times both and commits the agreement
+    note to accl_log/ (REPORT.md cites it)."""
     import jax.numpy as jnp
 
     r = x.ravel()
-    stride = max(1, int(r.shape[0]) // 4096)
-    return np.asarray(jnp.sum(r[::stride].astype(jnp.float32)))
+    return np.asarray(jnp.sum(r.astype(jnp.float32)))
 
 
 def _time_once(fn, *args, iters=2):
@@ -282,6 +283,153 @@ def bench_collective(jax, op_name, sizes_bytes, world):
     return rows
 
 
+def bench_sequence(jax, world, n_elems=8192, iters=30):
+    """Fused call sequence vs eager back-to-back dispatch: the SAME
+    3-collective chain (reduce_scatter -> allgather -> bcast) issued as
+    one recorded sequence (ONE compiled program, one dispatch) and as
+    three facade calls (three dispatches + HBM seams). The chain is
+    dispatch-dominated at this size, which is exactly the cost the
+    sequence layer exists to amortize. Emits sequence_eager /
+    sequence_fused rows plus a sequence_fused_vs_eager row whose value
+    column is the speedup (eager_sec / fused_sec)."""
+    from jax.sharding import Mesh
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.accl import ACCL
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    n = (n_elems // world) * world
+    chunk = n // world
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((world, n)).astype(np.float32)
+    a = accl.create_buffer(n, data=x)
+    b = accl.create_buffer(chunk)
+    c = accl.create_buffer(n)
+
+    def eager_once():
+        accl.reduce_scatter(a, b, chunk, ReduceFunction.SUM,
+                            from_device=True, to_device=True)
+        accl.allgather(b, c, chunk, from_device=True, to_device=True)
+        return accl.bcast(c, n, 0, from_device=True, to_device=True)
+
+    def fused_once():
+        seq = accl.sequence()
+        seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+        seq.allgather(b, c, chunk)
+        seq.bcast(c, n, 0)
+        return seq.run(from_device=True, to_device=True)
+
+    # warm both paths (compiles happen here; the timed loops below hit
+    # the schedule caches only)
+    eager_once().wait()
+    req = fused_once()
+    req.wait()
+    assert req.num_dispatches == 1 and req.num_steps == 3
+
+    def time_path(once):
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            once().wait()
+            times.append(time.perf_counter() - t0)
+        # median: multi-device CPU dispatch has heavy outliers
+        return float(np.median(times))
+
+    sec_eager = time_path(eager_once)
+    sec_fused = time_path(fused_once)
+    speedup = sec_eager / sec_fused
+    nbytes = n * 4
+    rows = [
+        (f"sequence_eager_w{world}_fp32", nbytes, sec_eager,
+         nbytes / sec_eager / 1e9, 1.0, True),
+        (f"sequence_fused_w{world}_fp32", nbytes, sec_fused,
+         nbytes / sec_fused / 1e9, 1.0, True),
+        # value column carries the SPEEDUP, not a bandwidth
+        ("sequence_fused_vs_eager", nbytes, sec_fused, speedup, 1.0, True),
+    ]
+    print(f"  sequence 3-coll w{world}: eager {sec_eager*1e6:9.1f} us  "
+          f"fused {sec_fused*1e6:9.1f} us  speedup {speedup:5.2f}x  "
+          f"(1 dispatch vs 3)", file=sys.stderr)
+    return rows, speedup
+
+
+def bench_ring_overlap(jax, world, nbytes=64 * 1024 * 1024):
+    """Segmented Pallas ring allreduce: slot-overlapped (default) vs
+    serialized segments, at a payload large enough to span many
+    PALLAS_RING_MAX_BYTES segments. Only meaningful where the fused ICI
+    kernel actually runs (real TPU); interpret mode at 64 MiB is not an
+    honest measurement, so the lane is skipped off-chip."""
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        print("  ring-overlap lane skipped (no TPU attached)",
+              file=sys.stderr)
+        return []
+    from jax.sharding import Mesh
+
+    from accl_tpu import CallOptions, DataType, Operation, ReduceFunction, TuningParams
+    from accl_tpu.sequencer import select_algorithm
+    from accl_tpu.sequencer.lowering import ScheduleCompiler
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    count = nbytes // 4
+    opts = CallOptions(scenario=Operation.allreduce, count=count,
+                       function=int(ReduceFunction.SUM),
+                       data_type=DataType.float32)
+    plan = select_algorithm(Operation.allreduce, count, 4, world,
+                            max_eager_size=1 << 30,
+                            eager_rx_buf_size=1 << 22,
+                            tuning=TuningParams.default())
+    x = jax.device_put(np.random.default_rng(3)
+                       .standard_normal((world, count)).astype(np.float32))
+    rows = []
+    for name, overlap in (("allreduce_pallas_serialized", False),
+                          ("allreduce_pallas_overlap", True)):
+        comp = ScheduleCompiler(mesh, use_pallas_ring=True,
+                                pallas_ring_overlap=overlap)
+        fn = comp.lower(opts, plan)
+        _fetch(fn(x))  # compile + warm
+        sec = _time_once(fn, x, iters=3)
+        bw = 2 * (world - 1) / world * nbytes / sec / 1e9
+        rows.append((f"{name}_w{world}_fp32", nbytes, sec, bw, 1.0, True))
+        print(f"  {name}_w{world} {nbytes:>10d} B  {sec*1e6:10.1f} us  "
+              f"{bw:8.2f} GB/s", file=sys.stderr)
+    return rows
+
+
+def _smoke_main():
+    """bench.py --smoke: the CI-facing quick lane — runs the fused-vs-
+    eager sequence benchmark on the virtual CPU mesh and emits ONE JSON
+    line whose value is the speedup, so per-PR regressions in the fused
+    path are visible without the full sweep."""
+    import jax
+
+    world = min(len(jax.devices()), 4)
+    rows, speedup = bench_sequence(jax, world)
+    outdir = pathlib.Path(__file__).parent / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    with open(outdir / "profile_smoke.csv", "w") as f:
+        f.write("Test,Bytes,Seconds,Value,Regime\n")
+        for t, b, s, g, _snr, _res in rows:
+            f.write(f"{t},{b},{s:.6e},{g:.3f},smoke\n")
+    print(json.dumps({
+        "metric": "sequence_fused_vs_eager speedup, 3-collective chain "
+                  f"(w{world}, one dispatch vs three)",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),  # eager chain = 1.0
+    }))
+    # the gate is real: a fused path SLOWER than eager back-to-back
+    # dispatch is a regression in the one property the sequence layer
+    # exists for — fail the CI job, don't just log a number
+    if speedup < 1.0:
+        print(f"FAIL: fused sequence slower than eager ({speedup:.2f}x)",
+              file=sys.stderr)
+        sys.exit(1)
+    if speedup < 1.15:
+        print(f"WARN: fused speedup {speedup:.2f}x below the 1.15x target",
+              file=sys.stderr)
+
+
 def _flagship_setup(jax):
     """One flagship model configuration shared by the train and decode
     lanes (so both benchmark the SAME model): returns
@@ -447,6 +595,18 @@ def main():
     ar_sizes = [1 << k for k in range(12, 27, 6)]
     rows += bench_collective(jax, "allreduce", ar_sizes, min(world, 8))
 
+    # fused call-sequence lane (one dispatch vs three) + the pallas ring
+    # segment-overlap A/B (TPU only; self-gated)
+    try:
+        seq_rows, _ = bench_sequence(jax, min(world, 8))
+        rows += seq_rows
+    except Exception as e:
+        print(f"sequence lane failed: {e!r}", file=sys.stderr)
+    try:
+        rows += bench_ring_overlap(jax, min(world, 8))
+    except Exception as e:
+        print(f"ring-overlap lane failed: {e!r}", file=sys.stderr)
+
     # ACCL_BENCH_FULL=1: the reference's 8-collective sweep shape
     # (bench.cpp:25-61) — every collective through its compiled schedule.
     # Off by default because each (op, size) pair costs a remote compile
@@ -548,4 +708,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        _smoke_main()
+    else:
+        main()

@@ -283,6 +283,15 @@ static bool recv_all(int fd, void *buf, size_t n) {
 // runtime
 // ---------------------------------------------------------------------------
 
+// Hop-shape constants SINGLE-SOURCED with the Python timing model
+// (accl_tpu/constants.py LOGP_ALLREDUCE_HOP_BYTES /
+// LOGP_ALLGATHER_HOP_BYTES / STREAM_SEG_BYTES); tests/test_timing.py
+// pins the two definitions together so the model cannot silently drift
+// from this executor.
+static constexpr uint64_t LOGP_ALLREDUCE_HOP_BYTES = 32 * 1024;
+static constexpr uint64_t LOGP_ALLGATHER_HOP_BYTES = 128 * 1024;
+static constexpr uint64_t STREAM_SEG_BYTES = 1ull << 20;
+
 struct RxSlot {
   enum { IDLE, VALID } status = IDLE;
   uint32_t src = 0, tag = 0, seqn = 0;
@@ -466,6 +475,30 @@ struct accl_rt {
   std::vector<OutstandingRecv> outstanding_recvs;
   uint64_t recv_ticket_next = 0;
 
+  // Last strict-recv head mismatch that DEFERRED instead of erroring
+  // (the head_is_claimable softening in seek_locked): a deferred
+  // protocol fault that never resolves surfaces as a plain
+  // RECEIVE_TIMEOUT, so the mismatch is recorded here and echoed in the
+  // eventual timeout detail. Guarded by rx_mu like the rx state it
+  // describes.
+  struct DeferNote {
+    uint64_t count = 0;  // defers recorded since bring-up
+    uint32_t src = 0;
+    uint32_t head_tag = 0, want_tag = 0, head_seqn = 0;
+    uint64_t head_msg = 0, head_off = 0, want_msg = 0;
+  } last_defer;
+  void note_defer_locked(const RxSlot &s, uint32_t want_tag,
+                         uint64_t want_msg) {
+    last_defer.count++;
+    last_defer.src = s.src;
+    last_defer.head_tag = s.tag;
+    last_defer.want_tag = want_tag;
+    last_defer.head_seqn = s.seqn;
+    last_defer.head_msg = s.msg_bytes;
+    last_defer.head_off = s.msg_off;
+    last_defer.want_msg = want_msg;
+  }
+
   // Direct-placement eager landing (rxbuf bypass): a parked strict recv
   // registers its destination so the rx thread reads subsequent
   // segments of ITS message straight into the final buffer — no slot
@@ -598,6 +631,12 @@ struct accl_rt {
   std::atomic<bool> fault_armed{false};
   std::vector<std::thread> fault_threads;
   std::mutex fault_mu;
+  // A delayed tail still in flight to fault_tail_dst: new egr traffic to
+  // that dst before it lands would break wire order (the lever's one
+  // precondition) — detected race-free at the SENDER, which owns
+  // outbound_seq, instead of peeking the counter from the delay thread.
+  std::atomic<bool> fault_tail_pending{false};
+  std::atomic<uint32_t> fault_tail_dst{0};
 
   // local (intra-process) POE state: my nominal port, the world's port
   // map, and the pin count of in-flight deliveries INTO this runtime
@@ -1206,6 +1245,19 @@ struct accl_rt {
     // would overflow the receiver's datagram buffer and surface as a
     // misleading sequencing error)
     if (udp_mode && bytes > max_rndzv) return DMA_SIZE_ERROR;
+    if (fault_tail_pending.load(std::memory_order_acquire) &&
+        fault_tail_dst.load(std::memory_order_relaxed) == dst) {
+      // ACCL_RT_FAULT_DELAY_TAIL_MS precondition violated: delivering
+      // more traffic to dst now would reorder the wire behind the
+      // delayed tail — fail loudly at the source instead of producing a
+      // baffling downstream sequencing error
+      fprintf(stderr,
+              "[r%u] FATAL: ACCL_RT_FAULT_DELAY_TAIL_MS wire-order "
+              "violation: new eager traffic to r%u while its delayed "
+              "tail is still in flight\n",
+              rank, dst);
+      abort();
+    }
     uint64_t seg_max = seg_bytes ? seg_bytes : rx_buf_bytes;
     if (udp_mode) seg_max = std::min<uint64_t>(seg_max, rx_buf_bytes);
     // one-shot fault arming: this message's final segment is delayed or
@@ -1225,6 +1277,8 @@ struct accl_rt {
         // caller must not send MORE traffic to dst before it lands, or
         // wire order breaks — acceptable for a test lever)
         std::vector<uint8_t> payload(ptr + off, ptr + off + seg);
+        fault_tail_dst.store(dst, std::memory_order_relaxed);
+        fault_tail_pending.store(true, std::memory_order_release);
         std::lock_guard<std::mutex> g(fault_mu);
         fault_threads.emplace_back([this, dst, tag, seqn, seg, bytes, off,
                                     payload = std::move(payload)] {
@@ -1235,6 +1289,7 @@ struct accl_rt {
             frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, payload.data(),
                       seg, /*host=*/0, /*msg_bytes=*/bytes,
                       /*msg_off=*/off);
+          fault_tail_pending.store(false, std::memory_order_release);
         });
         return NO_ERROR;
       }
@@ -1300,8 +1355,11 @@ struct accl_rt {
       return false;
     };
     if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY)) {
-      if (strict_tag)
-        return head_is_claimable() ? NOT_READY : DMA_TAG_MISMATCH_ERROR;
+      if (strict_tag) {
+        if (!head_is_claimable()) return DMA_TAG_MISMATCH_ERROR;
+        note_defer_locked(s, tag, want_msg);
+        return NOT_READY;
+      }
       return NOT_READY;
     }
     // Message-boundary match at the head of a NEW message (msg_start):
@@ -1314,8 +1372,11 @@ struct accl_rt {
     // deadline turn an unmatched recv into RECEIVE_TIMEOUT; strict
     // recvs apply the claimable-head rule above.
     if (msg_start && (s.msg_bytes != want_msg || s.msg_off != 0)) {
-      if (strict_tag)
-        return head_is_claimable() ? NOT_READY : DMA_SIZE_ERROR;
+      if (strict_tag) {
+        if (!head_is_claimable()) return DMA_SIZE_ERROR;
+        note_defer_locked(s, tag, want_msg);
+        return NOT_READY;
+      }
       return NOT_READY;
     }
     // Mid-message continuation must line up exactly with the progress the
@@ -1600,7 +1661,8 @@ struct accl_rt {
       do {
         uint64_t m = n ? std::min<uint64_t>(cap, n - off) : 0;
         uint32_t rc = op([&, off = off, m = m] {
-          return rt.egr_send(gdst, p + off, m, tag, /*seg_bytes=*/1 << 20);
+          return rt.egr_send(gdst, p + off, m, tag,
+                             /*seg_bytes=*/STREAM_SEG_BYTES);
         });
         if (rc != NO_ERROR) return rc;
         off += m;
@@ -2049,13 +2111,13 @@ struct accl_rt {
   // allreduce: ring 2(P-1) hops vs halving-doubling 2*log2(P)
   uint64_t logp_max_bytes(uint32_t world) const {
     uint32_t hops_saved = 2 * (world - 1) - 2 * log2_floor(world);
-    return (uint64_t)hops_saved * 32 * 1024;
+    return (uint64_t)hops_saved * LOGP_ALLREDUCE_HOP_BYTES;
   }
   // allgather: ring P-1 hops vs doubling log2(P); threshold compares
   // against the TOTAL gathered payload (world * chunk)
   uint64_t logp_ag_max_bytes(uint32_t world) const {
     uint32_t hops_saved = (world - 1) - log2_floor(world);
-    return (uint64_t)hops_saved * 128 * 1024;
+    return (uint64_t)hops_saved * LOGP_ALLGATHER_HOP_BYTES;
   }
 
   uint32_t do_allreduce(Ops &o, const CommView &cm, uint32_t dt,
@@ -2333,6 +2395,26 @@ struct accl_rt {
         if (getenv("ACCL_RT_DEBUG"))
           fprintf(stderr, "[r%u] call timeout scenario=%u step=%u\n", rank,
                   c.desc[0], c.current_step);
+        {
+          // a strict-recv head mismatch softened into a defer
+          // (head_is_claimable) is the likeliest cause of an otherwise
+          // bare timeout: echo the recorded mismatch so the protocol
+          // fault stays diagnosable
+          std::lock_guard<std::mutex> g(rx_mu);
+          if (last_defer.count)
+            fprintf(stderr,
+                    "[r%u] RECEIVE_TIMEOUT detail scenario=%u step=%u: "
+                    "%llu deferred head mismatch(es); last from r%u "
+                    "head(tag=%u seqn=%u msg=%llu off=%llu) vs "
+                    "wanted(tag=%u msg=%llu)\n",
+                    rank, c.desc[0], c.current_step,
+                    (unsigned long long)last_defer.count, last_defer.src,
+                    last_defer.head_tag, last_defer.head_seqn,
+                    (unsigned long long)last_defer.head_msg,
+                    (unsigned long long)last_defer.head_off,
+                    last_defer.want_tag,
+                    (unsigned long long)last_defer.want_msg);
+        }
         revoke_call_postings(c);
         return RECEIVE_TIMEOUT_ERROR;
       }
@@ -2786,15 +2868,20 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
         }
         continue;  // EAGAIN from the periodic timeout
       }
-      // accepted fds inherit the listener's SO_RCVTIMEO on Linux — clear
-      // it, or idle links die with EAGAIN after the accept-poll interval
-      struct timeval never{0, 0};
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &never, sizeof never);
+      // accepted fds inherit the listener's SO_RCVTIMEO on Linux. Keep a
+      // BOUNDED timeout for the 4-byte rank hello (a connector that
+      // established but never identifies itself — observed on sandboxed
+      // loopback stacks — must not wedge bring-up forever), then clear
+      // it so idle links don't die with EAGAIN later.
+      struct timeval hello_tv{5, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_tv, sizeof hello_tv);
       uint32_t peer;
       if (!recv_all(fd, &peer, 4) || peer >= world) {
         close(fd);
         continue;
       }
+      struct timeval never{0, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &never, sizeof never);
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
       rt->peer_fd[peer] = fd;
       accepted++;
@@ -2802,18 +2889,25 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   });
   bool ok = true;
   for (uint32_t i = rank + 1; i < world && ok; i++) {
-    int fd = socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in pa{};
     pa.sin_family = AF_INET;
     pa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     pa.sin_port = htons(ports[i]);
-    // retry: peers come up in any order
+    // retry: peers come up in any order. Each attempt gets a FRESH
+    // socket — POSIX leaves a socket unspecified after a failed
+    // connect, and some loopback stacks wedge a re-connected fd
+    // forever (observed as a bring-up hang on sandboxed kernels).
+    int fd = -1;
     int tries = 0;
-    while (connect(fd, (sockaddr *)&pa, sizeof pa) != 0) {
+    for (;;) {
+      fd = socket(AF_INET, SOCK_STREAM, 0);
+      if (connect(fd, (sockaddr *)&pa, sizeof pa) == 0) break;
+      close(fd);
+      fd = -1;
       if (++tries > 2000) { ok = false; break; }
       std::this_thread::sleep_for(std::chrono::milliseconds(5));
     }
-    if (!ok) { close(fd); break; }
+    if (!ok) break;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     uint32_t me = rank;
     send_all(fd, &me, 4);

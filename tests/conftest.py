@@ -23,12 +23,20 @@ import pytest  # noqa: E402
 #   ACCL_TPU_HW=1 python -m pytest tests/test_tpu_hw.py -v
 if os.environ.get("ACCL_TPU_HW") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax has no jax_num_cpu_devices knob; the XLA_FLAGS
+        # setdefault above covers it as long as jax wasn't pre-imported
+        pass
     # fp64 lanes are part of the CPU suite only; on the real chip x64
     # mode poisons Mosaic lowering (grid bookkeeping becomes i64 and the
     # TPU compiler rejects `func.return (i32, i64)`) — measured on the
     # v5e toolchain, so the HW suite runs in default 32-bit mode
     jax.config.update("jax_enable_x64", True)
+
+import accl_tpu  # noqa: E402,F401  (installs the jax compat shims before
+#   any test module touches jax.shard_map directly)
 
 
 @pytest.fixture(scope="session")

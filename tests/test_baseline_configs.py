@@ -12,6 +12,7 @@ subprocess (device count is fixed at backend init, so it needs its own
 interpreter).
 """
 
+import os
 import subprocess
 import sys
 import textwrap
@@ -195,7 +196,10 @@ def test_config5_sixtyfour_rank_streamed_compressed_allreduce():
     script = textwrap.dedent("""
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 64)
+        try:
+            jax.config.update("jax_num_cpu_devices", 64)
+        except AttributeError:
+            pass  # older jax: the XLA_FLAGS env below covers it
         jax.config.update("jax_enable_x64", True)
         import numpy as np, jax.numpy as jnp
         from jax.sharding import Mesh
@@ -217,6 +221,8 @@ def test_config5_sixtyfour_rank_streamed_compressed_allreduce():
         assert np.allclose(out.host[63], 3.0), "stream_put"
         print("CONFIG5 OK")
     """)
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=64")
     r = subprocess.run([sys.executable, "-c", script], capture_output=True,
-                       text=True, timeout=600, cwd="/root/repo")
+                       text=True, timeout=600, cwd="/root/repo", env=env)
     assert "CONFIG5 OK" in r.stdout, r.stderr[-2000:]

@@ -254,6 +254,166 @@ def test_cross_executor_agreement(cfg):
 
 
 # ---------------------------------------------------------------------------
+# call-sequence fuzz: a recorded batch of 2-5 random collectives must be
+# bitwise-identical to the same calls issued eagerly (the device-resident
+# sequence contract), and the same chain on the native executor must land
+# on the chained numpy oracle — on the socket emulator AND local-POE
+# transports
+# ---------------------------------------------------------------------------
+
+SEQ_CONFIGS = 8
+SEQ_SEED = 9876
+
+# chain step kinds: all leave every rank's result fully defined (so any
+# step may feed any later step on both executors). "rs_ag" records
+# reduce_scatter then allgather as two descriptors (the canonical fusion
+# target), landing back at full width.
+_SEQ_KINDS = ("allreduce", "bcast", "alltoall", "copy", "combine", "rs_ag")
+
+
+def _sample_sequences():
+    rng = np.random.default_rng(SEQ_SEED)
+    configs = []
+    for i in range(SEQ_CONFIGS):
+        world = int(rng.integers(2, 5))
+        n = world * int(rng.integers(4, 120))
+        n_steps = int(rng.integers(2, 6))
+        transport = str(rng.choice(["tcp", "local"]))
+        steps = []
+        for _ in range(n_steps):
+            kind = str(rng.choice(_SEQ_KINDS))
+            src = int(rng.integers(3))
+            src2 = int(rng.integers(3))
+            dst = int(rng.integers(3))
+            root = int(rng.integers(world))
+            func = ReduceFunction(int(rng.integers(2)))
+            steps.append((kind, src, src2, dst, root, func))
+        configs.append((i, world, n, tuple(steps), transport))
+    return configs
+
+
+def _seq_oracle(steps, bufs, world, n):
+    """Chain the numpy truth through three full-width (world, n) buffers,
+    honoring partial-width writes (reduce_scatter keeps the tail)."""
+    b = [x.copy() for x in bufs]
+    chunk = n // world
+    for kind, src, src2, dst, root, func in steps:
+        if kind == "allreduce":
+            red = b[src].sum(0) if func == ReduceFunction.SUM else b[src].max(0)
+            b[dst] = np.tile(red, (world, 1))
+        elif kind == "bcast":
+            b[dst] = np.tile(b[dst][root], (world, 1))
+        elif kind == "alltoall":
+            b[dst] = (b[src].reshape(world, world, chunk)
+                      .transpose(1, 0, 2).reshape(world, n))
+        elif kind == "copy":
+            b[dst] = b[src].copy()
+        elif kind == "combine":
+            if func == ReduceFunction.SUM:
+                b[dst] = b[src] + b[src2]
+            else:
+                b[dst] = np.maximum(b[src], b[src2])
+        elif kind == "rs_ag":
+            red = b[src].sum(0) if func == ReduceFunction.SUM else b[src].max(0)
+            b[dst] = np.tile(red, (world, 1))
+        else:
+            raise AssertionError(kind)
+    return b
+
+
+@pytest.mark.parametrize("cfg", _sample_sequences(),
+                         ids=lambda c: f"seq{c[0]}w{c[1]}n{c[2]}-{c[4]}")
+def test_sequence_fuzz_fused_eager_native(cfg):
+    from accl_tpu.accl import ACCL
+
+    i, world, n, steps, transport = cfg
+    chunk = n // world
+    rng = np.random.default_rng(SEQ_SEED + 100 + i)
+    init = [rng.standard_normal((world, n)).astype(np.float32)
+            for _ in range(3)]
+
+    # ---- XLA executor: eager chain vs recorded fused batch ------------
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    eager = [accl.create_buffer(n, data=x) for x in init]
+    fused = [accl.create_buffer(n, data=x) for x in init]
+
+    def issue(target, recorder=None):
+        ops = recorder if recorder is not None else accl
+        for kind, src, src2, dst, root, func in steps:
+            if kind == "allreduce":
+                ops.allreduce(target[src], target[dst], n, func)
+            elif kind == "bcast":
+                ops.bcast(target[dst], n, root)
+            elif kind == "alltoall":
+                ops.alltoall(target[src], target[dst], chunk)
+            elif kind == "copy":
+                ops.copy(target[src], target[dst], n)
+            elif kind == "combine":
+                ops.combine(n, func, target[src], target[src2], target[dst])
+            elif kind == "rs_ag":
+                ops.reduce_scatter(target[src], target[dst], chunk, func)
+                ops.allgather(target[dst], target[dst], chunk)
+
+    issue(eager)
+    rec = accl.sequence()
+    issue(fused, recorder=rec)
+    req = rec.run()
+    assert req.num_dispatches == 1
+
+    for k in range(3):
+        np.testing.assert_array_equal(
+            eager[k].host, fused[k].host,
+            err_msg=f"seq cfg {i}: fused != eager (buffer {k})")
+
+    want = _seq_oracle(steps, init, world, n)
+    for k in range(3):
+        np.testing.assert_allclose(
+            fused[k].host, want[k], rtol=1e-4, atol=1e-4,
+            err_msg=f"seq cfg {i}: XLA chain vs oracle (buffer {k})")
+
+    # ---- native executor: same chain, per-rank calls ------------------
+    w = EmuWorld(world, transport=transport)
+    try:
+        def body(rank, r):
+            b = [init[k][r].copy() for k in range(3)]
+            for kind, src, src2, dst, root, func in steps:
+                if kind == "allreduce":
+                    out = np.zeros(n, np.float32)
+                    rank.allreduce(b[src].copy(), out, n, func)
+                    b[dst] = out
+                elif kind == "bcast":
+                    rank.bcast(b[dst], n, root)
+                elif kind == "alltoall":
+                    out = np.zeros(n, np.float32)
+                    rank.alltoall(b[src].copy(), out, chunk)
+                    b[dst] = out
+                elif kind == "copy":
+                    out = np.zeros(n, np.float32)
+                    rank.copy(b[src], out, n)
+                    b[dst] = out
+                elif kind == "combine":
+                    out = np.zeros(n, np.float32)
+                    rank.combine(n, func, b[src], b[src2], out)
+                    b[dst] = out
+                elif kind == "rs_ag":
+                    rank.reduce_scatter(b[src].copy(), b[dst], chunk, func)
+                    out = np.zeros(n, np.float32)
+                    rank.allgather(b[dst][:chunk].copy(), out, chunk)
+                    b[dst] = out
+            return b
+
+        res = w.run(body)
+    finally:
+        w.close()
+    for r in range(world):
+        for k in range(3):
+            np.testing.assert_allclose(
+                res[r][k], want[k][r], rtol=1e-4, atol=1e-4,
+                err_msg=f"seq cfg {i}: native rank {r} buffer {k}")
+
+
+# ---------------------------------------------------------------------------
 # point-to-point fuzz: random send/recv patterns through both executors
 # ---------------------------------------------------------------------------
 

@@ -149,7 +149,12 @@ def _run_dcn_procs(n_procs, extra_args=(), prefix="dcn_test"):
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    env = dict(os.environ, PYTHONPATH=str(REPO))
+    # XLA_FLAGS covers jax versions without the jax_num_cpu_devices knob
+    # (the child sets it via config.update when available; the env var is
+    # in place before the child's interpreter starts, so it works even
+    # when sitecustomize imports jax first)
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
     procs, logs = [], []
     try:
         for pid in range(n_procs):

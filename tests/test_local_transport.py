@@ -108,6 +108,34 @@ def test_local_p2p_both_protocols(local4):
     np.testing.assert_allclose(res[1][1], big, rtol=0)
 
 
+def test_local_worlds_concurrent_no_port_collision():
+    """Two concurrently-alive local worlds must never collide in the
+    native port registry: local-mode port numbers are pure registry keys
+    (nothing binds them at create time), so EmuWorld now holds the
+    reserving sockets open for the world's lifetime — the OS cannot hand
+    the same keys to the second world. Regression for the local-POE
+    port-registry flake."""
+    w1 = EmuWorld(2, transport="local")
+    try:
+        w2 = EmuWorld(2, transport="local")
+        try:
+            assert not set(w1.ports) & set(w2.ports)
+
+            def body(rank, i):
+                out = np.zeros(8, np.float32)
+                rank.allreduce(np.full(8, float(i + 1), np.float32), out, 8,
+                               ReduceFunction.SUM)
+                return out
+
+            for w in (w1, w2):
+                for out in w.run(body):
+                    np.testing.assert_allclose(out, 3.0)
+        finally:
+            w2.close()
+    finally:
+        w1.close()
+
+
 def test_local_recv_timeout_is_clean():
     """No matching send: the housekeeping timeout fires exactly as on
     the socket transports (the sequencer's deadline machinery is

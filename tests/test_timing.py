@@ -274,3 +274,77 @@ def test_facade_autotune_tpu_tier(mesh8, tmp_path):
         accl.autotune(timing_model_path=p, tier="tpu")
     with pytest.raises(ValueError):
         accl.autotune(timing_model_path=p, tier="wat")
+
+
+# ---------------------------------------------------------------------------
+# single-source pinning: the hop-shape constants the timing model uses must
+# be the SAME values the native executor compiles in
+# ---------------------------------------------------------------------------
+
+
+def _native_src():
+    import pathlib
+
+    return (pathlib.Path(__file__).parent.parent
+            / "native" / "src" / "runtime.cpp").read_text()
+
+
+def _cpp_const(src, name):
+    import re
+
+    m = re.search(rf"constexpr\s+uint64_t\s+{name}\s*=\s*([^;]+);", src)
+    assert m, f"constexpr {name} not found in native/src/runtime.cpp"
+    expr = m.group(1).replace("ull", "").replace("u", "")
+    return int(eval(expr, {"__builtins__": {}}))  # noqa: S307 (pinned literal)
+
+
+def test_logp_constants_pinned_to_native_executor():
+    """constants.py is the single source for the logp crossovers and the
+    streamed jumbo-segment size; the C++ executor's constexprs must hold
+    identical values (a drift here silently skews every prediction the
+    timing model makes about the executor)."""
+    from accl_tpu.constants import (
+        LOGP_ALLGATHER_HOP_BYTES,
+        LOGP_ALLREDUCE_HOP_BYTES,
+        STREAM_SEG_BYTES,
+    )
+
+    src = _native_src()
+    assert _cpp_const(src, "LOGP_ALLREDUCE_HOP_BYTES") == \
+        LOGP_ALLREDUCE_HOP_BYTES
+    assert _cpp_const(src, "LOGP_ALLGATHER_HOP_BYTES") == \
+        LOGP_ALLGATHER_HOP_BYTES
+    assert _cpp_const(src, "STREAM_SEG_BYTES") == STREAM_SEG_BYTES
+
+
+def test_logp_constants_actually_used_by_native_rules():
+    """The constexprs must be what the selection rules and the jumbo
+    sender USE — re-hardcoding a literal in logp_max_bytes would pass the
+    definition check while drifting the behavior."""
+    src = _native_src()
+    assert "hops_saved * LOGP_ALLREDUCE_HOP_BYTES" in src
+    assert "hops_saved * LOGP_ALLGATHER_HOP_BYTES" in src
+    assert "seg_bytes=*/STREAM_SEG_BYTES" in src
+
+
+def test_predict_sequence_fused_vs_eager_gain():
+    """The sequence cost model: wire work is the per-call sum either way;
+    fusion saves exactly (k-1) host dispatches."""
+    from accl_tpu.sequencer.timing import predict, predict_sequence
+
+    link = LinkParams(alpha=1e-5, beta=1e9)
+    world = 4
+    calls = []
+    for op, count in ((Operation.reduce_scatter, 256),
+                      (Operation.allgather, 256),
+                      (Operation.bcast, 1024)):
+        calls.append((op, plan_for(op, count, world), count, 4))
+    t_fused = predict_sequence(link, calls, world, rx_buf_bytes=RX,
+                               dispatch_alpha=2e-4)
+    t_eager = predict_sequence(link, calls, world, rx_buf_bytes=RX,
+                               dispatch_alpha=2e-4, fused=False)
+    per_call = sum(predict(link, op, plan, count, 4, world,
+                           rx_buf_bytes=RX)
+                   for op, plan, count, _ in calls)
+    assert t_eager - t_fused == pytest.approx(2 * 2e-4)
+    assert t_fused == pytest.approx(per_call + 2e-4)

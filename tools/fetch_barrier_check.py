@@ -7,7 +7,7 @@ returns early and a 4-element fetch suffices).
 Method: time the same compiled K-deep combine loop three ways —
 
   fetch4      np.asarray(out.ravel()[:4])      (bench.py's barrier)
-  checksum    on-device strided sum over the WHOLE result, scalar pulled
+  checksum    on-device full sum over the WHOLE result, scalar pulled
   sum_tiny    the same checksum program over a 4-element array, timing
               the checksum machinery itself (its dispatch overhead)
 
