@@ -26,6 +26,14 @@ from .constants import (  # noqa: F401,E402
     TuningParams,
     error_code_to_string,
 )
+from .errors import (  # noqa: F401,E402
+    ACCLValidationError,
+    DtypeMismatchError,
+    InvalidRootError,
+    LintError,
+    SequenceReuseError,
+    ZeroLengthBufferError,
+)
 from .arithconfig import ArithConfig, DEFAULT_ARITH_CONFIG  # noqa: F401
 from .communicator import Communicator, Rank, generate_ranks  # noqa: F401
 from .descriptor import CallOptions, SequenceDescriptor  # noqa: F401

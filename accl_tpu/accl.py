@@ -39,6 +39,12 @@ from .constants import (
 )
 from .descriptor import CallOptions
 from .device.base import CCLOAddr
+from .errors import (
+    DtypeMismatchError,
+    InvalidRootError,
+    SequenceReuseError,
+    ZeroLengthBufferError,
+)
 from .device.tpu_device import TPUDevice
 from .request import BaseRequest
 from .utils.logging import Log
@@ -201,20 +207,27 @@ class ACCL:
         if scenario in (Operation.bcast, Operation.scatter, Operation.gather,
                         Operation.reduce):
             if not 0 <= root_src_dst < comm.size:
-                raise ValueError(
+                raise InvalidRootError(
                     f"root {root_src_dst} outside communicator of {comm.size}")
         elif scenario in (Operation.send, Operation.recv):
             src, dst = root_src_dst & 0xFFFF, (root_src_dst >> 16) & 0xFFFF
             if src >= comm.size or dst >= comm.size:
-                raise ValueError(
+                raise InvalidRootError(
                     f"src/dst ({src},{dst}) outside communicator of {comm.size}")
+        # a zero-length payload would compile a shape-degenerate schedule
+        # and, dispatched device-resident, fail with no host-side symptom
+        if count <= 0 and scenario not in (Operation.barrier,
+                                           Operation.config, Operation.nop):
+            raise ZeroLengthBufferError(
+                f"{scenario.name} with count {count}: data-plane calls "
+                "need a positive element count")
         dtype = None
         for b in (op0, op1, res):
             if b is not None and not isinstance(b, DummyBuffer):
                 if dtype is None:
                     dtype = b.data_type
                 elif b.data_type != dtype:
-                    raise NotImplementedError(
+                    raise DtypeMismatchError(
                         "mixed-dtype operands: use compress_dtype for wire "
                         "compression instead"
                     )
@@ -551,7 +564,8 @@ class ACCL:
     # call sequences: record a batch, dispatch ONE fused program
     # ------------------------------------------------------------------ #
 
-    def sequence(self, comm: Communicator | None = None) -> "SequenceRecorder":
+    def sequence(self, comm: Communicator | None = None,
+                 lint: str = "error") -> "SequenceRecorder":
         """Start recording a call sequence: collective/copy/combine calls
         on the returned recorder queue descriptors host-side (nothing
         executes), then `run()` lowers the WHOLE batch into one compiled
@@ -565,12 +579,20 @@ class ACCL:
             # one dispatch happened; results are in b and c
 
         Results are bitwise-identical to issuing the same calls eagerly
-        back to back (the cross-executor fuzz pins this)."""
+        back to back (the cross-executor fuzz pins this).
+
+        `lint` runs the batch through the static analyzer
+        (accl_tpu/analysis/, docs/lint.md) before it compiles:
+        "error" (default) raises errors.LintError on hazardous batches,
+        "warn" logs the diagnostics and proceeds, "off" opts out."""
+        if lint not in ("error", "warn", "off"):
+            raise ValueError(
+                f"lint must be 'error'|'warn'|'off', got {lint!r}")
         if not hasattr(self.cclo, "start_sequence"):
             raise NotImplementedError(
                 f"{type(self.cclo).__name__} does not support call "
                 "sequences")
-        return SequenceRecorder(self, comm)
+        return SequenceRecorder(self, comm, lint=lint)
 
     def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
@@ -786,9 +808,11 @@ class SequenceRecorder:
     methods return the recorder, so chains compose fluently; send/recv
     and barrier cannot ride a sequence (host-paired / payload-free)."""
 
-    def __init__(self, accl: ACCL, comm: Communicator | None = None):
+    def __init__(self, accl: ACCL, comm: Communicator | None = None,
+                 lint: str = "error"):
         self._accl = accl
         self._comm = comm
+        self._lint = lint
         self.calls: list[CallOptions] = []
         self._reads: list[BaseBuffer] = []  # per-step operand buffers
         self._writes: list[BaseBuffer] = []  # per-step result buffers
@@ -807,7 +831,8 @@ class SequenceRecorder:
 
     def _record(self, opts: CallOptions, reads, writes) -> "SequenceRecorder":
         if self._ran:
-            raise RuntimeError("sequence already executed; record a new one")
+            raise SequenceReuseError(
+                "sequence already executed; record a new one")
         self.calls.append(opts)
         self._reads.append(list(reads))
         self._writes.append(list(writes))
@@ -915,7 +940,8 @@ class SequenceRecorder:
         is what the fusion removes); run_async returns the request, to be
         completed with accl.wait()."""
         if self._ran:
-            raise RuntimeError("sequence already executed; record a new one")
+            raise SequenceReuseError(
+                "sequence already executed; record a new one")
         if not self.calls:
             raise ValueError("empty sequence: record at least one call")
         self._ran = True
@@ -924,5 +950,5 @@ class SequenceRecorder:
         accl._stage_in(sync_in, from_device)
         Log.debug("sequence of %d: %s", len(self.calls),
                   "+".join(o.scenario.name for o in self.calls))
-        req = accl.cclo.start_sequence(self.calls)
+        req = accl.cclo.start_sequence(self.calls, lint=self._lint)
         return accl._complete(req, sync_out, to_device, run_async)

@@ -1,0 +1,42 @@
+"""Static analysis for descriptor batches: the pre-dispatch lint gate.
+
+ACCL's core inversion — the host records descriptors, the device runs
+the whole batch — means a mis-recorded batch fails AFTER dispatch: a
+hang, or a silently wrong buffer (the debugging pain ACCL+, arxiv
+2312.11742, reports for FPGA-resident sequences). This package checks
+recorded `SequenceDescriptor` batches and per-rank descriptor chains
+BEFORE anything compiles or touches a device, emitting structured
+diagnostics with stable codes (docs/lint.md has the full table):
+
+  hazards.py    RAW/WAR/WAW aliasing + dtype flow over the canonical
+                address renaming               (ACCL101-103, 401, 405)
+  protocol.py   per-rank send/recv matching, deadlock cycles, and
+                abstract interpretation of schedule bodies (ACCL201-204)
+  slots.py      overlap-slot collective_id liveness (ACCL301-302)
+  validate.py   descriptor structure: roots, counts, dtypes,
+                communicators                  (ACCL401-404)
+  linter.py     the SequenceLinter orchestrator + lint_sequence()
+
+Wired in three places: the opt-out `lint=` stage in `ACCL.sequence()`
+(enforced in TPUDevice.start_sequence, cached by composite signature),
+the corpus CLI `tools/accl_lint.py`, and the CI lint job.
+"""
+
+from ..errors import LintError  # noqa: F401  (canonical home: errors.py)
+from .diagnostics import CODES, Diagnostic, enforce, make  # noqa: F401
+from .hazards import analyze_dataflow  # noqa: F401
+from .linter import SequenceLinter, lint_sequence  # noqa: F401
+from .protocol import (  # noqa: F401
+    Event,
+    interpret_schedule,
+    rank_programs_from_options,
+    simulate,
+    trace_schedule_hops,
+)
+from .slots import (  # noqa: F401
+    SlotInstance,
+    SlotTimeline,
+    check_slots,
+    ring_slot_timeline,
+)
+from .validate import validate_steps  # noqa: F401

@@ -1,0 +1,118 @@
+"""Diagnostic codes and records for the sequence linter.
+
+Every defect class the static analyzer can find has a STABLE code, so
+corpus fixtures, CI gates, suppression lists, and docs all key on the
+same identifiers (docs/lint.md holds the user-facing table):
+
+  ACCL1xx  dataflow hazards over the canonical buffer renaming
+  ACCL2xx  protocol defects (send/recv matching, deadlock)
+  ACCL3xx  overlap-slot / collective_id resource defects
+  ACCL4xx  descriptor validation (shape, dtype, root, communicator)
+
+Severity semantics: an `error` is a batch the analyzer can prove wrong
+on SOME shipping executor (stale reads, deadlock, slot cross-talk,
+malformed descriptors); a `warning` is a batch whose fused-program
+semantics are well-defined but that races on an executor free to
+overlap unordered steps (the device-resident FIFO posture) — almost
+always a mis-recorded batch, never silently wrong under the current
+fused lowering. `lint="error"` raises on errors and logs warnings;
+`lint="warn"` logs both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..errors import LintError
+
+__all__ = ["CODES", "Diagnostic", "LintError", "make", "enforce"]
+
+# code -> (kebab-case name, default severity, one-line description)
+CODES: dict[str, tuple[str, str, str]] = {
+    "ACCL101": ("raw-hazard", "error",
+                "read extends past the region the producing step wrote "
+                "(fresh prefix + stale tail)"),
+    "ACCL102": ("war-hazard", "warning",
+                "write to a buffer an earlier unordered step still reads"),
+    "ACCL103": ("waw-hazard", "warning",
+                "two unordered steps write the same buffer"),
+    "ACCL201": ("unmatched-sendrecv", "error",
+                "send or recv with no matching partner (or mismatched "
+                "payload counts)"),
+    "ACCL202": ("deadlock-cycle", "error",
+                "circular wait among blocking sends/recvs/collectives"),
+    "ACCL203": ("tag-mismatch", "error",
+                "send/recv pair on one edge whose tags can never match"),
+    "ACCL204": ("perm-conflict", "error",
+                "malformed permute hop: duplicate or out-of-range "
+                "source/destination"),
+    "ACCL301": ("slot-collision", "error",
+                "two live schedule instances share a collective_id slot "
+                "with no ordering between them"),
+    "ACCL302": ("slot-overcommit", "error",
+                "overlap window larger than the kernel's independent "
+                "slot resources"),
+    "ACCL401": ("dtype-shape-mismatch", "error",
+                "dtype or element-count inconsistency across the batch"),
+    "ACCL402": ("root-out-of-range", "error",
+                "root/src/dst rank outside the addressed communicator"),
+    "ACCL403": ("comm-mismatch", "error",
+                "steps address different communicators"),
+    "ACCL404": ("not-sequenceable", "error",
+                "descriptor kind cannot ride a fused call sequence"),
+    "ACCL405": ("buffer-underflow", "error",
+                "registered buffer narrower than the widths the batch "
+                "needs"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One linter finding, formatted `CODE name [step k] [rank r]: msg`."""
+
+    code: str
+    message: str
+    step: int | None = None  # descriptor index within the batch
+    rank: int | None = None  # communicator-relative rank, protocol passes
+
+    @property
+    def name(self) -> str:
+        return CODES[self.code][0]
+
+    @property
+    def severity(self) -> str:
+        return CODES[self.code][1]
+
+    def __str__(self) -> str:
+        where = ""
+        if self.step is not None:
+            where += f" [step {self.step}]"
+        if self.rank is not None:
+            where += f" [rank {self.rank}]"
+        return f"{self.code} {self.name}{where}: {self.message}"
+
+
+def make(code: str, message: str, step: int | None = None,
+         rank: int | None = None) -> Diagnostic:
+    if code not in CODES:
+        raise KeyError(f"unknown diagnostic code {code!r}")
+    return Diagnostic(code, message, step, rank)
+
+
+def enforce(diagnostics, mode: str) -> None:
+    """Apply a lint mode to a diagnostic list: `"error"` raises LintError
+    on error-severity findings (warnings are logged), `"warn"` logs
+    everything, `"off"` is a no-op. The full diagnostic list — warnings
+    included — rides any raised LintError."""
+    if mode not in ("error", "warn", "off"):
+        raise ValueError(f"lint mode must be 'error'|'warn'|'off', "
+                         f"got {mode!r}")
+    if mode == "off" or not diagnostics:
+        return
+    from ..utils.logging import Log
+
+    errors = [d for d in diagnostics if d.severity == "error"]
+    if mode == "error" and errors:
+        raise LintError(diagnostics)
+    for d in diagnostics:
+        Log.warning("lint: %s", d)

@@ -1,0 +1,194 @@
+"""Overlap-slot analysis: collective_id liveness over a descriptor batch.
+
+The segmented pallas ring (ops/ring_allreduce.py) owns NUM_RING_SLOTS
+independent semaphore/comm-buffer sets, keyed by collective_id. The
+lowering double-buffers segments across those slots (segmented_apply
+overlap_slots) and orders only slot REUSE: segment i depends on segment
+i-k. Two kernel instances that share a collective_id while both live
+would cross-talk on the shared semaphores — the exact silent-corruption
+failure the slot keying exists to prevent, and invisible post-dispatch.
+
+This pass rebuilds the slot timeline a batch will execute — every ring
+instance each step launches, its slot assignment, and the ordering
+edges the builder inserts (intra-step slot-reuse chains, plus
+sequence.py's cross-step _ordered_after chaining of consecutive ring
+steps) — and then checks the invariant from scratch:
+
+  ACCL301 slot-collision   two instances share a slot with no ordering
+                           path between them
+  ACCL302 slot-overcommit  the overlap window claims more concurrent
+                           instances than the kernel has slot resources
+                           (or a slot id outside the kernel's range)
+
+On the shipping lowering these cannot fire by construction; the pass is
+the regression gate that keeps that true as the lowering evolves, and
+the corpus exercises both codes through hand-built timelines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..constants import Operation, dtype_nbytes
+from ..sequencer.sequence import step_in_elems
+from .diagnostics import Diagnostic, make
+
+__all__ = [
+    "SlotInstance",
+    "SlotTimeline",
+    "check_slots",
+    "ring_slot_timeline",
+]
+
+# instances beyond this are a periodic continuation of the same slot
+# pattern; analyzing one full period past the cap adds no information
+MAX_INSTANCES = 256
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotInstance:
+    """One kernel launch: (step, segment) holding slot `slot`."""
+
+    step: int
+    segment: int
+    slot: int
+
+
+@dataclasses.dataclass
+class SlotTimeline:
+    """A batch's kernel launches in issue order plus the ordering edges
+    (indices into `instances`) the program graph enforces."""
+
+    num_slots: int
+    instances: list[SlotInstance]
+    deps: set[tuple[int, int]]
+    truncated: bool = False
+
+
+def ring_slot_timeline(
+    steps,
+    world: int,
+    *,
+    overlap: bool = True,
+    num_slots: int | None = None,
+    max_seg_bytes: int | None = None,
+) -> SlotTimeline:
+    """Mirror the lowering's slot assignment for a descriptor batch:
+    allreduce steps chunk into PALLAS_RING_MAX_BYTES segments; overlap
+    mode rotates segments through the kernel's slots with slot-reuse
+    ordering (segmented_apply overlap_slots), serialize mode chains
+    every segment through slot 0; consecutive ring steps are ordered
+    end-to-start (sequence.py's prev_ring chaining)."""
+    from ..ops.ring_allreduce import NUM_RING_SLOTS
+    from ..sequencer.lowering import ScheduleCompiler
+
+    if num_slots is None:
+        num_slots = NUM_RING_SLOTS
+    if max_seg_bytes is None:
+        max_seg_bytes = ScheduleCompiler.PALLAS_RING_MAX_BYTES
+
+    instances: list[SlotInstance] = []
+    deps: set[tuple[int, int]] = set()
+    truncated = False
+    prev_step_range: tuple[int, int] | None = None  # instance idx span
+    for k, opts in enumerate(steps):
+        if opts.scenario != Operation.allreduce:
+            continue
+        elem_bytes = max(dtype_nbytes(opts.data_type), 1)
+        seg_elems = max(max_seg_bytes // elem_bytes, 1)
+        count = step_in_elems(opts, world)
+        nseg = max(-(-count // seg_elems), 1)
+        if len(instances) + nseg > MAX_INSTANCES:
+            nseg = max(MAX_INSTANCES - len(instances), 1)
+            truncated = True
+        base = len(instances)
+        for i in range(nseg):
+            slot = (i % num_slots) if overlap and num_slots > 0 else 0
+            instances.append(SlotInstance(k, i, slot))
+            if overlap and num_slots > 0:
+                if i >= num_slots:
+                    deps.add((base + i - num_slots, base + i))
+            elif i > 0:
+                deps.add((base + i - 1, base + i))  # serialized chain
+        if prev_step_range is not None:
+            # _ordered_after(ins[0], prev_ring): the whole next ring
+            # step starts after the previous ring step's output
+            for a in range(*prev_step_range):
+                for b in range(base, len(instances)):
+                    deps.add((a, b))
+        prev_step_range = (base, len(instances))
+    return SlotTimeline(num_slots, instances, deps, truncated)
+
+
+def check_slots(timeline: SlotTimeline) -> list[Diagnostic]:
+    """Verify no two unordered instances share a collective_id slot and
+    every slot id fits the kernel's resources."""
+    diags: list[Diagnostic] = []
+    n = len(timeline.instances)
+    if timeline.num_slots < 1:
+        diags.append(make("ACCL302",
+                          f"kernel exposes {timeline.num_slots} slots"))
+        return diags
+    for i, inst in enumerate(timeline.instances):
+        if not 0 <= inst.slot < timeline.num_slots:
+            diags.append(make(
+                "ACCL302",
+                f"instance (step {inst.step}, segment {inst.segment}) "
+                f"claims slot {inst.slot} of a {timeline.num_slots}-slot "
+                "kernel", step=inst.step))
+    if any(d.code == "ACCL302" for d in diags):
+        return diags
+
+    # transitive closure over ordering edges (instance count is capped)
+    succ: list[set[int]] = [set() for _ in range(n)]
+    for a, b in timeline.deps:
+        if 0 <= a < n and 0 <= b < n:
+            succ[a].add(b)
+    reach: list[set[int]] = [set() for _ in range(n)]
+    order = _topo_order(n, succ)
+    if order is None:
+        # an ordering cycle means the timeline itself is malformed;
+        # report instead of looping
+        diags.append(make("ACCL301",
+                          "ordering edges form a cycle: timeline invalid"))
+        return diags
+    for i in reversed(order):
+        for j in succ[i]:
+            reach[i].add(j)
+            reach[i] |= reach[j]
+
+    by_slot: dict[int, list[int]] = {}
+    for i, inst in enumerate(timeline.instances):
+        by_slot.setdefault(inst.slot, []).append(i)
+    for slot, idxs in sorted(by_slot.items()):
+        for x in range(len(idxs)):
+            for y in range(x + 1, len(idxs)):
+                a, b = idxs[x], idxs[y]
+                if b not in reach[a] and a not in reach[b]:
+                    ia, ib = timeline.instances[a], timeline.instances[b]
+                    diags.append(make(
+                        "ACCL301",
+                        f"(step {ia.step}, segment {ia.segment}) and "
+                        f"(step {ib.step}, segment {ib.segment}) both "
+                        f"hold collective_id slot {slot} with no "
+                        "ordering between them: concurrent instances "
+                        "would cross-talk on the slot's semaphores",
+                        step=ib.step))
+    return diags
+
+
+def _topo_order(n: int, succ) -> list[int] | None:
+    indeg = [0] * n
+    for i in range(n):
+        for j in succ[i]:
+            indeg[j] += 1
+    queue = [i for i in range(n) if indeg[i] == 0]
+    order: list[int] = []
+    while queue:
+        i = queue.pop()
+        order.append(i)
+        for j in succ[i]:
+            indeg[j] -= 1
+            if indeg[j] == 0:
+                queue.append(j)
+    return order if len(order) == n else None

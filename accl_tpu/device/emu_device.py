@@ -13,6 +13,7 @@ hardware in the loop (SURVEY.md §4).
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
 import socket
 import subprocess
@@ -24,11 +25,8 @@ from ..constants import (
     ACCLError,
     DEFAULT_EAGER_RX_BUF_SIZE,
     DEFAULT_MAX_EAGER_SIZE,
-    DEFAULT_MAX_RENDEZVOUS_SIZE,
     DEFAULT_NUM_EAGER_RX_BUFS,
-    DataType,
     Operation,
-    ReduceFunction,
     TAG_ANY,
     from_numpy_dtype,
 )
@@ -41,16 +39,27 @@ _lib_lock = threading.Lock()
 
 
 def load_native():
-    """Load (building if needed) the native runtime library."""
+    """Load (building if needed) the native runtime library.
+
+    ACCL_NATIVE_LIB overrides the library path — the sanitizer CI lane
+    points it at the ASan/UBSan build (native/libacclrt.san.so, `make
+    -C native sanitize`) so the same test suite exercises the
+    instrumented data plane without touching the default artifact."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        # always invoke make: a fresh build is a no-op, and a stale .so
-        # silently shadowing source edits is worse than the fork cost
-        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
-                       capture_output=True)
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        override = os.environ.get("ACCL_NATIVE_LIB")
+        if override:
+            lib_path = pathlib.Path(override).resolve()
+        else:
+            # always invoke make: a fresh build is a no-op, and a stale
+            # .so silently shadowing source edits is worse than the
+            # fork cost
+            subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                           capture_output=True)
+            lib_path = _LIB_PATH
+        lib = ctypes.CDLL(str(lib_path))
         lib.accl_rt_create.restype = ctypes.c_void_p
         lib.accl_rt_create.argtypes = [
             ctypes.c_uint32, ctypes.c_uint32,

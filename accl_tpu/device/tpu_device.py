@@ -72,6 +72,8 @@ class TPUDevice(CCLODevice):
 
         self.streams = StreamRegistry()
         self._stream_cache: dict = {}
+        # composite-signature -> lint diagnostics (sequence lint stage)
+        self._lint_cache: dict = {}
         # comm_addr -> resolved communicator context (the firmware caches
         # the addressed communicator per call, ccl_offload_control.c:2317-2372)
         self._comm_cache: dict[int, "_CommCtx"] = {}
@@ -333,13 +335,20 @@ class TPUDevice(CCLODevice):
 
     # -- call sequences (device-resident descriptor batches) ---------------
 
-    def start_sequence(self, options_list) -> BaseRequest:
+    def start_sequence(self, options_list, lint: str = "error") -> BaseRequest:
         """Execute a recorded batch of call descriptors as ONE compiled
         device program (sequencer.sequence.SequencePlan): a single
         dispatch for the whole chain, intermediate results threaded
         on-device between stages instead of re-crossing the host. Plans
         are selected per step with the live tuning registers, exactly as
-        the eager path would."""
+        the eager path would.
+
+        `lint` gates the batch through the static analyzer
+        (accl_tpu/analysis/) BEFORE anything compiles: "error" rejects
+        hazardous batches with a typed LintError, "warn" logs the
+        diagnostics and proceeds, "off" skips the stage. Results are
+        cached under the same composite signature the compiled program
+        is, so a re-recorded batch re-lints nothing."""
         from ..descriptor import SequenceDescriptor
         from ..request import SequenceRequest
         from ..sequencer.sequence import SequencePlan
@@ -353,6 +362,9 @@ class TPUDevice(CCLODevice):
             plan, producer, consumer = self._resolve_step(opts, ctx, tuning)
             plans.append(plan)
             endpoints.append((producer, consumer))
+
+        if lint != "off":
+            self._lint_batch(desc, tuple(plans), ctx, lint)
 
         seq = SequencePlan(desc, plans, ctx.world, endpoints)
         bufs = {addr: self._buf(addr) for addr in seq.buffer_addrs}
@@ -389,6 +401,40 @@ class TPUDevice(CCLODevice):
 
         req = SequenceRequest(list(outs), plans, on_complete=place)
         return req
+
+    def _lint_batch(self, desc, plans, ctx, mode: str) -> None:
+        """The opt-out static gate in front of compile_sequence: lint
+        diagnostics are cached by the batch's composite signature (the
+        same canonical renaming the compile cache keys on), so steady
+        state pays a dict lookup. Buffer widths come from the registry
+        where registered, enabling the static underflow check."""
+        from ..analysis.diagnostics import enforce
+        from ..analysis.linter import SequenceLinter
+
+        widths = {}
+        canon: list[int] = []  # widths in canonical (renamed) order, so
+        # the cache can never alias two batches whose buffers differ
+        for opts in desc.steps:
+            for addr in (opts.addr_0, opts.addr_1, opts.addr_2):
+                buf = self.buffers.get(addr)
+                if addr and buf is not None and addr not in widths:
+                    widths[addr] = buf.shape[-1]
+                    canon.append(widths[addr])
+        key = (desc.signature(), plans, ctx.world, tuple(canon),
+               ctx.compiler.use_pallas_ring,
+               ctx.compiler.pallas_ring_overlap)
+        diags = self._lint_cache.get(key)
+        if diags is None:
+            linter = SequenceLinter(
+                ctx.world,
+                use_pallas_ring=ctx.compiler.use_pallas_ring,
+                pallas_ring_overlap=ctx.compiler.pallas_ring_overlap,
+                axis_name=self.axis_name,
+            )
+            diags = tuple(linter.lint(desc.steps, plans,
+                                      buffer_widths=widths))
+            self._lint_cache[key] = diags
+        enforce(diags, mode)
 
     # -- send/recv pairing ------------------------------------------------
 
@@ -632,6 +678,7 @@ class TPUDevice(CCLODevice):
                     if parked.claim():
                         parked._timeout_fire()
             self.compiler._cache.clear()
+            self._lint_cache.clear()
             self._comm_cache.clear()
             self._comm_extents.clear()
             self._group_cache.clear()

@@ -1,0 +1,77 @@
+"""Typed host-side errors: the driver's validation contract.
+
+The reference driver fails a bad call with a retcode AFTER dispatch
+(check_return_value, accl.cpp:1210-1234); a mis-parameterized call on the
+device-resident sequence path would instead hang or corrupt a buffer with
+no host-side symptom at all (the ACCL+ debugging pain, arxiv 2312.11742).
+So every host-side precondition failure raises a TYPED error from this
+module — callers can catch the precise failure class, tests can pin it,
+and each class maps onto a static-analysis diagnostic code (the
+`lint_code` attribute) so the same defect is reported identically whether
+it is caught at call time or by the sequence linter
+(accl_tpu/analysis/, docs/lint.md).
+
+Subclassing keeps backward compatibility: code that caught the untyped
+ValueError / RuntimeError / NotImplementedError these paths used to raise
+still works.
+"""
+
+from __future__ import annotations
+
+
+class ACCLValidationError(ValueError):
+    """Base class for host-side call/descriptor validation failures.
+
+    `lint_code` is the diagnostic code (docs/lint.md) the sequence
+    linter emits for the same defect found statically.
+    """
+
+    lint_code: str | None = None
+
+
+class InvalidRootError(ACCLValidationError):
+    """Root / src / dst rank outside the addressed communicator
+    (lint: ACCL402 root-out-of-range)."""
+
+    lint_code = "ACCL402"
+
+
+class ZeroLengthBufferError(ACCLValidationError):
+    """A data-plane call with a non-positive element count — the compiled
+    schedule would be shape-degenerate (lint: ACCL401)."""
+
+    lint_code = "ACCL401"
+
+
+class DtypeMismatchError(ACCLValidationError, NotImplementedError):
+    """Operand/result dtypes disagree within one call (use compress_dtype
+    for wire compression instead). Also NotImplementedError for backward
+    compatibility with the facade's historical raise
+    (lint: ACCL401 dtype/shape-mismatch)."""
+
+    lint_code = "ACCL401"
+
+
+class SequenceReuseError(RuntimeError):
+    """A completed SequenceRecorder handle was reused — recording into or
+    re-running an executed batch. RuntimeError subclass for backward
+    compatibility with the recorder's historical raise."""
+
+
+class LintError(ACCLValidationError):
+    """A recorded descriptor batch failed static analysis with
+    `lint="error"` (accl_tpu/analysis/). Carries the structured
+    diagnostics so callers and tests can inspect codes individually."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = tuple(diagnostics)
+        lines = [f"sequence rejected by lint ({len(self.diagnostics)} "
+                 "diagnostic(s)):"]
+        lines += [f"  {d}" for d in self.diagnostics]
+        lines.append("  (suppress with lint='warn' or lint='off'; see "
+                     "docs/lint.md)")
+        super().__init__("\n".join(lines))
+
+    @property
+    def codes(self) -> tuple[str, ...]:
+        return tuple(d.code for d in self.diagnostics)

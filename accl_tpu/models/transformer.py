@@ -21,7 +21,6 @@ Sharding layout over mesh axes (dp, sp, tp):
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp

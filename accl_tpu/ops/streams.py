@@ -69,10 +69,17 @@ def splice_producer(body, producer, n_expected):
     """Wrap a 1-operand schedule body so its operand comes from a traced
     producer instead of a buffer (OP0_STREAM semantics: streams are read
     once, never segmented — .c:929-931)."""
+    from jax import lax
 
-    def wrapped(_placeholder):
+    def wrapped(placeholder):
         data = producer()
         data = jnp.reshape(data, (-1,))[:n_expected]
+        # the placeholder operand may CARRY ordering edges (the fused
+        # sequence path barriers a ring step's operand after the
+        # previous ring step, sequence.py); thread it through an
+        # order-only barrier so those edges survive the splice instead
+        # of vanishing with the unused argument
+        data, _ = lax.optimization_barrier((data, placeholder))
         return body(data)
 
     return wrapped

@@ -154,7 +154,9 @@ class ParkedRecvRequest(BaseRequest):
         self._paired = threading.Event()
         self._claim_lock = threading.Lock()
         self._claimed = False
-        self._unpark = lambda: None  # set by the device to drop the parking
+        # set by the device to drop the parking; a do-nothing callable,
+        # not a def, so reassignment stays symmetric
+        self._unpark = lambda: None  # noqa: E731
 
     def claim(self) -> bool:
         """Atomically claim the right to decide this request's outcome."""

@@ -23,7 +23,6 @@ from ..utils import compat as _compat
 _compat.install()  # jax version shims, before any jax.shard_map use
 
 import jax  # noqa: E402
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
 from ..arithconfig import DEFAULT_ARITH_CONFIG, ArithConfig
@@ -134,12 +133,15 @@ class ScheduleCompiler:
         body, n_in = self._body(options, plan, arithcfg)
         return self._finalize(body, n_in)
 
-    def _finalize(self, body, n_in: int) -> Callable:
+    def _finalize(self, body, n_in: int, wrap=None) -> Callable:
+        """shard_map + jit finalization shared by the per-call and
+        call-sequence paths; `wrap` adapts the body's calling convention
+        (single (1, n)-shard result by default, tuples for sequences)."""
         spec = PartitionSpec(self.axis_name)
         # vma checking is disabled because the pallas-lowered bodies carry
         # explicit vma annotations the checker cannot yet propagate through.
         shmapped = jax.shard_map(
-            _squeeze_wrap(body, n_in),
+            (wrap or _squeeze_wrap)(body, n_in),
             mesh=self.mesh,
             in_specs=(spec,) * n_in,
             out_specs=spec,
@@ -447,21 +449,8 @@ class ScheduleCompiler:
         return fn
 
     def _finalize_sequence(self, body, n_in: int) -> Callable:
-        spec = PartitionSpec(self.axis_name)
-
-        def wrapped(*args):
-            flat = [a.reshape(a.shape[-1]) for a in args]
-            outs = body(*flat)
-            return tuple(o.reshape(1, o.shape[-1]) for o in outs)
-
-        shmapped = jax.shard_map(
-            wrapped,
-            mesh=self.mesh,
-            in_specs=(spec,) * n_in,
-            out_specs=spec,
-            check_vma=False,
-        )
-        return jax.jit(shmapped)
+        # kept as a distinct seam (tests pin it to detect re-traces)
+        return self._finalize(body, n_in, wrap=_tuple_wrap)
 
     # -- convenience: full pipeline from descriptor ------------------------
 
@@ -493,5 +482,18 @@ def _squeeze_wrap(body, n_in):
         flat = [a.reshape(a.shape[-1]) for a in args]
         out = body(*flat)
         return out.reshape(1, out.shape[-1])
+
+    return wrapped
+
+
+def _tuple_wrap(body, n_in):
+    """The call-sequence calling convention: the fused body returns one
+    flat buffer per written address; each reshapes back to a (1, n)
+    shard."""
+
+    def wrapped(*args):
+        flat = [a.reshape(a.shape[-1]) for a in args]
+        outs = body(*flat)
+        return tuple(o.reshape(1, o.shape[-1]) for o in outs)
 
     return wrapped

@@ -29,8 +29,6 @@ Algorithm provenance (reference ccl_offload_control.c):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -397,8 +395,13 @@ def _ordered_after(seg_in, prev_out):
     been computed, without changing its value. optimization_barrier (not
     `+ prev*0`) because the algebraic simplifier folds mul-by-zero away
     for integer dtypes, which would silently drop the serialization the
-    slot-keyed kernel semaphores rely on."""
-    seg_in, _ = lax.optimization_barrier((seg_in, prev_out[:1]))
+    slot-keyed kernel semaphores rely on. The barrier takes the WHOLE
+    prev_out: narrowing it first (e.g. prev_out[:1]) would let the
+    simplifier reduce a slice of a concatenation to a slice of its
+    FIRST operand — and a segmented ring step's output IS a concat — so
+    the dependency on segments 2..N would silently vanish (the same
+    hazard the serialize tail path below documents)."""
+    seg_in, _ = lax.optimization_barrier((seg_in, prev_out))
     return seg_in
 
 

@@ -145,6 +145,29 @@ class SequencePlan:
     def n_in(self) -> int:
         return len(self.buffer_addrs)
 
+    def lint(self, *, use_pallas_ring: bool = False,
+             pallas_ring_overlap: bool = True, deep: bool = False,
+             buffer_widths: dict[int, int] | None = None,
+             axis_name: str = "ccl"):
+        """Run the static analyzer (accl_tpu/analysis/) over this plan's
+        descriptor batch and return the diagnostic list — the same gate
+        TPUDevice.start_sequence applies before compile_sequence, here
+        callable on a standalone plan (corpus replay, tests). The flags
+        mirror the ScheduleCompiler configuration the batch would lower
+        under, so the slot model matches the real launch."""
+        from ..analysis.linter import SequenceLinter
+
+        linter = SequenceLinter(
+            self.world,
+            use_pallas_ring=use_pallas_ring,
+            pallas_ring_overlap=pallas_ring_overlap,
+            deep=deep,
+            axis_name=axis_name,
+        )
+        return linter.lint(self.descriptor.steps,
+                           [st.plan for st in self.steps],
+                           buffer_widths=buffer_widths)
+
     def min_widths(self) -> dict[int, int]:
         """Per-address minimum buffer width (elements) the batch needs —
         execution-time validation against the registered buffers."""

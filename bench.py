@@ -396,15 +396,69 @@ def bench_ring_overlap(jax, world, nbytes=64 * 1024 * 1024):
     return rows
 
 
+def measure_lint_overhead(jax, world, n_elems=8192, iters=20):
+    """The lint stage's cost against the record+compile time it guards:
+    record the smoke chain on a FRESH ACCL (cold caches), time its
+    first run (lowering + XLA compile) with lint off, then time the
+    same batch through the analyzer. Returns
+    (lint_sec, record_compile_sec, ratio). The smoke gate asserts
+    ratio < 0.05 — the static gate must stay invisible next to the
+    compile it fronts."""
+    from jax.sharding import Mesh
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.accl import ACCL
+    from accl_tpu.analysis.linter import SequenceLinter
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    n = (n_elems // world) * world
+    chunk = n // world
+    a = accl.create_buffer(n)
+    b = accl.create_buffer(chunk)
+    c = accl.create_buffer(n)
+
+    t0 = time.perf_counter()
+    seq = accl.sequence(lint="off")
+    seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+    seq.allgather(b, c, chunk)
+    seq.bcast(c, n, 0)
+    steps = list(seq.calls)
+    seq.run(from_device=True, to_device=True).wait()
+    record_compile = time.perf_counter() - t0
+
+    linter = SequenceLinter(world)  # the in-band (shallow) configuration
+    widths = {o.addr_0: n for o in steps} | {steps[0].addr_2: chunk}
+    linter.lint(steps, buffer_widths=widths)  # warm imports
+    lint_sec = min(
+        _time_wall(lambda: linter.lint(steps, buffer_widths=widths))
+        for _ in range(iters))
+    return lint_sec, record_compile, lint_sec / record_compile
+
+
+def _time_wall(fn):
+    t = time.perf_counter()
+    fn()
+    return time.perf_counter() - t
+
+
 def _smoke_main():
     """bench.py --smoke: the CI-facing quick lane — runs the fused-vs-
     eager sequence benchmark on the virtual CPU mesh and emits ONE JSON
     line whose value is the speedup, so per-PR regressions in the fused
-    path are visible without the full sweep."""
+    path are visible without the full sweep. Also gates the sequence
+    linter's overhead: the static analysis stage must cost <5% of the
+    record+compile time it fronts."""
     import jax
 
     world = min(len(jax.devices()), 4)
     rows, speedup = bench_sequence(jax, world)
+    lint_sec, rc_sec, lint_ratio = measure_lint_overhead(jax, world)
+    rows.append(("sequence_lint_overhead", 0, lint_sec, lint_ratio,
+                 1.0, True))
+    print(f"  lint stage {lint_sec*1e6:8.1f} us vs record+compile "
+          f"{rc_sec*1e3:8.1f} ms ({lint_ratio*100:.3f}%)",
+          file=sys.stderr)
     outdir = pathlib.Path(__file__).parent / "accl_log"
     outdir.mkdir(exist_ok=True)
     with open(outdir / "profile_smoke.csv", "w") as f:
@@ -428,6 +482,13 @@ def _smoke_main():
     if speedup < 1.15:
         print(f"WARN: fused speedup {speedup:.2f}x below the 1.15x target",
               file=sys.stderr)
+    # the lint gate is real too: the static analyzer fronts every
+    # recorded batch, so its cost must stay invisible against the
+    # record+compile it guards (<5%, measured on this very run)
+    if lint_ratio >= 0.05:
+        print(f"FAIL: lint stage costs {lint_ratio*100:.1f}% of "
+              "record+compile time (>= 5% budget)", file=sys.stderr)
+        sys.exit(1)
 
 
 def _flagship_setup(jax):

@@ -1388,7 +1388,11 @@ struct accl_rt {
     // to its msg_bytes) — an error in both modes.
     if (s.data.size() > cap) return DMA_SIZE_ERROR;
     *got = s.data.size();
-    if (ptr) std::memcpy(ptr, s.data.data(), s.data.size());
+    // empty vector's data() is null, and memcpy declares both pointers
+    // nonnull even for zero sizes (UBSan: zero-length eager segments,
+    // e.g. a world-strided chunk of a sub-world-sized buffer)
+    if (ptr && !s.data.empty())
+      std::memcpy(ptr, s.data.data(), s.data.size());
     release_slot_locked(i);
     rx_index.erase(it);
     src_valid_count[src]--;

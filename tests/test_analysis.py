@@ -1,0 +1,372 @@
+"""Sequence linter: static hazard, deadlock, and slot-collision analysis.
+
+Pins the analysis package's contract (accl_tpu/analysis/, docs/lint.md):
+every corpus fixture rejects/passes as recorded, every shipping schedule
+interprets clean per rank, hazards ride the canonical renaming, the
+facade's lint= stage raises typed LintErrors before anything compiles,
+and lint results cache under the composite signature.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from accl_tpu import LintError
+from accl_tpu.constants import (
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    DataType,
+    Operation,
+    ReduceFunction,
+    TuningParams,
+)
+from accl_tpu.descriptor import CallOptions
+from accl_tpu.analysis import (
+    CODES,
+    SequenceLinter,
+    check_slots,
+    lint_sequence,
+    simulate,
+    validate_steps,
+)
+from accl_tpu.analysis.protocol import (
+    coll,
+    interpret_schedule,
+    recv,
+    send,
+    trace_schedule_hops,
+)
+from accl_tpu.analysis.slots import SlotInstance, SlotTimeline, ring_slot_timeline
+from accl_tpu.sequencer.plan import select_algorithm
+
+CORPUS = pathlib.Path(__file__).parent.parent / "tools" / "lint_corpus"
+RNG = np.random.default_rng(11)
+
+
+def _opt(scen, count, a0=0, a2=0, *, dt=DataType.float32, root=0, a1=0,
+         comm=0, func=ReduceFunction.SUM):
+    return CallOptions(scenario=scen, count=count, comm_addr=comm,
+                       root_src_dst=root, function=int(func),
+                       data_type=dt, addr_0=a0, addr_1=a1, addr_2=a2)
+
+
+def _plan(opts, world, tuning=None):
+    from accl_tpu.constants import dtype_nbytes
+
+    return select_algorithm(
+        opts.scenario, opts.count, dtype_nbytes(opts.data_type), world,
+        max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+        eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+        tuning=tuning or TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE))
+
+
+# ---------------------------------------------------------------------------
+# corpus replay: the acceptance gate in test form
+# ---------------------------------------------------------------------------
+
+
+def _corpus_files():
+    return sorted(CORPUS.glob("*.json"))
+
+
+def test_corpus_exists_and_is_substantial():
+    files = _corpus_files()
+    bad = [f for f in files if json.loads(f.read_text())["expect"]]
+    assert len(bad) >= 10, "corpus must hold >= 10 known-bad sequences"
+    assert len(files) > len(bad), "corpus needs known-good fixtures too"
+
+
+@pytest.mark.parametrize("path", _corpus_files(), ids=lambda p: p.stem)
+def test_corpus_fixture(path):
+    """Every known-bad fixture is rejected with its expected codes;
+    every known-good fixture lints clean."""
+    import sys
+
+    sys.path.insert(0, str(CORPUS.parent))
+    try:
+        from accl_lint import lint_fixture
+    finally:
+        sys.path.pop(0)
+    fx = json.loads(path.read_text())
+    got = [d.code for d in lint_fixture(fx)]
+    if fx["expect"]:
+        for code in fx["expect"]:
+            assert code in got, f"{path.name}: expected {code}, got {got}"
+    else:
+        assert got == [], f"{path.name}: expected clean, got {got}"
+
+
+# ---------------------------------------------------------------------------
+# shipping schedules interpret clean (the conformance half of acceptance)
+# ---------------------------------------------------------------------------
+
+_ROOTED = (Operation.bcast, Operation.scatter, Operation.gather,
+           Operation.reduce)
+_TREE_TUNING = TuningParams(
+    gather_flat_tree_max_fanin=2, gather_flat_tree_max_count=64,
+    bcast_flat_tree_max_ranks=2, reduce_flat_tree_max_ranks=2,
+    reduce_flat_tree_max_count=64,
+    allreduce_composition_max_count=1 << 30)
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.parametrize("scen", [
+    Operation.bcast, Operation.scatter, Operation.gather, Operation.reduce,
+    Operation.allgather, Operation.allreduce, Operation.reduce_scatter,
+    Operation.alltoall, Operation.barrier,
+], ids=lambda s: s.name)
+def test_shipping_schedules_interpret_clean(scen, world):
+    roots = range(world) if scen in _ROOTED else (0,)
+    for root in roots:
+        for count in (16, 100_000):
+            if scen == Operation.barrier and count != 16:
+                continue
+            for tuning in (None, _TREE_TUNING):
+                opts = _opt(scen, count, 1, 2, root=root)
+                plan = _plan(opts, world, tuning)
+                diags = interpret_schedule(opts, plan, world)
+                assert diags == [], (
+                    f"{scen.name} world={world} root={root} count={count} "
+                    f"{plan.algorithm.name}: {[str(d) for d in diags]}")
+
+
+def test_hop_trace_matches_ring_structure():
+    """The abstract interpretation reads REAL schedule structure: an
+    eager-ring allgather at world=4 moves world-1 relay hops, each the
+    full ring permutation."""
+    world = 4
+    opts = _opt(Operation.allgather, 16, 1, 2)
+    hops = trace_schedule_hops(opts, _plan(opts, world), world)
+    assert len(hops) == world - 1
+    ring = tuple((i, (i + 1) % world) for i in range(world))
+    assert all(set(h) == set(ring) for h in hops)
+
+
+# ---------------------------------------------------------------------------
+# hazard pass unit coverage
+# ---------------------------------------------------------------------------
+
+
+def test_raw_hazard_stale_tail():
+    steps = [_opt(Operation.reduce_scatter, 8, 1, 2),
+             _opt(Operation.bcast, 32, 2, 2)]
+    with pytest.raises(LintError) as ei:
+        lint_sequence(steps, 4)
+    assert "ACCL101" in ei.value.codes
+    assert isinstance(ei.value, ValueError)  # typed-error contract
+
+
+def test_raw_ok_when_fully_covered():
+    steps = [_opt(Operation.reduce_scatter, 8, 1, 2),
+             _opt(Operation.allgather, 8, 2, 3),
+             _opt(Operation.bcast, 32, 3, 3)]
+    assert lint_sequence(steps, 4) == []
+
+
+def test_war_and_waw_are_warnings_not_errors():
+    war = [_opt(Operation.copy, 16, 1, 2), _opt(Operation.copy, 16, 3, 1)]
+    diags = lint_sequence(war, 4, mode="warn")
+    assert [d.code for d in diags] == ["ACCL102"]
+    assert all(d.severity == "warning" for d in diags)
+    # error mode must NOT raise on warnings
+    assert [d.code for d in lint_sequence(war, 4)] == ["ACCL102"]
+    waw = [_opt(Operation.copy, 16, 1, 3), _opt(Operation.copy, 16, 2, 3)]
+    assert [d.code for d in lint_sequence(waw, 4)] == ["ACCL103"]
+
+
+def test_waw_ordered_through_dataflow_is_clean():
+    # write c, read c into d, write c again: ordered via the RAW edge
+    steps = [_opt(Operation.combine, 24, 1, 3, a1=2),
+             _opt(Operation.allreduce, 24, 3, 4),
+             _opt(Operation.copy, 24, 4, 3)]
+    assert lint_sequence(steps, 4) == []
+
+
+def test_dtype_flow_mismatch():
+    steps = [_opt(Operation.copy, 16, 1, 2),
+             _opt(Operation.copy, 16, 2, 3, dt=DataType.int32)]
+    with pytest.raises(LintError) as ei:
+        lint_sequence(steps, 4)
+    assert "ACCL401" in ei.value.codes
+
+
+def test_buffer_underflow_static():
+    steps = [_opt(Operation.allgather, 8, 1, 2)]
+    diags = SequenceLinter(4).lint(steps, buffer_widths={1: 8, 2: 8})
+    assert [d.code for d in diags] == ["ACCL405"]
+    assert SequenceLinter(4).lint(steps, buffer_widths={1: 8, 2: 32}) == []
+
+
+# ---------------------------------------------------------------------------
+# validation pass
+# ---------------------------------------------------------------------------
+
+
+def test_validate_root_zero_count_comm_and_kind():
+    world = 4
+    assert [d.code for d in validate_steps(
+        [_opt(Operation.bcast, 8, 1, 1, root=9)], world)] == ["ACCL402"]
+    assert "ACCL401" in [d.code for d in validate_steps(
+        [_opt(Operation.allreduce, 0, 1, 2)], world)]
+    two_comms = [_opt(Operation.allreduce, 8, 1, 2, comm=0x100),
+                 _opt(Operation.bcast, 8, 2, 2, comm=0x200)]
+    assert "ACCL403" in [d.code for d in validate_steps(two_comms, world)]
+    with_barrier = [_opt(Operation.allreduce, 8, 1, 2),
+                    _opt(Operation.barrier, 0)]
+    assert "ACCL404" in [d.code for d in validate_steps(with_barrier, world)]
+
+
+# ---------------------------------------------------------------------------
+# protocol simulator
+# ---------------------------------------------------------------------------
+
+
+def test_simulate_clean_pingpong_and_collectives():
+    progs = [[send(1, tag=1), recv(1, tag=2), coll("allreduce", 16)],
+             [recv(0, tag=1), send(0, tag=2), coll("allreduce", 16)]]
+    assert simulate(progs) == []
+
+
+def test_simulate_rendezvous_deadlock_and_buffered_difference():
+    progs = [[send(1), recv(1)], [send(0), recv(0)]]
+    assert [d.code for d in simulate(progs)] == ["ACCL202"]
+    # with buffered (eager) sends the same programs complete
+    assert simulate(progs, blocking_sends=False) == []
+
+
+def test_simulate_tag_any_wildcard_matches():
+    from accl_tpu.constants import TAG_ANY
+
+    progs = [[send(1, tag=42)], [recv(0, tag=TAG_ANY)]]
+    assert simulate(progs) == []
+
+
+def test_simulate_unmatched_and_cycle():
+    assert [d.code for d in simulate([[send(1)], []])] == ["ACCL201"]
+    progs = [[recv(1), send(2)], [recv(2), send(0)], [recv(0), send(1)]]
+    diags = simulate(progs)
+    assert [d.code for d in diags] == ["ACCL202"]
+    assert "circular wait" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# slot timeline
+# ---------------------------------------------------------------------------
+
+
+def test_ring_slot_timeline_overlap_is_clean_and_collision_detected():
+    steps = [_opt(Operation.allreduce, 4 * 1024 * 1024, 1, 2),
+             _opt(Operation.allreduce, 2 * 1024 * 1024, 2, 3)]
+    for overlap in (True, False):
+        tl = ring_slot_timeline(steps, 4, overlap=overlap)
+        assert len(tl.instances) > 2  # really segmented
+        assert check_slots(tl) == []
+    # strip the builder's ordering edges: every same-slot pair collides
+    tl = ring_slot_timeline(steps, 4, overlap=True)
+    broken = SlotTimeline(tl.num_slots, tl.instances, set())
+    assert "ACCL301" in [d.code for d in check_slots(broken)]
+
+
+def test_slot_overcommit():
+    tl = SlotTimeline(2, [SlotInstance(0, 0, 0), SlotInstance(0, 1, 5)],
+                      set())
+    assert [d.code for d in check_slots(tl)] == ["ACCL302"]
+
+
+# ---------------------------------------------------------------------------
+# facade integration: the lint= stage
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def accl4(mesh4):
+    from accl_tpu.accl import ACCL
+
+    return ACCL(mesh4)
+
+
+def _bufs(accl, *widths):
+    return [accl.create_buffer(w) for w in widths]
+
+
+def test_sequence_lint_error_rejects_before_compile(accl4, monkeypatch):
+    n, chunk = 32, 8
+    a, b = _bufs(accl4, n, n)
+    compiled = []
+    monkeypatch.setattr(
+        type(accl4.cclo.compiler), "compile_sequence",
+        lambda self, seq: compiled.append(1) or (_ for _ in ()).throw(
+            AssertionError("lint must reject before compile")))
+    seq = accl4.sequence()
+    seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+    seq.bcast(b, n, 0)
+    with pytest.raises(LintError) as ei:
+        seq.run()
+    assert ei.value.codes == ("ACCL101",)
+    assert compiled == []
+
+
+def test_sequence_lint_warn_and_off_proceed(accl4):
+    n, chunk = 32, 8
+    x = RNG.standard_normal((4, n)).astype(np.float32)
+    for mode in ("warn", "off"):
+        a = accl4.create_buffer(n, data=x)
+        b = accl4.create_buffer(n)
+        seq = accl4.sequence(lint=mode)
+        seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+        seq.bcast(b, n, 0)
+        seq.run()  # hazardous but executable: warn/off let it through
+
+
+def test_sequence_lint_mode_validated_at_record_time(accl4):
+    with pytest.raises(ValueError, match="lint must be"):
+        accl4.sequence(lint="loud")
+
+
+def test_sequence_lint_result_cached_by_signature(accl4, monkeypatch):
+    n = 16
+    x = RNG.standard_normal((4, n)).astype(np.float32)
+    a, b = accl4.create_buffer(n, data=x), accl4.create_buffer(n)
+    with accl4.sequence() as s:
+        s.allreduce(a, b, n, ReduceFunction.SUM)
+        s.bcast(b, n, 0)
+    dev = accl4.cclo
+    n_cached = len(dev._lint_cache)
+    assert n_cached >= 1
+    calls = []
+    from accl_tpu.analysis.linter import SequenceLinter as SL
+
+    monkeypatch.setattr(
+        SL, "lint", lambda self, *a, **k: calls.append(1) or [])
+    # same shapes + wiring, DIFFERENT buffers: canonical renaming hits
+    a2, b2 = accl4.create_buffer(n, data=x), accl4.create_buffer(n)
+    with accl4.sequence() as s:
+        s.allreduce(a2, b2, n, ReduceFunction.SUM)
+        s.bcast(b2, n, 0)
+    assert calls == []
+    assert len(dev._lint_cache) == n_cached
+
+
+def test_sequence_plan_lint_method(accl4):
+    """SequencePlan.lint mirrors the device gate for standalone plans."""
+    from accl_tpu.descriptor import SequenceDescriptor
+    from accl_tpu.sequencer.sequence import SequencePlan
+
+    steps = (_opt(Operation.allreduce, 16, 0x10, 0x20),
+             _opt(Operation.bcast, 16, 0x20, 0x20))
+    desc = SequenceDescriptor(steps)
+    plans = [_plan(o, 4) for o in steps]
+    sp = SequencePlan(desc, plans, 4)
+    assert sp.lint() == []
+    assert sp.lint(deep=True) == []
+
+
+def test_lint_diagnostic_codes_documented():
+    """Every code the analyzer can emit appears in docs/lint.md."""
+    doc = (pathlib.Path(__file__).parent.parent / "docs"
+           / "lint.md").read_text()
+    for code in CODES:
+        assert code in doc, f"{code} missing from docs/lint.md"

@@ -18,7 +18,6 @@ import sys
 import textwrap
 
 import numpy as np
-import pytest
 
 import ml_dtypes
 

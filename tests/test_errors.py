@@ -1,0 +1,142 @@
+"""Typed host-side validation errors (accl_tpu/errors.py).
+
+Every descriptor-validation failure must raise a PRECISE exception
+class host-side — catchable individually, backward compatible with the
+untyped classes these paths historically raised — and each class maps
+(via `lint_code`) onto the static-analysis diagnostic the linter emits
+for the same defect, with a corpus fixture pinning that mapping.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from accl_tpu import (
+    ACCLValidationError,
+    DtypeMismatchError,
+    InvalidRootError,
+    LintError,
+    ReduceFunction,
+    SequenceReuseError,
+    ZeroLengthBufferError,
+)
+from accl_tpu.accl import ACCL
+
+CORPUS = pathlib.Path(__file__).parent.parent / "tools" / "lint_corpus"
+RNG = np.random.default_rng(23)
+
+
+@pytest.fixture()
+def accl4(mesh4):
+    return ACCL(mesh4)
+
+
+def _buf(accl, n, data=None):
+    return accl.create_buffer(n, data=data)
+
+
+# ---------------------------------------------------------------------------
+# invalid root rank
+# ---------------------------------------------------------------------------
+
+
+def test_invalid_root_typed_and_backcompat(accl4):
+    n = 16
+    a = _buf(accl4, n)
+    with pytest.raises(InvalidRootError, match="outside communicator"):
+        accl4.bcast(a, n, 4)
+    with pytest.raises(ValueError):  # backward-compatible class
+        accl4.bcast(a, n, 4)
+    b = _buf(accl4, n)
+    with pytest.raises(InvalidRootError):
+        accl4.reduce(a, b, n, -1, ReduceFunction.SUM)
+    with pytest.raises(InvalidRootError, match="src/dst"):
+        accl4.send(a, n, 0, 9)
+    # sub-communicator roots are communicator-relative
+    comm = accl4.split([0, 2])
+    with pytest.raises(InvalidRootError):
+        accl4.bcast(a, n, 2, comm=comm)
+    # the recorder validates at RECORD time, same class
+    seq = accl4.sequence()
+    with pytest.raises(InvalidRootError):
+        seq.bcast(a, n, 7)
+
+
+# ---------------------------------------------------------------------------
+# zero-length buffers
+# ---------------------------------------------------------------------------
+
+
+def test_zero_length_typed(accl4):
+    n = 16
+    a, b = _buf(accl4, n), _buf(accl4, n)
+    with pytest.raises(ZeroLengthBufferError, match="positive element"):
+        accl4.allreduce(a, b, 0, ReduceFunction.SUM)
+    with pytest.raises(ZeroLengthBufferError):
+        accl4.copy(a, b, -3)
+    with pytest.raises(ZeroLengthBufferError):
+        accl4.sequence().allgather(a, b, 0)
+    # barrier legitimately carries count 0
+    accl4.barrier()
+
+
+# ---------------------------------------------------------------------------
+# mismatched dtypes across a communicator call
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_mismatch_typed_and_backcompat(accl4):
+    n = 16
+    a = accl4.create_buffer(n, dtype=np.float32)
+    b = accl4.create_buffer(n, dtype=np.int32)
+    with pytest.raises(DtypeMismatchError, match="compress_dtype"):
+        accl4.allreduce(a, b, n, ReduceFunction.SUM)
+    with pytest.raises(NotImplementedError):  # historical class
+        accl4.allreduce(a, b, n, ReduceFunction.SUM)
+    with pytest.raises(DtypeMismatchError):
+        accl4.sequence().copy(a, b, n)
+
+
+# ---------------------------------------------------------------------------
+# reuse of a completed sequence handle
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_reuse_typed(accl4):
+    n = 16
+    x = RNG.standard_normal((4, n)).astype(np.float32)
+    a, b = _buf(accl4, n, x), _buf(accl4, n)
+    seq = accl4.sequence()
+    seq.allreduce(a, b, n, ReduceFunction.SUM)
+    seq.run()
+    with pytest.raises(SequenceReuseError, match="already executed"):
+        seq.run()
+    with pytest.raises(SequenceReuseError):
+        seq.bcast(b, n, 0)
+    with pytest.raises(RuntimeError):  # backward-compatible class
+        seq.run()
+
+
+# ---------------------------------------------------------------------------
+# error class <-> lint diagnostic mapping, pinned by corpus fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("exc,fixture", [
+    (InvalidRootError, "bad_root_out_of_range.json"),
+    (ZeroLengthBufferError, "bad_zero_count.json"),
+    (DtypeMismatchError, "bad_dtype_flow.json"),
+])
+def test_error_paths_have_lint_fixtures(exc, fixture):
+    """Each typed validation error appears in the lint corpus as a
+    known-bad sequence expecting the class's lint_code."""
+    fx = json.loads((CORPUS / fixture).read_text())
+    assert exc.lint_code in fx["expect"], (
+        f"{fixture} must expect {exc.lint_code} ({exc.__name__})")
+
+
+def test_lint_error_is_validation_error():
+    assert issubclass(LintError, ACCLValidationError)
+    assert issubclass(ACCLValidationError, ValueError)

@@ -6,10 +6,7 @@ stands in for (DCN slice id, ICI position); the compiled program structure
 is identical on real hardware.
 """
 
-import functools
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P

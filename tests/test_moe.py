@@ -4,7 +4,7 @@ framework's alltoall schedule (ccl_offload_control.c:2123-2218 analog)."""
 import jax
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from accl_tpu.models.moe import (
     MoEConfig,

@@ -12,8 +12,6 @@ import numpy as np
 import pytest
 
 import jax
-from jax.sharding import Mesh
-
 from accl_tpu import (
     CallOptions,
     DataType,
@@ -304,3 +302,50 @@ def test_pallas_ring_overlap_matches_serialized(mesh4):
     np.testing.assert_allclose(outs[True], np.tile(x.sum(0), (world, 1)),
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_array_equal(outs[True], outs[False])
+
+
+def test_ordered_after_depends_on_every_concat_segment():
+    """The cross-step ring barrier must consume the WHOLE previous
+    output: a segmented ring step's result is a concatenation, and a
+    narrowed barrier operand (e.g. prev[:1]) lets XLA's slice-of-concat
+    simplification drop the dependency on segments 2..N — two kernel
+    instances sharing a collective_id slot would then run unordered."""
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.sequencer.schedules import _ordered_after
+
+    def f(x, a, b):
+        prev = jnp.concatenate([a, b])  # a segmented step's output shape
+        return _ordered_after(x, prev)
+
+    jaxpr = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((4,), np.float32),
+        jax.ShapeDtypeStruct((4,), np.float32),
+        jax.ShapeDtypeStruct((4,), np.float32))
+    concat_outs = {str(v) for e in jaxpr.jaxpr.eqns
+                   if e.primitive.name == "concatenate" for v in e.outvars}
+    barrier_ins = {str(v) for e in jaxpr.jaxpr.eqns
+                   if e.primitive.name == "optimization_barrier"
+                   for v in e.invars}
+    assert concat_outs & barrier_ins, (
+        "optimization_barrier no longer consumes the full concatenated "
+        f"previous output\n{jaxpr}")
+
+
+def test_splice_producer_preserves_placeholder_ordering():
+    """A producer-spliced step's operand placeholder may carry the
+    sequence builder's ring-ordering barrier; the splice must thread it
+    into the traced graph, not drop the argument."""
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.ops.streams import splice_producer
+
+    wrapped = splice_producer(lambda d: d, lambda: jnp.ones(4), 4)
+    jaxpr = jax.make_jaxpr(wrapped)(jax.ShapeDtypeStruct((4,), np.float32))
+    placeholder = str(jaxpr.jaxpr.invars[0])
+    used = {str(v) for e in jaxpr.jaxpr.eqns for v in e.invars}
+    assert placeholder in used, (
+        "splice_producer drops its placeholder operand — ordering edges "
+        f"injected by the fused sequence path would vanish\n{jaxpr}")

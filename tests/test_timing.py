@@ -5,7 +5,6 @@ structures, (b) recover known link parameters from measurements, and
 (c) reproduce the reference tuning defaults as PERFORMANCE crossovers
 (accl.cpp:1198-1208), not just control-flow constants."""
 
-import math
 
 import numpy as np
 import pytest

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""accl_lint: replay recorded descriptor batches through the static
+analyzer (accl_tpu/analysis/, docs/lint.md).
+
+Three modes, combinable:
+
+  --corpus [DIR]   replay every *.json fixture under DIR (default
+                   tools/lint_corpus/): known-bad batches must be
+                   rejected with their expected diagnostic codes,
+                   known-good batches must come back clean
+  --schedules      abstractly interpret every shipping schedule family
+                   in sequencer/schedules.py (both protocol regimes,
+                   several worlds/roots) and require zero diagnostics
+  FILE...          lint individual fixture files
+
+Exit status is 0 only when every expectation holds — the CI lint job
+runs `accl_lint.py --corpus --schedules` as a gate.
+
+Fixture schema (JSON):
+  kind "sequence":       "steps" (descriptor dicts: op/count/dtype/
+                         addr_0/addr_1/addr_2/root/function/tag/comm)
+                         or "words" (the batched 15-word call stream),
+                         plus optional "world", "deep",
+                         "use_pallas_ring", "overlap", "buffer_widths"
+  kind "rank_programs":  "programs": per-rank event lists
+                         ({kind: send|recv|coll, peer, tag, count,
+                         comm, op}), optional "blocking_sends"
+  kind "slots":          "num_slots", "instances" [[step, seg, slot]],
+                         "deps" [[from, to]]
+  all kinds:             "expect": diagnostic codes that MUST surface
+                         ([] = the batch must lint clean), "title"
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+# the deep pass traces schedule bodies under jax's abstract evaluation;
+# keep that off any real accelerator (and quiet) regardless of where
+# the CLI runs — must happen before anything imports jax
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from accl_tpu.constants import (  # noqa: E402
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    DEFAULT_MAX_RENDEZVOUS_SIZE,
+    DataType,
+    Operation,
+    ReduceFunction,
+    TAG_ANY,
+    TuningParams,
+    dtype_nbytes,
+)
+from accl_tpu.descriptor import CallOptions, SequenceDescriptor  # noqa: E402
+from accl_tpu.analysis import (  # noqa: E402
+    SequenceLinter,
+    check_slots,
+    simulate,
+)
+from accl_tpu.analysis.protocol import Event, interpret_schedule  # noqa: E402
+from accl_tpu.analysis.slots import SlotInstance, SlotTimeline  # noqa: E402
+from accl_tpu.sequencer.plan import select_algorithm  # noqa: E402
+
+DEFAULT_CORPUS = pathlib.Path(__file__).resolve().parent / "lint_corpus"
+
+
+def _step_from_dict(d: dict) -> CallOptions:
+    op = Operation[d["op"]]
+    fn = d.get("function", 0)
+    if isinstance(fn, str):
+        fn = int(ReduceFunction[fn])
+    dt = d.get("dtype", "float32")
+    return CallOptions(
+        scenario=op,
+        count=int(d.get("count", 0)),
+        comm_addr=int(d.get("comm", 0)),
+        root_src_dst=int(d.get("root", d.get("root_src_dst", 0))),
+        function=int(fn),
+        tag=int(d.get("tag", TAG_ANY)),
+        addr_0=int(d.get("addr_0", 0)),
+        addr_1=int(d.get("addr_1", 0)),
+        addr_2=int(d.get("addr_2", 0)),
+        data_type=DataType[dt] if isinstance(dt, str) else DataType(dt),
+    )
+
+
+def _default_plan(opts: CallOptions, world: int):
+    return select_algorithm(
+        opts.scenario, opts.count, dtype_nbytes(opts.data_type), world,
+        opts.compression_flags, opts.stream_flags,
+        max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+        eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+        tuning=TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE),
+    )
+
+
+def lint_fixture(fx: dict) -> list:
+    """Run one fixture through the analyzer; returns Diagnostics."""
+    kind = fx.get("kind", "sequence")
+    world = int(fx.get("world", 4))
+    if kind == "sequence":
+        if "words" in fx:
+            steps = list(
+                SequenceDescriptor.from_words(list(fx["words"])).steps)
+        else:
+            steps = [_step_from_dict(d) for d in fx["steps"]]
+        widths = None
+        if "buffer_widths" in fx:
+            widths = {int(k, 0) if isinstance(k, str) else int(k): int(v)
+                      for k, v in fx["buffer_widths"].items()}
+        linter = SequenceLinter(
+            world,
+            use_pallas_ring=bool(fx.get("use_pallas_ring", False)),
+            pallas_ring_overlap=bool(fx.get("overlap", True)),
+            deep=bool(fx.get("deep", False)),
+        )
+        plans = [_default_plan(o, world) for o in steps]
+        return linter.lint(steps, plans, buffer_widths=widths)
+    if kind == "rank_programs":
+        programs = [
+            [Event(e["kind"], int(e.get("peer", -1)),
+                   int(e.get("tag", TAG_ANY)), int(e.get("count", 0)),
+                   int(e.get("comm", 0)), e.get("op", ""))
+             for e in prog]
+            for prog in fx["programs"]
+        ]
+        return simulate(programs,
+                        blocking_sends=bool(fx.get("blocking_sends", True)))
+    if kind == "slots":
+        timeline = SlotTimeline(
+            int(fx["num_slots"]),
+            [SlotInstance(*map(int, i)) for i in fx["instances"]],
+            {(int(a), int(b)) for a, b in fx.get("deps", [])},
+        )
+        return check_slots(timeline)
+    raise ValueError(f"unknown fixture kind {kind!r}")
+
+
+def run_fixture_file(path: pathlib.Path) -> tuple[bool, str]:
+    fx = json.loads(path.read_text())
+    diags = lint_fixture(fx)
+    got = [d.code for d in diags]
+    expect = fx.get("expect", [])
+    if expect:
+        missing = [c for c in expect if c not in got]
+        ok = not missing
+        verdict = (f"rejected with {sorted(set(got))}" if ok else
+                   f"MISSED {missing} (got {sorted(set(got))})")
+    else:
+        ok = not diags
+        verdict = "clean" if ok else f"UNEXPECTED {sorted(set(got))}"
+    detail = "".join(f"\n      {d}" for d in diags) if not ok else ""
+    return ok, f"{path.name:40s} {verdict}{detail}"
+
+
+def run_corpus(corpus_dir: pathlib.Path) -> bool:
+    files = sorted(corpus_dir.glob("*.json"))
+    if not files:
+        print(f"no fixtures under {corpus_dir}", file=sys.stderr)
+        return False
+    ok_all = True
+    n_bad = n_good = 0
+    for path in files:
+        try:
+            ok, line = run_fixture_file(path)
+        except Exception as e:  # a crashing fixture is a failing fixture
+            ok, line = False, f"{path.name:40s} ERROR {type(e).__name__}: {e}"
+        ok_all &= ok
+        fx_expect = json.loads(path.read_text()).get("expect", [])
+        n_bad += bool(fx_expect)
+        n_good += not fx_expect
+        print(("  ok  " if ok else " FAIL ") + line)
+    print(f"corpus: {len(files)} fixtures "
+          f"({n_bad} known-bad, {n_good} known-good)")
+    return ok_all
+
+
+def run_schedules() -> bool:
+    """Interpret every shipping schedule family per rank and require it
+    clean — the conformance half of the acceptance gate."""
+    ok = True
+    rooted = (Operation.bcast, Operation.scatter, Operation.gather,
+              Operation.reduce)
+    tunings = {
+        "default": TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE),
+        # force the binary-tree / capped-fan-in branches
+        "trees": TuningParams(
+            gather_flat_tree_max_fanin=2,
+            gather_flat_tree_max_count=64,
+            bcast_flat_tree_max_ranks=2,
+            reduce_flat_tree_max_ranks=2,
+            reduce_flat_tree_max_count=64,
+            allreduce_composition_max_count=1 << 30,
+        ),
+    }
+    scens = (Operation.bcast, Operation.scatter, Operation.gather,
+             Operation.reduce, Operation.allgather, Operation.allreduce,
+             Operation.reduce_scatter, Operation.alltoall,
+             Operation.barrier, Operation.send)
+    n = 0
+    for world in (2, 4, 8):
+        for scen in scens:
+            roots = range(world) if scen in rooted else (0,)
+            for root in roots:
+                for count in (16, 100_000):
+                    for tname, tuning in tunings.items():
+                        if scen == Operation.barrier and count != 16:
+                            continue
+                        rsd = root if scen != Operation.send \
+                            else 0 | ((world - 1) << 16)
+                        opts = CallOptions(
+                            scenario=scen, count=count, root_src_dst=rsd,
+                            function=int(ReduceFunction.SUM),
+                            data_type=DataType.float32)
+                        plan = select_algorithm(
+                            scen, count, 4, world,
+                            max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+                            eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+                            tuning=tuning)
+                        diags = interpret_schedule(opts, plan, world)
+                        n += 1
+                        if diags:
+                            ok = False
+                            print(f" FAIL {scen.name} world={world} "
+                                  f"root={root} count={count} "
+                                  f"tuning={tname} "
+                                  f"{plan.algorithm.name}: "
+                                  f"{[str(d) for d in diags]}")
+    print(f"schedules: {n} (scenario, world, root, size, tuning) "
+          f"configurations interpreted "
+          + ("clean" if ok else "WITH DEFECTS"))
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--corpus", nargs="?", const=str(DEFAULT_CORPUS),
+                    default=None, metavar="DIR",
+                    help="replay the fixture corpus (default "
+                         "tools/lint_corpus/)")
+    ap.add_argument("--schedules", action="store_true",
+                    help="interpret every shipping schedule and require "
+                         "it clean")
+    ap.add_argument("files", nargs="*", help="individual fixture files")
+    args = ap.parse_args(argv)
+    if not (args.corpus or args.schedules or args.files):
+        ap.error("nothing to do: pass --corpus, --schedules, or files")
+    ok = True
+    if args.corpus:
+        ok &= run_corpus(pathlib.Path(args.corpus))
+    if args.schedules:
+        ok &= run_schedules()
+    for f in args.files:
+        fok, line = run_fixture_file(pathlib.Path(f))
+        ok &= fok
+        print(("  ok  " if fok else " FAIL ") + line)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
