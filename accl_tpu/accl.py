@@ -243,6 +243,19 @@ class ACCL:
             if pair not in self.arith_config:
                 raise ValueError(f"no arithmetic configuration for {pair}")
             if compress_dtype is not None and compress_dtype != dtype:
+                from .ops.compression import is_quantized
+
+                # quantized lanes exist only where the backend ships the
+                # blockwise ring kernels (the XLA schedule tier); a
+                # lane-less executor would degrade the request to a cast
+                # — 2 B/elem on a wire billed at ~1 B — so fail host-side
+                if is_quantized(self.arith_config[pair]) and not getattr(
+                        self.cclo, "supports_quantized_wire", False):
+                    raise NotImplementedError(
+                        f"{type(self.cclo).__name__} has no blockwise-"
+                        f"quantized wire lanes ({pair[0].name} -> "
+                        f"{pair[1].name}); quantized compression is "
+                        "XLA-schedule-tier only")
                 comp |= CompressionFlags.ETH_COMPRESSED
             arithcfg_addr = self.arith_config[pair].addr()
         return CallOptions(
@@ -726,7 +739,8 @@ class ACCL:
                   tuning.allreduce_composition_max_count)
 
     def autotune(self, link=None, timing_model_path=None,
-                 tier: str = "emulator") -> TuningParams:
+                 tier: str = "emulator",
+                 wire_dtype: DataType = DataType.none) -> TuningParams:
         """Derive the four switch-point tuning registers from the
         calibrated timing model and apply them (gather fan-in keeps its
         structural default): the measured-performance closure of the
@@ -736,7 +750,12 @@ class ACCL:
         by tools/timing_model.py). tier="tpu" uses the on-chip
         calibration tier instead of the emulator link fit (dispatch alpha
         + HBM-bounded beta — a projection until ICI is measured on a
-        multi-chip slice). Returns the applied TuningParams."""
+        multi-chip slice). `wire_dtype` tunes for a workload running
+        that compression lane on its collectives (e.g. DataType.int8 for
+        the blockwise-quantized wire): crossover arithmetic happens in
+        wire bytes, so byte-threshold registers stretch by the
+        compression ratio — the registers MOVE when quantized lanes are
+        enabled. Returns the applied TuningParams."""
         from .sequencer.timing import LinkParams, tuning_crossovers
 
         if tier not in ("emulator", "tpu"):
@@ -774,7 +793,8 @@ class ACCL:
                         "nor link; re-run tools/timing_model.py")
                 link = LinkParams(alpha=lk["alpha_us"] * 1e-6,
                                   beta=lk["beta_gbps"] * 1e9)
-        cross = tuning_crossovers(link, world=self.world)
+        cross = tuning_crossovers(link, world=self.world,
+                                  wire_dtype=wire_dtype)
         tuning = TuningParams.from_crossovers(cross)
         self.configure_tuning_parameters(tuning)
         return tuning

@@ -63,6 +63,10 @@ CODES: dict[str, tuple[str, str, str]] = {
     "ACCL405": ("buffer-underflow", "error",
                 "registered buffer narrower than the widths the batch "
                 "needs"),
+    "ACCL406": ("quantized-lane-mismatch", "error",
+                "blockwise-quantized wire requested for a payload dtype "
+                "with no quantized lane (or a wire dtype with no "
+                "arithmetic-configuration row)"),
 }
 
 

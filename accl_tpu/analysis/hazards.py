@@ -34,13 +34,26 @@ aliasing between steps NOT so ordered is a hazard:
   ACCL405             a registered buffer is narrower than the widest
                       access the batch makes to it (the static form of
                       TPUDevice.start_sequence's min_widths check).
+  ACCL406             a step requests a compressed wire with no
+                      arithmetic-configuration lane for its payload
+                      dtype (e.g. blockwise int8 on an int32 operand) —
+                      dispatched device-resident, the lane lookup would
+                      fail after the host already returned.
+
+The dtype-flow rules know the compression lanes: a compressed step's
+in-sequence RESULT is always back in the payload dtype (cast lanes
+decompress on arrival, the quantized lanes dequantize), so wire
+compression never changes what a downstream step reads — ACCL401 keys
+on the descriptor's data_type on both sides, and ACCL406 separately
+proves the requested lane pairing exists.
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-from ..constants import DataType, Operation
+from ..arithconfig import DEFAULT_ARITH_CONFIG
+from ..constants import CompressionFlags, DataType, Operation
 from ..sequencer.sequence import step_in_elems, step_out_elems
 from .diagnostics import Diagnostic, make
 
@@ -102,16 +115,40 @@ def analyze_dataflow(
     *,
     ring_steps: frozenset[int] | set[int] = frozenset(),
     buffer_widths: dict[int, int] | None = None,
+    arith_table: dict | None = None,
 ) -> list[Diagnostic]:
     """Run the RAW/WAR/WAW + dtype-flow hazard pass over a batch of
     CallOptions. `ring_steps` are indices the sequence builder chains
     with explicit ordering edges (pallas-ring steps); `buffer_widths`
     maps buffer ADDRESS -> registered element width for the static
     underflow check (omit when widths are unknown, e.g. corpus replay
-    of a bare descriptor stream)."""
+    of a bare descriptor stream); `arith_table` is the ACTIVE arithmetic
+    configuration the batch will lower under (an ACCL built with a
+    custom table lints against ITS lanes, not the defaults — omit for
+    bare-descriptor replay, where the default table is the lane set)."""
     diags: list[Diagnostic] = []
     reads, writes, _ = _accesses(steps, world)
     n = len(list(steps))
+    table = arith_table if arith_table is not None else DEFAULT_ARITH_CONFIG
+
+    # pass 0: compression-lane pairing — a wire dtype only exists where
+    # an arithmetic-configuration row maps (payload, wire) to lanes; the
+    # quantized lanes in particular pair ONLY with fp32 payloads
+    for k, opts in enumerate(steps):
+        wire = opts.compress_dtype
+        if (wire == DataType.none
+                or not opts.compression_flags
+                & CompressionFlags.ETH_COMPRESSED):
+            continue
+        if (opts.data_type, wire) not in table:
+            kind = ("blockwise-quantized" if wire == DataType.int8
+                    else "compressed")
+            diags.append(make(
+                "ACCL406",
+                f"step {k} ({opts.scenario.name}) requests a {kind} "
+                f"{wire.name} wire for a {opts.data_type.name} payload, "
+                "but no arithmetic-configuration lane implements that "
+                "pairing", step=k))
 
     # pass 1: true-dependency edges + RAW coverage / dtype-flow checks
     edges: set[tuple[int, int]] = set()

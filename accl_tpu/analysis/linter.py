@@ -45,12 +45,16 @@ class SequenceLinter:
         pallas_ring_overlap: bool = True,
         deep: bool = False,
         axis_name: str = "ccl",
+        arith_table: dict | None = None,
     ):
         self.world = world
         self.use_pallas_ring = use_pallas_ring
         self.pallas_ring_overlap = pallas_ring_overlap
         self.deep = deep
         self.axis_name = axis_name
+        # the ACTIVE arithmetic configuration (compression-lane pairing,
+        # ACCL406): None = the shipping default table
+        self.arith_table = arith_table
 
     def ring_steps(self, steps) -> frozenset[int]:
         """Indices that lower to the slot-keyed pallas ring — the same
@@ -82,6 +86,7 @@ class SequenceLinter:
             steps, self.world,
             ring_steps=self.ring_steps(steps),
             buffer_widths=buffer_widths,
+            arith_table=self.arith_table,
         )
         if self.use_pallas_ring:
             timeline = ring_slot_timeline(

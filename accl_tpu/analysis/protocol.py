@@ -365,17 +365,27 @@ def trace_schedule_hops(options, plan, world: int,
     return hops
 
 
-def _collect_ppermutes(jaxpr, hops: list) -> None:
-    """Depth-first walk of a jaxpr and every sub-jaxpr riding its eqn
-    params (pjit bodies, scan/cond branches), appending perm tuples in
-    trace order."""
-    for eqn in jaxpr.eqns:
+def iter_ppermute_eqns(jaxpr):
+    """Yield every ppermute equation of a (closed) jaxpr, depth-first
+    through eqn-param sub-jaxprs (pjit bodies, shard_map, scan/cond
+    branches), in trace order. THE walker for the 'every cross-rank hop
+    is a ppermute' invariant — the protocol pass reads perms from it and
+    bench.py's wire-byte audit sums operand bytes over it, so a jax
+    version changing eqn param shapes gets fixed in exactly one place."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)  # unwrap ClosedJaxpr
+    for eqn in inner.eqns:
         if eqn.primitive.name == "ppermute":
-            hops.append(tuple(tuple(p) for p in eqn.params["perm"]))
+            yield eqn
             continue
         for val in eqn.params.values():
             for sub in _sub_jaxprs(val):
-                _collect_ppermutes(sub, hops)
+                yield from iter_ppermute_eqns(sub)
+
+
+def _collect_ppermutes(jaxpr, hops: list) -> None:
+    """Perm tuples of every ppermute hop, in trace order."""
+    for eqn in iter_ppermute_eqns(jaxpr):
+        hops.append(tuple(tuple(p) for p in eqn.params["perm"]))
 
 
 def _sub_jaxprs(val):

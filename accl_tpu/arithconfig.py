@@ -83,7 +83,10 @@ class ArithConfig:
 #   arith lanes 5-9: MAX for the same dtypes              — TDEST 5-9
 #   arith lanes 10/11: SUM/MAX bf16 (TPU-native extension)
 #   compressor lanes: 0 = fp32->fp16, 1 = fp16->fp32 (hp_compression analog),
-#     2 = fp32->bf16, 3 = bf16->fp32 (TPU-native extension)
+#     2 = fp32->bf16, 3 = bf16->fp32 (TPU-native extension),
+#     4 = fp32->int8 blockwise quantize, 5 = int8->fp32 blockwise
+#     dequantize (EQuARX-style quantized wire: int8 payload + one fp32
+#     scale per QUANT_BLOCK_ELEMS block, accl_tpu/ops/compression.py)
 #
 # Default table mirrors DEFAULT_ARITH_CONFIG (arithconfig.hpp:102-119) and
 # adds bf16 rows.
@@ -97,7 +100,18 @@ DEFAULT_ARITH_CONFIG: dict[tuple[DataType, DataType], ArithConfig] = {
     # TPU-native: bf16 wire compression and bf16-domain arithmetic.
     (DataType.bfloat16, DataType.bfloat16): ArithConfig(2, 2, 0, 2, 2, False, (10, 11)),
     (DataType.float32, DataType.bfloat16): ArithConfig(4, 2, 0, 2, 3, True, (10, 11)),
+    # Quantized wire: int8 payload + per-block fp32 scales on the hop,
+    # arithmetic stays in the UNCOMPRESSED fp32 domain (a sum of int8
+    # codes is meaningless across blocks), so arith_is_compressed=False
+    # and the ring schedules fuse dequantize->reduce->requantize per hop.
+    (DataType.float32, DataType.int8): ArithConfig(4, 1, 0, 4, 5, False, (0, 5)),
 }
+
+
+# compressor/decompressor lane ids of the blockwise-quantized wire; the
+# Wire datapath keys its (payload, scales) hop form off these
+QUANT_COMPRESSOR_LANE = 4
+QUANT_DECOMPRESSOR_LANE = 5
 
 
 def validate_arith_config(table: dict[tuple[DataType, DataType], ArithConfig]):

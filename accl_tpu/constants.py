@@ -290,6 +290,27 @@ LOGP_ALLGATHER_HOP_BYTES = 128 * 1024
 # (runtime.cpp egr_send seg_bytes at its ring-collective call sites)
 STREAM_SEG_BYTES = 1 << 20
 
+# ---------------------------------------------------------------------------
+# Blockwise int8 wire quantization (the EQuARX-style compression lanes,
+# arxiv 2506.17615): payloads cross each hop as int8 blocks with one fp32
+# scale per block. The block size divides STREAM_SEG_BYTES for every
+# payload dtype the lanes accept, so a jumbo wire segment never splits a
+# block between two messages (1 MiB of fp32 = 1024 blocks exactly).
+# ---------------------------------------------------------------------------
+
+QUANT_BLOCK_ELEMS = 256  # elements per scale block
+QUANT_SCALE_BYTES = 4  # one fp32 scale per block
+# symmetric round-to-nearest-even onto [-QUANT_QMAX, QUANT_QMAX]: the
+# full-range -128 code is unused so the grid is symmetric and MAX
+# reductions cannot bias toward the negative rail
+QUANT_QMAX = 127
+# the block scale is DEFINED as amax * fp32(1/QUANT_QMAX): an explicit
+# reciprocal multiply encodes bitwise-identically across executors,
+# where a divide-by-literal may or may not be strength-reduced
+QUANT_INV_QMAX = float(np.float32(1.0) / np.float32(QUANT_QMAX))
+# effective wire width per element (timing.wire_elem_bytes bills this):
+# 1 B of payload + the amortized per-block scale = 1.015625 B for fp32
+
 EXCHMEM_SIZE = 8192  # bytes of emulated exchange memory per rank
 
 

@@ -36,6 +36,12 @@ from .base import CCLOAddr, CCLODevice
 
 
 class TPUDevice(CCLODevice):
+    # the blockwise int8 wire (compressor lanes 4/5) is implemented in
+    # the XLA schedule tier only; backends without the quantized ring
+    # kernels leave this unset so the facade rejects the request up
+    # front instead of letting a lane-less executor degrade it silently
+    supports_quantized_wire = True
+
     def __init__(self, mesh, axis_name: str = "ccl"):
         super().__init__()
         self.mesh = mesh
@@ -269,6 +275,9 @@ class TPUDevice(CCLODevice):
             max_eager_size=self.max_eager_size,
             eager_rx_buf_size=self.eager_rx_buf_size,
             tuning=tuning if tuning is not None else self.tuning(),
+            # the wire rides the Plan so timing.predict on recorded
+            # plans charges compressed widths (+ scale side-channel)
+            compress_dtype=options.compress_dtype,
         )
         # stream ids ride dedicated descriptor bytes (word 8), so the tag
         # stays available for matching
@@ -430,6 +439,10 @@ class TPUDevice(CCLODevice):
                 use_pallas_ring=ctx.compiler.use_pallas_ring,
                 pallas_ring_overlap=ctx.compiler.pallas_ring_overlap,
                 axis_name=self.axis_name,
+                # lint against the lanes this device will LOWER with: a
+                # custom arith_config's extra rows must not be rejected,
+                # and its removed rows must not slip through
+                arith_table=ctx.compiler.arith_table,
             )
             diags = tuple(linter.lint(desc.steps, plans,
                                       buffer_widths=widths))

@@ -1,4 +1,5 @@
-"""Cast-compression lanes (hp_compression plugin analog).
+"""Compression lanes: cast lanes (hp_compression analog) + blockwise
+int8 quantized lanes (EQuARX-style, arxiv 2506.17615).
 
 The reference runs three fp32<->fp16 casting kernel instances on the op0,
 op1 and result lanes so payloads can cross the wire at half width
@@ -7,25 +8,58 @@ rationale docs/overview.rst:39). On TPU the casts are VPU elementwise
 converts that XLA fuses against the adjacent ICI transfer; bf16 is added
 as the TPU-preferred wire format.
 
+The quantized lanes go past the 2x cast ceiling: payloads travel as int8
+codes with one fp32 scale per QUANT_BLOCK_ELEMS-element block (~3.94x
+fewer wire bytes than fp32, scale overhead included). Quantization is
+symmetric round-to-nearest-even onto [-127, 127]:
+
+    scale_b = max(|x_b|) / 127          (one fp32 per block)
+    q_i     = clip(round(x_i / scale_b), -127, 127)  as int8
+    x'_i    = q_i * scale_b
+
+so the per-element absolute error is bounded by scale_b / 2 =
+max(|x_b|) / 254 per quantization pass (all-zero blocks encode scale 0
+and decode exactly; blocks whose amax is small enough that the scale
+underflows — or is flushed, XLA CPU runs FTZ — to zero encode as exact
+zeros with error < amax < ~1.5e-36). The scale is defined as
+amax * fp32(1/127), an explicit reciprocal multiply, so every executor
+encodes bitwise-identically; the whole transform is deterministic and
+quantized collectives are bitwise-reproducible.
+
 Compressor lane numbering (referenced from ArithConfig rows):
   0: fp32 -> fp16     1: fp16 -> fp32
   2: fp32 -> bf16     3: bf16 -> fp32
+  4: fp32 -> int8 blockwise quantize   5: int8 -> fp32 blockwise dequantize
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..arithconfig import ArithConfig
+from ..arithconfig import (
+    QUANT_COMPRESSOR_LANE,
+    QUANT_DECOMPRESSOR_LANE,
+    ArithConfig,
+)
+from ..constants import QUANT_BLOCK_ELEMS, QUANT_INV_QMAX, QUANT_QMAX
 
 _COMPRESS_TARGET = {
     0: jnp.float16,
     2: jnp.bfloat16,
+    QUANT_COMPRESSOR_LANE: jnp.int8,
 }
 _DECOMPRESS_TARGET = {
     1: jnp.float32,
     3: jnp.float32,
+    QUANT_DECOMPRESSOR_LANE: jnp.float32,
 }
+
+
+def is_quantized(cfg: ArithConfig) -> bool:
+    """True when cfg's wire is the blockwise int8 lane pair: payloads
+    then travel as (int8 codes, per-block fp32 scales) instead of a
+    plain cast, and hops must ride Wire.encode/hop/decode."""
+    return cfg.compressor_lane == QUANT_COMPRESSOR_LANE
 
 
 def wire_dtype(cfg: ArithConfig):
@@ -38,6 +72,10 @@ def wire_dtype(cfg: ArithConfig):
 
 def compress(x: jnp.ndarray, cfg: ArithConfig) -> jnp.ndarray:
     """Run the compressor lane of cfg over a payload."""
+    if is_quantized(cfg):
+        raise ValueError(
+            "blockwise-quantized lanes carry (payload, scales) pairs; "
+            "hops must go through Wire.encode/hop/decode, not compress()")
     wd = wire_dtype(cfg)
     return x if wd is None else x.astype(wd)
 
@@ -45,6 +83,10 @@ def compress(x: jnp.ndarray, cfg: ArithConfig) -> jnp.ndarray:
 def decompress(x: jnp.ndarray, cfg: ArithConfig, out_dtype) -> jnp.ndarray:
     """Run the decompressor lane of cfg; the lane's target must agree with
     the caller's uncompressed dtype."""
+    if is_quantized(cfg):
+        raise ValueError(
+            "blockwise-quantized lanes carry (payload, scales) pairs; "
+            "hops must go through Wire.encode/hop/decode, not decompress()")
     target = _DECOMPRESS_TARGET.get(cfg.decompressor_lane)
     if target is not None and jnp.dtype(target) != jnp.dtype(out_dtype):
         raise ValueError(
@@ -52,3 +94,98 @@ def decompress(x: jnp.ndarray, cfg: ArithConfig, out_dtype) -> jnp.ndarray:
             f"caller expects {out_dtype}"
         )
     return x.astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# blockwise int8 quantization core (compressor lanes 4/5)
+# ---------------------------------------------------------------------------
+
+
+def quant_num_blocks(n: int, block: int = QUANT_BLOCK_ELEMS) -> int:
+    return -(-n // block)
+
+
+def quantize_blockwise(x: jnp.ndarray, block: int = QUANT_BLOCK_ELEMS):
+    """Encode a flat buffer as (int8 codes, per-block fp32 scales).
+
+    The codes array keeps the payload's OWN length — the tail block is
+    zero-padded only for the scale reduction, never on the wire, so a
+    sub-block ring chunk ships `n + 4*ceil(n/block)` bytes instead of a
+    rounded-up full block (which would cost MORE than fp32 below 64
+    elements). Accumulation dtype is fp32 regardless of x's dtype: the
+    quantized lanes only pair with fp32 payloads (ACCL406 gates anything
+    else statically).
+    """
+    n = x.shape[-1]
+    pad = (-n) % block
+    xf = x.astype(jnp.float32)
+    xp = jnp.pad(xf, (0, pad)) if pad else xf
+    # scale is DEFINED as amax * fp32(1/127), not amax / 127: a divide
+    # by a literal is rewritten to a reciprocal multiply by some XLA
+    # pipelines and not others (ULP-level drift), and the format must
+    # encode identically in the jnp reference and the Mosaic kernel
+    scales = jnp.max(jnp.abs(xp.reshape(-1, block)), axis=-1) \
+        * QUANT_INV_QMAX
+    # scale 0 (all-zero block, or an amax tiny enough that the divide
+    # underflowed/flushed) encodes the block as exact zeros; the guard
+    # keeps the 0/0 out of the divide without branching
+    safe = jnp.where(scales > 0, scales, 1.0)
+    per_elem = jnp.repeat(safe, block)[:n]
+    q = jnp.clip(jnp.round(xf / per_elem), -QUANT_QMAX, QUANT_QMAX)
+    live = jnp.repeat(scales > 0, block)[:n]
+    return jnp.where(live, q, 0.0).astype(jnp.int8), scales
+
+
+def dequantize_blockwise(q: jnp.ndarray, scales: jnp.ndarray, n: int,
+                         out_dtype=jnp.float32,
+                         block: int = QUANT_BLOCK_ELEMS) -> jnp.ndarray:
+    """Decode (codes, scales) back to n elements of out_dtype."""
+    per_elem = jnp.repeat(scales, block)[: q.shape[-1]]
+    x = q.astype(jnp.float32) * per_elem
+    return x[:n].astype(out_dtype)
+
+
+def dequant_combine(q, scales, local, func_op: str):
+    """Fused dequantize -> reduce: decode an incoming quantized partial
+    and combine it with the local fp32 operand, accumulating in fp32
+    (one VMEM pass via the pallas kernel on TPU; the jnp form is the
+    identical-numerics reference everywhere else). The element count is
+    local's — q decodes against the operand it combines with, on both
+    datapaths."""
+    if _use_quant_pallas():
+        from .pallas_kernels import fused_dequant_combine_pallas
+
+        return fused_dequant_combine_pallas(q, scales, local, op=func_op,
+                                            interpret=False)
+    x = dequantize_blockwise(q, scales, local.shape[-1], jnp.float32)
+    loc = local.astype(jnp.float32)
+    out = jnp.add(x, loc) if func_op == "sum" else jnp.maximum(x, loc)
+    return out.astype(local.dtype)
+
+
+def dequant_combine_requant(q, scales, local, func_op: str):
+    """The fused ring-step op: dequantize -> reduce (fp32) -> requantize,
+    so only (int8 payload + scales) leave for the next hop while the
+    accumulation itself never drops below fp32."""
+    if _use_quant_pallas():
+        from .pallas_kernels import fused_dequant_combine_quant_pallas
+
+        return fused_dequant_combine_quant_pallas(q, scales, local,
+                                                  op=func_op,
+                                                  interpret=False)
+    return quantize_blockwise(dequant_combine(q, scales, local, func_op))
+
+
+def _use_quant_pallas() -> bool:
+    """Route the fused quantized ring step through the Mosaic kernels:
+    on-TPU only, and opt-in (ACCL_QUANT_PALLAS=1) until the kernel tier
+    is measured on hardware — the jnp fallback is numerically identical
+    (the interpret-mode parity test pins it), so flipping the knob
+    changes the datapath, not the results."""
+    import os
+
+    if os.environ.get("ACCL_QUANT_PALLAS") != "1":
+        return False
+    from .pallas_kernels import _on_tpu
+
+    return _on_tpu()

@@ -194,3 +194,175 @@ def fused_combine_cast_pallas(
         interpret=interpret,
     )(at, bt)
     return _from_tiles(out, n)
+
+
+# ---------------------------------------------------------------------------
+# blockwise int8 quantized wire (compressor lanes 4/5): quantize /
+# dequantize / fused dequantize->reduce[->requantize] kernels. One scale
+# block per tile row (QUANT_BLOCK_ELEMS = 256 lanes, a 2-VREG row), so
+# the per-row max-abs reduction IS the block reduction and the fused ring
+# step runs decode + combine + re-encode in a single VMEM pass instead of
+# three HBM round-trips. Numerics are pinned to the jnp reference in
+# ops/compression.py (the interpret-mode parity test), so the kernel and
+# fallback paths are interchangeable bit-for-bit.
+# ---------------------------------------------------------------------------
+
+from ..constants import (  # noqa: E402
+    QUANT_BLOCK_ELEMS,
+    QUANT_INV_QMAX,
+    QUANT_QMAX,
+)
+from .compression import quant_num_blocks as _quant_rows  # noqa: E402
+
+_QUANT_BLOCK_ROWS = 256  # block rows (= scale blocks) per grid step
+
+
+def _as_quant_tiles(x):
+    """Flat buffer -> (rows, QUANT_BLOCK_ELEMS) with a zero-padded tail;
+    rows further padded to the grid's row block."""
+    n = x.shape[-1]
+    rows = _quant_rows(n)
+    flat = jnp.pad(x, (0, rows * QUANT_BLOCK_ELEMS - n))
+    return flat.reshape(rows, QUANT_BLOCK_ELEMS), rows, n
+
+
+def _encode_tiles(x):
+    """The wire format's encode rule over (rows, block) fp32 tiles ->
+    (int8 codes, (rows, 1) scales) — ONE definition shared by the
+    quantize kernel and the fused ring step's requant tail, so the two
+    kernel paths cannot fork the format."""
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = amax * QUANT_INV_QMAX  # the format's reciprocal-multiply rule
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / safe), -QUANT_QMAX, QUANT_QMAX)
+    return jnp.where(scale > 0, q, 0.0).astype(jnp.int8), scale
+
+
+def _quantize_kernel(x_ref, q_ref, s_ref):
+    q_ref[...], s_ref[...] = _encode_tiles(x_ref[...].astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quantize_pallas(x, interpret: bool | None = None):
+    """Blockwise int8 quantize (compressor lane 4): flat fp32 buffer ->
+    (int8 codes [padded to a block multiple], fp32 per-block scales)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    xt, rows, n = _as_quant_tiles(x.astype(jnp.float32))
+    xt = _pad_rows(xt, _QUANT_BLOCK_ROWS)
+    grid = (xt.shape[0] // _QUANT_BLOCK_ROWS,)
+    q, s = pl.pallas_call(
+        _quantize_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(xt.shape, jnp.int8),
+            jax.ShapeDtypeStruct((xt.shape[0], 1), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[pl.BlockSpec((_QUANT_BLOCK_ROWS, QUANT_BLOCK_ELEMS),
+                               lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((_QUANT_BLOCK_ROWS, QUANT_BLOCK_ELEMS),
+                         lambda i: (i, 0)),
+            pl.BlockSpec((_QUANT_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(xt)
+    # the wire form keeps the payload's own length (see quantize_blockwise)
+    return q[:rows].reshape(-1)[:n], s[:rows, 0]
+
+
+def _dequantize_kernel(q_ref, s_ref, o_ref):
+    o_ref[...] = q_ref[...].astype(jnp.float32) * s_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def dequantize_pallas(q, scales, n: int, interpret: bool | None = None):
+    """Blockwise dequantize (decompressor lane 5): (codes, scales) ->
+    n fp32 elements."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    rows = _quant_rows(n)
+    qp = jnp.pad(q, (0, rows * QUANT_BLOCK_ELEMS - q.shape[-1]))
+    qt = _pad_rows(qp.reshape(rows, QUANT_BLOCK_ELEMS), _QUANT_BLOCK_ROWS)
+    st = _pad_rows(scales.reshape(rows, 1), _QUANT_BLOCK_ROWS)
+    grid = (qt.shape[0] // _QUANT_BLOCK_ROWS,)
+    out = pl.pallas_call(
+        _dequantize_kernel,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_QUANT_BLOCK_ROWS, QUANT_BLOCK_ELEMS),
+                         lambda i: (i, 0)),
+            pl.BlockSpec((_QUANT_BLOCK_ROWS, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_QUANT_BLOCK_ROWS, QUANT_BLOCK_ELEMS),
+                               lambda i: (i, 0)),
+        interpret=interpret,
+    )(qt, st)
+    return out[:rows].reshape(-1)[:n]
+
+
+def _fused_dq_combine_kernel(op, requant, q_ref, s_ref, l_ref, *out_refs):
+    x = q_ref[...].astype(jnp.float32) * s_ref[...]
+    loc = l_ref[...].astype(jnp.float32)
+    r = jnp.add(x, loc) if op == "sum" else jnp.maximum(x, loc)
+    if not requant:
+        out_refs[0][...] = r
+        return
+    out_refs[0][...], out_refs[1][...] = _encode_tiles(r)
+
+
+def _fused_dq_call(q, scales, local, op: str, requant: bool,
+                   interpret: bool | None):
+    if interpret is None:
+        interpret = not _on_tpu()
+    n = local.shape[-1]
+    rows = _quant_rows(n)
+    lt = jnp.pad(local.astype(jnp.float32),
+                 (0, rows * QUANT_BLOCK_ELEMS - n))
+    lt = _pad_rows(lt.reshape(rows, QUANT_BLOCK_ELEMS), _QUANT_BLOCK_ROWS)
+    qp = jnp.pad(q, (0, rows * QUANT_BLOCK_ELEMS - q.shape[-1]))
+    qt = _pad_rows(qp.reshape(rows, QUANT_BLOCK_ELEMS), _QUANT_BLOCK_ROWS)
+    st = _pad_rows(scales.reshape(-1, 1)[:rows], _QUANT_BLOCK_ROWS)
+    grid = (qt.shape[0] // _QUANT_BLOCK_ROWS,)
+    payload_spec = pl.BlockSpec((_QUANT_BLOCK_ROWS, QUANT_BLOCK_ELEMS),
+                                lambda i: (i, 0))
+    scale_spec = pl.BlockSpec((_QUANT_BLOCK_ROWS, 1), lambda i: (i, 0))
+    if requant:
+        out_shape = (jax.ShapeDtypeStruct(qt.shape, jnp.int8),
+                     jax.ShapeDtypeStruct((qt.shape[0], 1), jnp.float32))
+        out_specs = (payload_spec, scale_spec)
+    else:
+        out_shape = jax.ShapeDtypeStruct(qt.shape, jnp.float32)
+        out_specs = payload_spec
+    out = pl.pallas_call(
+        functools.partial(_fused_dq_combine_kernel, op, requant),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=[payload_spec, scale_spec, payload_spec],
+        out_specs=out_specs,
+        interpret=interpret,
+    )(qt, st, lt)
+    if requant:
+        qo, so = out
+        return qo[:rows].reshape(-1)[:n], so[:rows, 0]
+    return out[:rows].reshape(-1)[:n].astype(local.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def fused_dequant_combine_pallas(q, scales, local, op: str = "sum",
+                                 interpret: bool | None = None):
+    """Fused dequantize -> reduce: one VMEM pass from (codes, scales) +
+    local fp32 operand to the fp32 accumulation (the terminal ring hop)."""
+    return _fused_dq_call(q, scales, local, op, requant=False,
+                          interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "interpret"))
+def fused_dequant_combine_quant_pallas(q, scales, local, op: str = "sum",
+                                       interpret: bool | None = None):
+    """Fused dequantize -> reduce -> requantize: the interior segmented
+    ring step — accumulation stays fp32 inside the kernel while only
+    (int8 payload + scales) leave for the next ppermute hop."""
+    return _fused_dq_call(q, scales, local, op, requant=True,
+                          interpret=interpret)

@@ -22,5 +22,11 @@ move instructions at runtime, we split the same logic into:
                   signature).
 """
 
-from .plan import Algorithm, Plan, Protocol, select_algorithm  # noqa: F401
+from .plan import (  # noqa: F401
+    Algorithm,
+    Plan,
+    Protocol,
+    select_algorithm,
+    select_wire,
+)
 from .sequence import SequencePlan  # noqa: F401

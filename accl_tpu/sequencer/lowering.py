@@ -94,7 +94,13 @@ class ScheduleCompiler:
     ) -> schedules.Wire:
         """Resolve the datapath config: which compression lanes wrap each
         hop and which arith lane reductions use (prepare_call's dtype logic,
-        reference accl.cpp:1236-1356)."""
+        reference accl.cpp:1236-1356). Blockwise-quantized rows (compressor
+        lane 4) produce a Wire whose hops carry (int8 codes, per-block
+        scales) and whose ring families fuse dequantize->reduce->requantize
+        per step; because the call-sequence path composes the SAME _body
+        lowerings, recorded sequences fuse quantized steps bitwise-
+        identically to eager dispatch (pinned by the quantized sequence
+        fuzz)."""
         arith_lane = None
         if arithcfg is not None and func is not None:
             arith_lane = arithcfg.arith_lanes[int(func)]
@@ -337,7 +343,11 @@ class ScheduleCompiler:
                 if (
                     self.use_pallas_ring
                     # per-hop compression with uncompressed-domain arithmetic
-                    # cannot be fused into the single-dtype ring kernel
+                    # cannot be fused into the single-dtype ring kernel —
+                    # this also routes the blockwise-quantized wire (whose
+                    # hops carry a scale side-channel) to the lax quantized
+                    # ring below, where the fused dequant-reduce-requant
+                    # kernels live
                     and (not eth_active or compressed_domain)
                     and mosaic_ok
                 ):

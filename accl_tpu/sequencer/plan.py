@@ -16,6 +16,7 @@ import functools
 
 from ..constants import (
     CompressionFlags,
+    DataType,
     Operation,
     StreamFlags,
     TuningParams,
@@ -67,6 +68,14 @@ class Plan:
     # .c:1768-1781). The stage plans are resolved here so lowering and the
     # native runtime never re-derive selection rules.
     stages: tuple["Plan", ...] = ()
+    # The dtype payloads travel in on cross-rank hops (DataType.none =
+    # uncompressed). Compression is a PLAN dimension, not just a
+    # descriptor flag: the timing model charges wire bytes from this
+    # field (cast lanes at the cast width, int8 at 1 B + amortized
+    # per-block scale), so predict()/autotune() crossovers move when a
+    # wire is active and select_wire() can arbitrate it by predicted
+    # time (HiCCL's compression-as-measured-decision posture).
+    wire_dtype: DataType = DataType.none
 
 
 def is_rendezvous(
@@ -120,24 +129,34 @@ def select_algorithm(
     max_eager_size: int,
     eager_rx_buf_size: int,
     tuning: TuningParams,
+    compress_dtype: DataType = DataType.none,
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
     Selection rules are the firmware's, collective by collective; each
-    branch cites the reference decision point.
+    branch cites the reference decision point. `compress_dtype` names
+    the wire dtype of an ETH_COMPRESSED call (the descriptor's
+    compress_dtype): it rides the Plan so the timing model charges wire
+    widths, not payload widths.
     """
     bytes_count = count * dtype_nbytes
     rndzv = is_rendezvous(bytes_count, compression, stream, max_eager_size)
     proto = Protocol.RENDEZVOUS if rndzv else Protocol.EAGER
+    wire = (compress_dtype
+            if compression & CompressionFlags.ETH_COMPRESSED
+            and compress_dtype != DataType.none
+            else DataType.none)
 
     def eager_plan(algorithm: Algorithm, world_align: int = 1) -> Plan:
         seg = eager_seg_count(
             count, dtype_nbytes, eager_rx_buf_size, stream, world_align
         )
-        return Plan(Protocol.EAGER, algorithm, seg, _segments(count, seg))
+        return Plan(Protocol.EAGER, algorithm, seg, _segments(count, seg),
+                    wire_dtype=wire)
 
     def rndzv_plan(algorithm: Algorithm, **kw) -> Plan:
-        return Plan(Protocol.RENDEZVOUS, algorithm, count, 1, **kw)
+        return Plan(Protocol.RENDEZVOUS, algorithm, count, 1,
+                    wire_dtype=wire, **kw)
 
     # Local-only operations and single-rank corner cases (.c:1520-1522,
     # .c:1765-1767, .c:1875-1877: world==1 reductions degrade to copy).
@@ -207,6 +226,7 @@ def select_algorithm(
                 max_eager_size=max_eager_size,
                 eager_rx_buf_size=eager_rx_buf_size,
                 tuning=tuning,
+                compress_dtype=compress_dtype,
             )
             return rndzv_plan(
                 Algorithm.RNDZV_REDUCE_SCATTER,
@@ -239,6 +259,7 @@ def select_algorithm(
                 max_eager_size=max_eager_size,
                 eager_rx_buf_size=eager_rx_buf_size,
                 tuning=tuning,
+                compress_dtype=compress_dtype,
             )
             return rndzv_plan(
                 Algorithm.RNDZV_REDUCE_BCAST,
@@ -259,3 +280,72 @@ def select_algorithm(
         return Plan(Protocol.RENDEZVOUS, Algorithm.BARRIER_GATHER_SCATTER, 0, 1)
 
     raise ValueError(f"no algorithm for scenario {scenario!r}")
+
+
+def select_wire(
+    scenario: Operation,
+    count: int,
+    data_type: DataType,
+    world_size: int,
+    link,
+    *,
+    max_eager_size: int,
+    eager_rx_buf_size: int,
+    rx_buf_bytes: int,
+    tuning: TuningParams,
+    arith_table: dict | None = None,
+    min_gain: float = 0.05,
+    aggregate: bool = False,
+    quantized_ok: bool = True,
+) -> DataType:
+    """Pick the wire dtype for a call by PREDICTED TIME — compression as
+    a plan dimension, not a flag (HiCCL's point that compression and
+    algorithm choice must be measured performance decisions).
+
+    Candidates are the arithmetic-configuration rows whose uncompressed
+    dtype matches the payload (fp32 -> {fp16, bf16, int8-blockwise} on
+    the default table) plus the uncompressed baseline. Each candidate is
+    re-planned (compressed calls route eager) and costed through the
+    calibrated timing model with WIRE-byte accounting; a compressed wire
+    is chosen only when it beats the baseline by more than `min_gain`
+    relative — on latency-dominated small payloads, where wire bytes
+    barely move the prediction, the call keeps its exact fp32 wire
+    rather than paying quantization error for nothing.
+
+    `link` is a timing.LinkParams. Returns the chosen compress_dtype
+    (DataType.none = stay uncompressed); callers hand it to the facade's
+    `compress_dtype=` seam unchanged. `quantized_ok=False` drops the
+    blockwise lanes from the candidate set — pass
+    `getattr(device, "supports_quantized_wire", False)` when selecting
+    for a backend that may lack the quantized ring kernels, so the
+    runner-up cast lane wins instead of the facade rejecting the pick.
+    """
+    from ..arithconfig import DEFAULT_ARITH_CONFIG
+    from ..constants import dtype_nbytes
+    from ..ops.compression import is_quantized
+    from .timing import predict
+
+    table = arith_table or DEFAULT_ARITH_CONFIG
+    elem_bytes = dtype_nbytes(data_type)
+    kw = dict(max_eager_size=max_eager_size,
+              eager_rx_buf_size=eager_rx_buf_size, tuning=tuning)
+
+    def cost(wire: DataType) -> float:
+        comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
+                else CompressionFlags.NO_COMPRESSION)
+        plan = select_algorithm(scenario, count, elem_bytes, world_size,
+                                comp, compress_dtype=wire, **kw)
+        return predict(link, scenario, plan, count, elem_bytes, world_size,
+                       rx_buf_bytes=rx_buf_bytes, aggregate=aggregate)
+
+    t_none = cost(DataType.none)
+    best, t_best = DataType.none, t_none
+    for (unc, cmp_), row in table.items():
+        if unc != data_type or cmp_ == unc:
+            continue
+        if not quantized_ok and is_quantized(row):
+            continue
+        t = cost(cmp_)
+        if t < t_best and (t_none - t) > min_gain * t_none:
+            best, t_best = cmp_, t
+    return best

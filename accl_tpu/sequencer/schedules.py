@@ -34,7 +34,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..constants import ReduceFunction
-from ..ops.compression import compress, decompress
+from ..ops.compression import (
+    compress,
+    decompress,
+    dequant_combine,
+    dequant_combine_requant,
+    dequantize_blockwise,
+    is_quantized,
+    quantize_blockwise,
+)
 from ..ops.reduce_ops import combine_op, reduce_lane
 
 
@@ -51,20 +59,44 @@ class Wire:
     lanes around each cross-rank hop when ETH_COMPRESSED is active) and the
     arithmetic lane reductions run through — the schedule-level analog of
     the AXIS switch steering payloads through the hp_compression and
-    reduce_ops plugin lanes."""
+    reduce_ops plugin lanes.
+
+    Cast lanes (fp16/bf16) wrap each hop as compress -> ppermute ->
+    decompress. The blockwise-quantized lanes (int8 + per-block fp32
+    scales) instead carry an ENCODED payload — a (codes, scales) pair —
+    through `encode`/`hop`/`decode`, so the scale side-channel crosses
+    the same ppermute the codes do and the ring families can relay or
+    fuse the encoded form without bouncing through fp32 at every hop."""
 
     def __init__(self, cfg=None, arith_lane=None):
         self.cfg = cfg  # ArithConfig when wire compression is active
         self.arith_lane = arith_lane
+        self.quantized = cfg is not None and is_quantized(cfg)
 
     def send(self, x):
+        if self.quantized:
+            raise NotImplementedError(
+                "quantized wire hops carry (payload, scales): use "
+                "encode/hop/decode")
         return x if self.cfg is None else compress(x, self.cfg)
 
     def recv(self, x, out_dtype):
+        if self.quantized:
+            raise NotImplementedError(
+                "quantized wire hops carry (payload, scales): use "
+                "encode/hop/decode")
         return x if self.cfg is None else decompress(x, self.cfg, out_dtype)
 
     def ppermute(self, x, axis, perm):
-        """One cross-rank hop: compress -> permute -> decompress."""
+        """One cross-rank hop: compress -> permute -> decompress. On the
+        quantized wire this is encode -> permute both side-channels ->
+        decode (ranks not addressed by perm receive zero codes AND zero
+        scales, which decode to exact zeros — the same masking contract
+        the cast lanes have)."""
+        if self.quantized:
+            n = x.shape[-1]
+            return self.decode(self.hop(self.encode(x), axis, perm), n,
+                               x.dtype)
         y = lax.ppermute(self.send(x), axis, perm)
         return self.recv(y, x.dtype)
 
@@ -73,6 +105,38 @@ class Wire:
         if self.arith_lane is not None:
             return reduce_lane(self.arith_lane, a, b)
         return combine_op(func, a, b)
+
+    # -- quantized-wire datapath (compressor lanes 4/5) --------------------
+
+    def encode(self, x):
+        """fp32 payload -> (int8 codes, per-block fp32 scales)."""
+        return quantize_blockwise(x)
+
+    def hop(self, enc, axis, perm):
+        """Permute an encoded payload: codes and the scale side-channel
+        cross the same hop, so bytes-on-wire per hop is exactly
+        len(codes) + 4 * n_blocks."""
+        q, s = enc
+        return lax.ppermute(q, axis, perm), lax.ppermute(s, axis, perm)
+
+    def decode(self, enc, n, out_dtype):
+        q, s = enc
+        return dequantize_blockwise(q, s, n, out_dtype)
+
+    def combine_decoded(self, func, enc, local):
+        """Fused dequantize -> reduce (terminal ring hop): fp32
+        accumulation of an encoded arrival against the local operand."""
+        q, s = enc
+        op = "sum" if func == ReduceFunction.SUM else "max"
+        return dequant_combine(q, s, local, op)
+
+    def combine_requant(self, func, enc, local):
+        """Fused dequantize -> reduce -> requantize (interior ring step):
+        accumulate in fp32, re-encode so only (codes, scales) travel to
+        the next hop."""
+        q, s = enc
+        op = "sum" if func == ReduceFunction.SUM else "max"
+        return dequant_combine_requant(q, s, local, op)
 
 
 # ---------------------------------------------------------------------------
@@ -225,6 +289,8 @@ def gather_flat_schedule(x, *, root: int, axis, world, wire, fanin: int):
 def allgather_ring_schedule(x, *, axis, world, wire):
     """Ring allgather (eager .c:1402-1499, rendezvous .c:1314-1401): P-1
     relay steps; the step-s arrival originates from rank me-1-s."""
+    if wire.quantized:
+        return _allgather_ring_quant(x, axis=axis, world=world, wire=wire)
     count = x.shape[-1]
     me = lax.axis_index(axis)
     out = jnp.zeros((world * count,), x.dtype)
@@ -235,6 +301,28 @@ def allgather_ring_schedule(x, *, axis, world, wire):
         origin = (me - 1 - s) % world
         out = lax.dynamic_update_slice_in_dim(out, recv, origin * count, axis=-1)
         relay = recv
+    return out
+
+
+def _allgather_ring_quant(x, *, axis, world, wire):
+    """Quantized ring allgather: each rank encodes its chunk ONCE and the
+    (codes, scales) pair relays around the ring unchanged — one
+    quantization error per chunk total (not per hop), and every rank
+    decodes identical bytes for chunk c, so a downstream allreduce stays
+    rank-consistent. The local chunk is placed through the same
+    encode/decode round trip the remote copies take, which is what makes
+    the quantized allreduce's result identical on every rank."""
+    count = x.shape[-1]
+    me = lax.axis_index(axis)
+    out = jnp.zeros((world * count,), x.dtype)
+    enc = wire.encode(x)
+    out = lax.dynamic_update_slice_in_dim(
+        out, wire.decode(enc, count, x.dtype), me * count, axis=-1)
+    for s in range(world - 1):
+        enc = wire.hop(enc, axis, _ring_perm(world))
+        origin = (me - 1 - s) % world
+        out = lax.dynamic_update_slice_in_dim(
+            out, wire.decode(enc, count, x.dtype), origin * count, axis=-1)
     return out
 
 
@@ -293,6 +381,9 @@ def reduce_scatter_ring_schedule(x, *, func, axis, world, wire):
     """Ring reduce-scatter (.c:1782-1850): P-1 steps; at step s each rank
     combines the arriving partial with its local copy of chunk me-1-s and
     forwards; rank r ends holding reduced chunk r."""
+    if wire.quantized:
+        return _reduce_scatter_ring_quant(
+            x, func=func, axis=axis, world=world, wire=wire)
     count = x.shape[-1] // world
     me = lax.axis_index(axis)
     # Step-0 send is our local copy of chunk me-1; the step-s arrival is the
@@ -305,6 +396,30 @@ def reduce_scatter_ring_schedule(x, *, func, axis, world, wire):
         local = lax.dynamic_slice_in_dim(x, idx * count, count, axis=-1)
         v = wire.combine(func, recv, local)
     return v
+
+
+def _reduce_scatter_ring_quant(x, *, func, axis, world, wire):
+    """Quantized ring reduce-scatter: the fused quantize-reduce ring.
+    The traveling partial stays ENCODED between hops — only (int8 codes +
+    per-block scales) cross each ppermute — while every combine runs the
+    fused dequantize -> reduce(fp32) -> requantize step, so accumulation
+    never drops below fp32. The terminal hop skips the requantize and
+    lands the fp32 partial directly (one quantization pass per hop on the
+    partial's path, P-1 total)."""
+    count = x.shape[-1] // world
+    me = lax.axis_index(axis)
+    v = lax.dynamic_slice_in_dim(x, ((me - 1) % world) * count, count, axis=-1)
+    enc = wire.encode(v)
+    out = v  # world == 1 degenerates to the local chunk (plan NONE upstream)
+    for s in range(world - 1):
+        enc = wire.hop(enc, axis, _ring_perm(world))
+        local = lax.dynamic_slice_in_dim(
+            x, ((me - 2 - s) % world) * count, count, axis=-1)
+        if s < world - 2:
+            enc = wire.combine_requant(func, enc, local)
+        else:
+            out = wire.combine_decoded(func, enc, local)
+    return out
 
 
 def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):

@@ -148,7 +148,7 @@ class SequencePlan:
     def lint(self, *, use_pallas_ring: bool = False,
              pallas_ring_overlap: bool = True, deep: bool = False,
              buffer_widths: dict[int, int] | None = None,
-             axis_name: str = "ccl"):
+             axis_name: str = "ccl", arith_table: dict | None = None):
         """Run the static analyzer (accl_tpu/analysis/) over this plan's
         descriptor batch and return the diagnostic list — the same gate
         TPUDevice.start_sequence applies before compile_sequence, here
@@ -163,6 +163,7 @@ class SequencePlan:
             pallas_ring_overlap=pallas_ring_overlap,
             deep=deep,
             axis_name=axis_name,
+            arith_table=arith_table,
         )
         return linter.lint(self.descriptor.steps,
                            [st.plan for st in self.steps],

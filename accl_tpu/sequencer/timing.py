@@ -31,12 +31,32 @@ import dataclasses
 import math
 
 from ..constants import (
+    DataType,
     LOGP_ALLGATHER_HOP_BYTES,
     LOGP_ALLREDUCE_HOP_BYTES,
     Operation,
+    QUANT_BLOCK_ELEMS,
+    QUANT_SCALE_BYTES,
     STREAM_SEG_BYTES,
+    dtype_nbytes,
 )
 from .plan import Algorithm, Plan, Protocol
+
+
+def wire_elem_bytes(elem_bytes: int, wire: DataType) -> float:
+    """Effective bytes-per-element ON THE WIRE for a hop under the given
+    wire dtype: cast lanes travel at the cast width, the blockwise int8
+    lanes at 1 B plus the amortized per-block fp32 scale, and
+    DataType.none at the payload width. This is the width predict() and
+    the crossover scan charge — ETH_COMPRESSED calls must not be billed
+    uncompressed bytes (they would never show the compression win the
+    wire actually delivers)."""
+    if wire == DataType.none:
+        return float(elem_bytes)
+    wb = float(dtype_nbytes(wire))
+    if wire == DataType.int8:
+        wb += QUANT_SCALE_BYTES / QUANT_BLOCK_ELEMS
+    return wb
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,8 +114,11 @@ def coefficients(
     """(messages, bytes) on the CRITICAL PATH of the planned schedule —
     the busiest serialized sequence of hops, mirroring the structures in
     schedules.py / the native do_* bodies. Rendezvous messages count 2
-    (address notification + one-sided write)."""
-    n = count * elem_bytes
+    (address notification + one-sided write). Bytes are WIRE bytes: a
+    plan with an active wire_dtype charges the compressed element width
+    (+ scale side-channel for the quantized lanes), and its segment
+    counts follow the compressed payload too."""
+    n = count * wire_elem_bytes(elem_bytes, plan.wire_dtype)
     P = world
     if P <= 1 or plan.algorithm == Algorithm.NONE:
         return 0.0, 0.0
@@ -190,8 +213,9 @@ def coefficients_aggregate(
     measured ~1.4-2 GB/s transport rate and the median error under
     1.15x, where the critical-path shape was 1.9-3x off. The
     critical-path `coefficients` remain the model for parallel hardware
-    (the TPU tier and the tuning-register crossovers)."""
-    n = count * elem_bytes
+    (the TPU tier and the tuning-register crossovers). Bytes are WIRE
+    bytes (see `coefficients`)."""
+    n = count * wire_elem_bytes(elem_bytes, plan.wire_dtype)
     P = world
     if P <= 1 or plan.algorithm == Algorithm.NONE:
         return 0.0, 0.0
@@ -355,7 +379,8 @@ def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
 
 def tuning_crossovers(params: LinkParams, *, world: int = 8,
                       elem_bytes: int = 4,
-                      rx_buf_bytes: int = 4096) -> dict:
+                      rx_buf_bytes: int = 4096,
+                      wire_dtype: DataType = DataType.none) -> dict:
     """The model's own switch-over points for the five tuning registers
     (reference defaults accl.cpp:1198-1208: gather fan-in capped above
     32 KB, bcast flat <= 3 ranks, reduce flat <= 4 ranks or <= 32 KB).
@@ -369,9 +394,29 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
       log2(P) rounds of latency for log2(P) payloads. Crossover bytes =
       where the extra serialized payload time equals the saved round
       latency.
+
+    `wire_dtype` evaluates the crossovers under an active compression
+    lane: the latency-vs-serialization tradeoffs happen in WIRE bytes,
+    but the registers are compared against UNCOMPRESSED payload bytes
+    (select_algorithm's bytes_count), so byte thresholds scale up by
+    elem_bytes / wire_elem_bytes — e.g. the int8 lanes stretch the
+    flat-tree regime ~3.94x further in payload bytes. This is how
+    autotune() moves its crossovers when the quantized lanes are on.
+
+    Scope caveat: a wire_dtype tune is a declaration that the workload's
+    collectives ride that wire. The byte registers are global (the
+    reference's registers are too) and the rendezvous branches that
+    consult them are reachable only by UNCOMPRESSED calls in this port
+    (is_rendezvous requires NO_COMPRESSION) — so a session mixing
+    compressed and uncompressed traffic should tune from its dominant
+    regime; the minority shape sees registers calibrated for the other
+    wire, exactly as with the reference's hand-picked globals.
     """
     P = world
     a, b = params.alpha, params.beta
+    # payload-bytes per wire-byte: register thresholds live in payload
+    # bytes while the latency/serialization arithmetic is wire bytes
+    wire_ratio = elem_bytes / wire_elem_bytes(elem_bytes, wire_dtype)
 
     bcast_max = 1
     while (bcast_max + 1) - 1 <= math.ceil(math.log2(bcast_max + 1)):
@@ -380,7 +425,8 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
     r = math.ceil(math.log2(P))
     # flat reduce: 2 latency + (P-1)n/b ; binomial: 2r latency + r*n/b
     denom = (P - 1 - r) / b
-    reduce_cross = (2 * r - 2) * a / denom if denom > 0 else float("inf")
+    reduce_cross = ((2 * r - 2) * a / denom * wire_ratio
+                    if denom > 0 else float("inf"))
     # flat gather (unbounded fan-in) vs fan-in-capped binomial: same shape
     gather_cross = reduce_cross
 
@@ -411,6 +457,11 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
     ring_only = TuningParams()
     max_eager = rx_buf_bytes
     nbytes = max_eager * 2
+    if wire_dtype != DataType.none:
+        # compressed calls never take the rendezvous path (is_rendezvous
+        # requires NO_COMPRESSION), so the reduce+bcast composition is
+        # unreachable under an active wire: the ring is the only shape
+        nbytes = (1 << 24) + 1
     while nbytes <= (1 << 24):
         count = max(nbytes // elem_bytes, 1)
         kw = dict(max_eager_size=max_eager, eager_rx_buf_size=rx_buf_bytes)
@@ -435,4 +486,5 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
         "reduce_flat_tree_max_ranks": reduce_ranks,
         "allreduce_composition_max_bytes": comp_best,
         "world": P,
+        "wire_dtype": wire_dtype.name,
     }

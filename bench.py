@@ -442,6 +442,107 @@ def _time_wall(fn):
     return time.perf_counter() - t
 
 
+def _jaxpr_ppermute_bytes(jaxpr) -> int:
+    """Sum the operand bytes of every ppermute equation in a (closed)
+    jaxpr: the static measure of bytes-on-the-wire per rank for one
+    execution of the traced program. Rides the analysis package's
+    walker (every cross-rank hop in the schedule layer IS a ppermute —
+    the protocol pass leans on the same invariant)."""
+    import jax.core as jcore
+
+    from accl_tpu.analysis.protocol import iter_ppermute_eqns
+
+    return sum(v.aval.size * v.aval.dtype.itemsize
+               for eqn in iter_ppermute_eqns(jaxpr)
+               for v in eqn.invars
+               if not isinstance(v, jcore.Literal))
+
+
+def bench_quantized_wire(jax, world, nbytes=16 * 1024 * 1024,
+                         err_elems=1 << 16):
+    """The quantized-allreduce gate lane: trace the fp32 and the
+    blockwise-int8-wire ring allreduce at `nbytes` payload and compare
+    TOTAL ppermute operand bytes (the wire bytes every hop moves,
+    measured from the lowered program itself, not from the model), then
+    execute a smaller quantized allreduce against the fp32 oracle for
+    the max relative error. Returns (reduction_x, max_rel_err)."""
+    from jax.sharding import Mesh
+
+    from accl_tpu import (CallOptions, CompressionFlags, DataType,
+                          Operation, ReduceFunction, TuningParams)
+    from accl_tpu.sequencer import select_algorithm
+    from accl_tpu.sequencer.lowering import ScheduleCompiler
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    comp = ScheduleCompiler(mesh, use_pallas_ring=False)
+    count = nbytes // 4
+    kw = dict(max_eager_size=1 << 30, eager_rx_buf_size=1 << 22,
+              tuning=TuningParams.default())
+
+    def traced_bytes(wire):
+        flags = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
+                 else CompressionFlags.NO_COMPRESSION)
+        opts = CallOptions(scenario=Operation.allreduce, count=count,
+                           function=int(ReduceFunction.SUM),
+                           compression_flags=flags,
+                           data_type=DataType.float32, compress_dtype=wire)
+        plan = select_algorithm(Operation.allreduce, count, 4, world,
+                                flags, compress_dtype=wire, **kw)
+        fn = comp.lower(opts, plan)
+        arg = jax.ShapeDtypeStruct((world, count), np.float32)
+        return _jaxpr_ppermute_bytes(jax.make_jaxpr(fn)(arg))
+
+    b_fp32 = traced_bytes(DataType.none)
+    b_q = traced_bytes(DataType.int8)
+    reduction = b_fp32 / max(b_q, 1)
+
+    # numeric lane: quantized vs fp32 oracle at a size small enough for
+    # the CPU mesh, same plan family as the 16 MiB trace
+    flags = CompressionFlags.ETH_COMPRESSED
+    opts = CallOptions(scenario=Operation.allreduce, count=err_elems,
+                       function=int(ReduceFunction.SUM),
+                       compression_flags=flags,
+                       data_type=DataType.float32,
+                       compress_dtype=DataType.int8)
+    plan = select_algorithm(Operation.allreduce, err_elems, 4, world,
+                            flags, compress_dtype=DataType.int8, **kw)
+    fn = comp.lower(opts, plan)
+    x = np.random.default_rng(11).standard_normal(
+        (world, err_elems)).astype(np.float32)
+    out = np.asarray(fn(x))
+    oracle = x.sum(0)
+    scale = np.abs(oracle).max()
+    max_rel = float(np.abs(out[0] - oracle).max() / scale)
+    print(f"  quantized_allreduce w{world}: wire {b_fp32 / 2**20:.1f} MiB "
+          f"-> {b_q / 2**20:.1f} MiB per rank ({reduction:.2f}x), "
+          f"max rel err {max_rel:.2e} at {err_elems * 4 // 1024} KiB",
+          file=sys.stderr)
+    return reduction, max_rel
+
+
+def _quant_gate_main():
+    """bench.py --quant-gate: ONLY the quantized-allreduce gate lane
+    (for the CI lint job, which wants the wire-byte gate without paying
+    the tier1-smoke job's full sequence benchmark twice). One JSON line;
+    exit 1 when the 16 MiB wire-byte reduction drops below 1.9x."""
+    import jax
+
+    world = min(len(jax.devices()), 4)
+    reduction, max_rel = bench_quantized_wire(jax, world)
+    print(json.dumps({
+        "metric": "quantized allreduce ppermute bytes-on-wire reduction "
+                  f"vs fp32 at 16 MiB (w{world})",
+        "value": round(reduction, 2),
+        "unit": "x",
+        "vs_baseline": round(reduction / 4.0, 3),  # 4x = scale-free ideal
+        "quantized_max_rel_error": round(max_rel, 6),
+    }))
+    if reduction < 1.9:
+        print(f"FAIL: quantized allreduce wire reduction "
+              f"{reduction:.2f}x < 1.9x at 16 MiB", file=sys.stderr)
+        sys.exit(1)
+
+
 def _smoke_main():
     """bench.py --smoke: the CI-facing quick lane — runs the fused-vs-
     eager sequence benchmark on the virtual CPU mesh and emits ONE JSON
@@ -459,6 +560,9 @@ def _smoke_main():
     print(f"  lint stage {lint_sec*1e6:8.1f} us vs record+compile "
           f"{rc_sec*1e3:8.1f} ms ({lint_ratio*100:.3f}%)",
           file=sys.stderr)
+    q_reduction, q_max_rel = bench_quantized_wire(jax, world)
+    rows.append(("quantized_allreduce_wire_reduction", 16 * 1024 * 1024,
+                 0.0, q_reduction, 1.0, True))
     outdir = pathlib.Path(__file__).parent / "accl_log"
     outdir.mkdir(exist_ok=True)
     with open(outdir / "profile_smoke.csv", "w") as f:
@@ -471,7 +575,19 @@ def _smoke_main():
         "value": round(speedup, 3),
         "unit": "x",
         "vs_baseline": round(speedup, 3),  # eager chain = 1.0
+        # quantized-wire gate lane: measured ppermute bytes-on-wire
+        # reduction at 16 MiB (must hold >= 1.9x vs fp32) and the max
+        # relative error of the int8-wire allreduce vs the fp32 oracle
+        "quantized_wire_reduction": round(q_reduction, 2),
+        "quantized_max_rel_error": round(q_max_rel, 6),
     }))
+    # wire-byte gate: the quantized lanes exist to beat the 2x cast
+    # ceiling — anything under 1.9x at 16 MiB means the scale
+    # side-channel (or a regression) ate the win
+    if q_reduction < 1.9:
+        print(f"FAIL: quantized allreduce wire reduction "
+              f"{q_reduction:.2f}x < 1.9x at 16 MiB", file=sys.stderr)
+        sys.exit(1)
     # the gate is real: a fused path SLOWER than eager back-to-back
     # dispatch is a regression in the one property the sequence layer
     # exists for — fail the CI job, don't just log a number
@@ -619,14 +735,55 @@ def bench_decode(jax):
         f.write(f"{batch},{ctx},{sec:.6e},{tok_s:.1f},{snr:.1f},{regime}\n")
 
 
+_PROBE_CACHE = pathlib.Path(__file__).parent / "accl_log" / \
+    "tpu_probe_cache.json"
+
+
+def _tpu_reachable_backoff(attempts=(20, 40, 90), cache_ttl_s=900.0) -> bool:
+    """Bounded-backoff TPU probe with a run-scoped verdict cache.
+
+    A live tunnel answers `jax.devices()` in seconds, so the probe
+    starts with a short rope and only escalates toward the full
+    watchdog budget when earlier attempts time out (a wedged tunnel
+    never answers — BENCH_r05 paid the whole 'device probe hung past
+    150s' before falling back). The verdict lands in
+    accl_log/tpu_probe_cache.json with a timestamp, so every later
+    bench invocation of the same run (the probe-loop payload runs the
+    suite, the full sweep, and the timing-model refresh back to back)
+    reads the cached verdict instead of re-paying a multi-minute hang;
+    a cache older than cache_ttl_s re-probes, since tunnels do recover
+    (tools/tpu_probe_loop.py exists to catch exactly that)."""
+    try:
+        c = json.loads(_PROBE_CACHE.read_text())
+        if time.time() - float(c["ts"]) < cache_ttl_s:
+            print(f"TPU probe: cached verdict ok={c['ok']} "
+                  f"({time.time() - c['ts']:.0f}s old)", file=sys.stderr)
+            return bool(c["ok"])
+    except (OSError, ValueError, KeyError):
+        pass
+    from __graft_entry__ import _probe_tpu  # the one shared watchdog
+
+    ok = False
+    for i, t in enumerate(attempts):
+        ok, detail = _probe_tpu(timeout_s=t)
+        if ok:
+            break
+        print(f"TPU probe attempt {i + 1}/{len(attempts)} "
+              f"(timeout {t}s): {detail.splitlines()[0]}", file=sys.stderr)
+    _PROBE_CACHE.parent.mkdir(exist_ok=True)
+    try:
+        _PROBE_CACHE.write_text(json.dumps({"ok": ok, "ts": time.time()}))
+    except OSError:
+        pass  # probe verdict is still good for this process
+    return ok
+
+
 def main():
     if os.environ.get("ACCL_BENCH_NO_FALLBACK") != "1":
-        # shared subprocess watchdog (see __graft_entry__._tpu_reachable):
-        # a wedged tunnel hangs jax.devices() forever, and probing in a
+        # shared subprocess watchdog (see __graft_entry__._probe_tpu): a
+        # wedged tunnel hangs jax.devices() forever, and probing in a
         # subprocess keeps THIS process's backend un-touched
-        from __graft_entry__ import _tpu_reachable
-
-        if not _tpu_reachable(timeout_s=150):
+        if not _tpu_reachable_backoff():
             # TPU wedged: re-exec on the CPU backend so the driver still
             # gets a (clearly labeled) result instead of a hang
             import subprocess
@@ -771,5 +928,7 @@ def main():
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         _smoke_main()
+    elif "--quant-gate" in sys.argv:
+        _quant_gate_main()
     else:
         main()

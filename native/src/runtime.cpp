@@ -51,6 +51,7 @@ enum Err : uint32_t {
   DMA_SIZE_ERROR = 1u << 18,
   ARITH_ERROR = 1u << 19,
   PACK_SEQ_NUMBER_ERROR = 1u << 21,
+  COMPRESSION_ERROR = 1u << 22,
   DMA_TAG_MISMATCH_ERROR = 1u << 26,
   NOT_READY = 0x80000000u,  // internal: requeue with current_step saved
 };
@@ -2483,6 +2484,14 @@ struct accl_rt {
         // row rewrite between requeue passes must not flip the wire
         // dtype of a partially-executed call
         uint32_t arcfg_addr = c.desc[6];
+        // compressor lanes > 3 are the blockwise-quantized wire
+        // (arithconfig.py lanes 4/5: int8 codes + per-block scales);
+        // this data plane has no quantized kernel — degrading to a
+        // cast would silently put 2 B/elem on a wire the caller sized
+        // at ~1 B, so the call is rejected, not reinterpreted
+        if (arcfg_addr != 0 && arcfg_addr + 16 < EXCHMEM_BYTES &&
+            rd(arcfg_addr + 4 * 3) > 3)
+          return COMPRESSION_ERROR;
         c.cstate->wire_bf16 =
             (arcfg_addr != 0 && arcfg_addr + 16 < EXCHMEM_BYTES &&
              rd(arcfg_addr + 4 * 3) == 2)

@@ -414,6 +414,143 @@ def test_sequence_fuzz_fused_eager_native(cfg):
 
 
 # ---------------------------------------------------------------------------
+# quantized-wire fuzz: blockwise int8 lanes vs the fp32 oracle. The native
+# executor has no quantized lane (the int8 wire is an XLA-tier feature), so
+# these cases check the schedule executor against numpy truth with the
+# DOCUMENTED per-block error bound: each quantization pass adds at most
+# block_amax / 254 per element, and a value's path through the ring
+# quantizes P-1 times for reduce_scatter (encode + P-2 requantizes) plus
+# one more allgather encode for allreduce. Positive operands keep partial
+# amax <= final amax, so the bound composes without cancellation caveats.
+# ---------------------------------------------------------------------------
+
+QUANT_SEED = 24601
+QUANT_CONFIGS = 10
+
+
+def _sample_quantized():
+    rng = np.random.default_rng(QUANT_SEED)
+    configs = []
+    for i in range(QUANT_CONFIGS):
+        op = [Operation.allreduce, Operation.reduce_scatter][
+            int(rng.integers(2))]
+        world = int(rng.integers(2, 9))
+        count = int(rng.integers(1, 3000))
+        func = ReduceFunction(int(rng.integers(2)))
+        configs.append((i, op, world, count, func))
+    # pinned: both ops at world 8 with counts crossing several scale
+    # blocks AND several eager segments, both reduce functions
+    configs += [
+        (QUANT_CONFIGS, Operation.allreduce, 8, 9000, ReduceFunction.SUM),
+        (QUANT_CONFIGS + 1, Operation.allreduce, 8, 9000, ReduceFunction.MAX),
+        (QUANT_CONFIGS + 2, Operation.reduce_scatter, 8, 1200,
+         ReduceFunction.SUM),
+    ]
+    return configs
+
+
+def _lower_quantized(op, world, count, func, mesh):
+    from accl_tpu import DataType
+
+    flags = CompressionFlags.ETH_COMPRESSED
+    opts = CallOptions(scenario=op, count=count, function=int(func),
+                       compression_flags=flags, data_type=DataType.float32,
+                       compress_dtype=DataType.int8)
+    plan = select_algorithm(op, count, 4, world, flags,
+                            max_eager_size=1024, eager_rx_buf_size=1024,
+                            tuning=TuningParams.default(),
+                            compress_dtype=DataType.int8)
+    return ScheduleCompiler(mesh, use_pallas_ring=False).lower(opts, plan)
+
+
+def _per_block_bound(oracle_rows, n_passes):
+    """Per-element error budget: n_passes quantization steps, each
+    bounded by that element's block amax / 254 (+ fp32 slop for the
+    differing accumulation order)."""
+    from accl_tpu.constants import QUANT_BLOCK_ELEMS, QUANT_QMAX
+
+    flat = np.asarray(oracle_rows, np.float32).reshape(
+        oracle_rows.shape[0], -1)
+    out = np.empty_like(flat)
+    for r, row in enumerate(flat):
+        n = row.shape[-1]
+        pad = (-n) % QUANT_BLOCK_ELEMS
+        blocks = np.pad(row, (0, pad)).reshape(-1, QUANT_BLOCK_ELEMS)
+        amax = np.abs(blocks).max(-1)
+        out[r] = np.repeat(amax, QUANT_BLOCK_ELEMS)[:n]
+    bound = out * (n_passes / (2 * QUANT_QMAX)) * 1.05
+    return bound.reshape(oracle_rows.shape) + 1e-5
+
+
+@pytest.mark.parametrize(
+    "cfg", _sample_quantized(),
+    ids=lambda c: f"q{c[0]}-{c[1].name}-w{c[2]}-n{c[3]}-{c[4].name}")
+def test_quantized_wire_vs_fp32_oracle(cfg):
+    i, op, world, count, func = cfg
+    rng = np.random.default_rng(QUANT_SEED + 10 + i)
+    in_per_rank = count * world if op == Operation.reduce_scatter else count
+    # positive operands: partial-sum amax is monotone, so the per-block
+    # bound composes across hops without cancellation caveats
+    x = rng.uniform(0.1, 1.0, (world, in_per_rank)).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    fn = _lower_quantized(op, world, count, func, mesh)
+    out = np.asarray(fn(x))
+
+    red = x.sum(0) if func == ReduceFunction.SUM else x.max(0)
+    if op == Operation.allreduce:
+        oracle = np.tile(red, (world, 1))
+        n_passes = world  # P-1 reduce-scatter passes + 1 allgather encode
+    else:
+        oracle = red.reshape(world, count)
+        n_passes = world - 1
+    bound = _per_block_bound(oracle, n_passes)
+    err = np.abs(out - oracle)
+    assert (err <= bound).all(), (
+        f"cfg {cfg}: max err {err.max():.3e} exceeds per-block bound "
+        f"{bound[err.argmax() // bound.shape[-1]].max():.3e}")
+    # bitwise-reproducible across runs
+    np.testing.assert_array_equal(out, np.asarray(fn(x)))
+
+
+def test_quantized_sequence_fused_equals_eager_bitwise():
+    """A recorded quantized batch (allreduce + reduce_scatter/allgather
+    on the int8 wire) must be BITWISE identical to the same calls issued
+    eagerly — the device-resident sequence contract does not weaken
+    under quantized lanes, because both paths lower through the same
+    schedule bodies."""
+    from accl_tpu import DataType
+    from accl_tpu.accl import ACCL
+
+    world, n = 4, 1024
+    chunk = n // world
+    rng = np.random.default_rng(QUANT_SEED + 99)
+    init = [rng.standard_normal((world, n)).astype(np.float32)
+            for _ in range(2)]
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    eager = [accl.create_buffer(n, data=x) for x in init]
+    fused = [accl.create_buffer(n, data=x) for x in init]
+
+    def issue(bufs, ops):
+        ops.allreduce(bufs[0], bufs[1], n, ReduceFunction.SUM,
+                      compress_dtype=DataType.int8)
+        ops.reduce_scatter(bufs[1], bufs[0], chunk, ReduceFunction.MAX,
+                           compress_dtype=DataType.int8)
+        ops.allgather(bufs[0], bufs[1], chunk,
+                      compress_dtype=DataType.int8)
+
+    issue(eager, accl)
+    rec = accl.sequence()
+    issue(fused, rec)
+    req = rec.run()
+    assert req.num_dispatches == 1
+    for k in range(2):
+        np.testing.assert_array_equal(
+            eager[k].host, fused[k].host,
+            err_msg=f"quantized fused != eager (buffer {k})")
+
+
+# ---------------------------------------------------------------------------
 # point-to-point fuzz: random send/recv patterns through both executors
 # ---------------------------------------------------------------------------
 

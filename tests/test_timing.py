@@ -326,6 +326,133 @@ def test_logp_constants_actually_used_by_native_rules():
     assert "seg_bytes=*/STREAM_SEG_BYTES" in src
 
 
+# ---------------------------------------------------------------------------
+# wire-byte accounting: ETH_COMPRESSED plans must be charged wire widths
+# (+ scale overhead for the quantized lanes), and the autotune crossovers
+# must MOVE when a compression lane is active
+# ---------------------------------------------------------------------------
+
+
+def _compressed_plan(op, count, world, wire):
+    from accl_tpu.constants import CompressionFlags, DataType
+
+    comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
+            else CompressionFlags.NO_COMPRESSION)
+    return select_algorithm(op, count, 4, world, comp,
+                            max_eager_size=4096, eager_rx_buf_size=RX,
+                            tuning=TUNING, compress_dtype=wire)
+
+
+def test_predict_charges_wire_dtype_widths():
+    """The satellite regression: predict() used to charge UNCOMPRESSED
+    bytes on ETH_COMPRESSED calls. Cast lanes must halve the byte term,
+    the blockwise int8 lanes must shrink it 4/(1+4/256) ~ 3.94x (scale
+    side-channel included)."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.timing import wire_elem_bytes
+
+    count, world = 1 << 20, 8  # 4 MiB: byte-dominated ring regime
+    p_none = _compressed_plan(Operation.allreduce, count, world,
+                              DataType.none)
+    p_f16 = _compressed_plan(Operation.allreduce, count, world,
+                             DataType.float16)
+    p_q = _compressed_plan(Operation.allreduce, count, world,
+                           DataType.int8)
+    assert p_f16.wire_dtype == DataType.float16
+    assert p_q.wire_dtype == DataType.int8
+    _, b_none = coefficients(Operation.allreduce, p_none, count, 4, world,
+                             rx_buf_bytes=RX)
+    _, b_f16 = coefficients(Operation.allreduce, p_f16, count, 4, world,
+                            rx_buf_bytes=RX)
+    _, b_q = coefficients(Operation.allreduce, p_q, count, 4, world,
+                          rx_buf_bytes=RX)
+    assert b_none / b_f16 == pytest.approx(2.0)
+    assert b_none / b_q == pytest.approx(4 / wire_elem_bytes(4,
+                                                             DataType.int8))
+    assert b_none / b_q == pytest.approx(3.938, rel=1e-3)
+    # and the time prediction follows on a bandwidth-bound link
+    lp = LinkParams(alpha=1e-9, beta=1e9)
+    t_none = predict(lp, Operation.allreduce, p_none, count, 4, world,
+                     rx_buf_bytes=RX)
+    t_q = predict(lp, Operation.allreduce, p_q, count, 4, world,
+                  rx_buf_bytes=RX)
+    assert t_none / t_q == pytest.approx(3.938, rel=1e-2)
+
+
+def test_tuning_crossovers_shift_with_quantized_wire():
+    """Crossover arithmetic runs in WIRE bytes while the registers are
+    compared against payload bytes: enabling the quantized lanes must
+    stretch the byte thresholds by the compression ratio (the flat-tree
+    regime reaches ~3.94x further into payload bytes), leave the
+    structural rank crossovers alone, and pin the composition scan to 0
+    (compressed calls never route rendezvous)."""
+    from accl_tpu.constants import DataType
+
+    link = LinkParams(alpha=25e-6, beta=2.5e9)
+    base = tuning_crossovers(link, world=8)
+    quant = tuning_crossovers(link, world=8, wire_dtype=DataType.int8)
+    ratio = (quant["reduce_flat_tree_max_count_bytes"]
+             / base["reduce_flat_tree_max_count_bytes"])
+    assert ratio == pytest.approx(4 / (1 + 4 / 256), rel=1e-6)
+    assert quant["bcast_flat_tree_max_ranks"] == \
+        base["bcast_flat_tree_max_ranks"]
+    assert quant["allreduce_composition_max_bytes"] == 0
+    assert quant["wire_dtype"] == "int8"
+    # cast lanes shift too, by exactly their width ratio
+    half = tuning_crossovers(link, world=8, wire_dtype=DataType.bfloat16)
+    assert (half["reduce_flat_tree_max_count_bytes"]
+            / base["reduce_flat_tree_max_count_bytes"]) == \
+        pytest.approx(2.0, rel=1e-6)
+
+
+def test_facade_autotune_moves_with_quantized_wire(mesh8):
+    """ACCL.autotune(wire_dtype=int8) must land DIFFERENT registers than
+    the uncompressed tune — the acceptance pin that enabling quantized
+    lanes moves the crossovers end to end (model -> registers -> device
+    readback)."""
+    from accl_tpu import DataType
+    from accl_tpu.accl import ACCL
+
+    accl = ACCL(mesh8)
+    link = LinkParams(alpha=50e-6, beta=1e9)
+    plain = accl.autotune(link=link)
+    quant = accl.autotune(link=link, wire_dtype=DataType.int8)
+    assert quant.reduce_flat_tree_max_count > plain.reduce_flat_tree_max_count
+    assert (quant.reduce_flat_tree_max_count
+            / plain.reduce_flat_tree_max_count) == pytest.approx(
+        4 / (1 + 4 / 256), rel=1e-2)
+    # the quantized tune is live on the device
+    assert accl.cclo.tuning().reduce_flat_tree_max_count == \
+        quant.reduce_flat_tree_max_count
+
+
+def test_select_wire_is_a_performance_decision():
+    """Compression as a plan dimension: on a latency-dominated call the
+    selector keeps the exact fp32 wire (the byte saving cannot clear the
+    min_gain bar), on a bandwidth-bound payload it picks the narrowest
+    profitable lane (int8 beats the casts)."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.plan import select_wire
+
+    link = LinkParams(alpha=25e-6, beta=2.5e9)
+    kw = dict(max_eager_size=4096, eager_rx_buf_size=RX, rx_buf_bytes=RX,
+              tuning=TUNING)
+    small = select_wire(Operation.allreduce, 16, DataType.float32, 8,
+                        link, **kw)
+    big = select_wire(Operation.allreduce, 1 << 22, DataType.float32, 8,
+                      link, **kw)
+    assert small == DataType.none
+    assert big == DataType.int8
+    # non-fp32 payloads have no compression rows: always uncompressed
+    assert select_wire(Operation.allreduce, 1 << 22, DataType.int32, 8,
+                       link, **kw) == DataType.none
+    # a backend without the quantized ring kernels (quantized_ok=False,
+    # from its supports_quantized_wire) gets the runner-up cast lane
+    # instead of a pick the facade would reject
+    assert select_wire(Operation.allreduce, 1 << 22, DataType.float32, 8,
+                       link, quantized_ok=False, **kw) == DataType.float16
+
+
 def test_predict_sequence_fused_vs_eager_gain():
     """The sequence cost model: wire work is the per-call sum either way;
     fusion saves exactly (k-1) host dispatches."""

@@ -69,11 +69,23 @@ DEFAULT_CORPUS = pathlib.Path(__file__).resolve().parent / "lint_corpus"
 
 
 def _step_from_dict(d: dict) -> CallOptions:
+    from accl_tpu.constants import CompressionFlags
+
     op = Operation[d["op"]]
     fn = d.get("function", 0)
     if isinstance(fn, str):
         fn = int(ReduceFunction[fn])
     dt = d.get("dtype", "float32")
+    data_type = DataType[dt] if isinstance(dt, str) else DataType(dt)
+    # "compress": wire dtype of an ETH_COMPRESSED call (e.g. "int8" for
+    # the blockwise-quantized lanes) — mirrors the facade's
+    # compress_dtype resolution in _prepare
+    cp = d.get("compress")
+    compress_dtype = (DataType[cp] if isinstance(cp, str)
+                      else DataType(cp)) if cp is not None else DataType.none
+    comp_flags = (CompressionFlags.ETH_COMPRESSED
+                  if compress_dtype not in (DataType.none, data_type)
+                  else CompressionFlags.NO_COMPRESSION)
     return CallOptions(
         scenario=op,
         count=int(d.get("count", 0)),
@@ -84,7 +96,9 @@ def _step_from_dict(d: dict) -> CallOptions:
         addr_0=int(d.get("addr_0", 0)),
         addr_1=int(d.get("addr_1", 0)),
         addr_2=int(d.get("addr_2", 0)),
-        data_type=DataType[dt] if isinstance(dt, str) else DataType(dt),
+        data_type=data_type,
+        compress_dtype=compress_dtype,
+        compression_flags=comp_flags,
     )
 
 
@@ -95,6 +109,7 @@ def _default_plan(opts: CallOptions, world: int):
         max_eager_size=DEFAULT_MAX_EAGER_SIZE,
         eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
         tuning=TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE),
+        compress_dtype=opts.compress_dtype,
     )
 
 
