@@ -597,10 +597,13 @@ class ACCL:
         `lint` runs the batch through the static analyzer
         (accl_tpu/analysis/, docs/lint.md) before it compiles:
         "error" (default) raises errors.LintError on hazardous batches,
-        "warn" logs the diagnostics and proceeds, "off" opts out."""
-        if lint not in ("error", "warn", "off"):
+        "warn" logs the diagnostics and proceeds, "off" opts out, and
+        "deep" adds the exhaustive-interleaving tier (wildcard races
+        and schedule-dependent deadlocks over every legal match order,
+        ACCL205/206 — budgeted, enforced like "error")."""
+        if lint not in ("error", "warn", "off", "deep"):
             raise ValueError(
-                f"lint must be 'error'|'warn'|'off', got {lint!r}")
+                f"lint must be 'error'|'warn'|'off'|'deep', got {lint!r}")
         if not hasattr(self.cclo, "start_sequence"):
             raise NotImplementedError(
                 f"{type(self.cclo).__name__} does not support call "

@@ -12,22 +12,35 @@ diagnostics with stable codes (docs/lint.md has the full table):
                 address renaming               (ACCL101-103, 401, 405)
   protocol.py   per-rank send/recv matching, deadlock cycles, and
                 abstract interpretation of schedule bodies (ACCL201-204)
+  modelcheck.py exhaustive-interleaving model checking: wildcard races
+                and schedule-dependent deadlocks over ALL legal match
+                orders, budgeted               (ACCL205-207)
   slots.py      overlap-slot collective_id liveness (ACCL301-302)
   validate.py   descriptor structure: roots, counts, dtypes,
                 communicators                  (ACCL401-404)
   linter.py     the SequenceLinter orchestrator + lint_sequence()
 
 Wired in three places: the opt-out `lint=` stage in `ACCL.sequence()`
-(enforced in TPUDevice.start_sequence, cached by composite signature),
-the corpus CLI `tools/accl_lint.py`, and the CI lint job.
+(enforced in TPUDevice.start_sequence, cached by composite signature;
+`lint="deep"` opts into the interleaving tier), the corpus CLI
+`tools/accl_lint.py` (`--deep`), and the CI lint job.
 """
 
 from ..errors import LintError  # noqa: F401  (canonical home: errors.py)
 from .diagnostics import CODES, Diagnostic, enforce, make  # noqa: F401
 from .hazards import analyze_dataflow  # noqa: F401
 from .linter import SequenceLinter, lint_sequence  # noqa: F401
+from .modelcheck import (  # noqa: F401
+    Budget,
+    CheckResult,
+    check_interleavings,
+    diagnose_programs,
+)
 from .protocol import (  # noqa: F401
+    ANY_SRC,
     Event,
+    MatchNote,
+    batch_rank_programs,
     interpret_schedule,
     rank_programs_from_options,
     simulate,

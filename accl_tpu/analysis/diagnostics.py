@@ -46,6 +46,17 @@ CODES: dict[str, tuple[str, str, str]] = {
     "ACCL204": ("perm-conflict", "error",
                 "malformed permute hop: duplicate or out-of-range "
                 "source/destination"),
+    "ACCL205": ("wildcard-race", "error",
+                "a wildcard recv (TAG_ANY / any-source) matches different "
+                "sends across legal match orders: the delivered data is "
+                "schedule-dependent"),
+    "ACCL206": ("schedule-dependent-deadlock", "error",
+                "some legal match order reaches a stuck state although "
+                "the canonical schedule completes"),
+    "ACCL207": ("modelcheck-truncated", "warning",
+                "exhaustive interleaving exploration hit its state or "
+                "wall-clock budget: the deep verdict covers only the "
+                "explored prefix"),
     "ACCL301": ("slot-collision", "error",
                 "two live schedule instances share a collective_id slot "
                 "with no ordering between them"),
@@ -106,17 +117,20 @@ def make(code: str, message: str, step: int | None = None,
 def enforce(diagnostics, mode: str) -> None:
     """Apply a lint mode to a diagnostic list: `"error"` raises LintError
     on error-severity findings (warnings are logged), `"warn"` logs
-    everything, `"off"` is a no-op. The full diagnostic list — warnings
-    included — rides any raised LintError."""
-    if mode not in ("error", "warn", "off"):
-        raise ValueError(f"lint mode must be 'error'|'warn'|'off', "
+    everything, `"off"` is a no-op. `"deep"` enforces like `"error"` —
+    the mode names select which passes RUN (the deep tier adds the
+    interleaving model checker); enforcement semantics differ only in
+    error vs warn vs off. The full diagnostic list — warnings included —
+    rides any raised LintError."""
+    if mode not in ("error", "warn", "off", "deep"):
+        raise ValueError(f"lint mode must be 'error'|'warn'|'off'|'deep', "
                          f"got {mode!r}")
     if mode == "off" or not diagnostics:
         return
     from ..utils.logging import Log
 
     errors = [d for d in diagnostics if d.severity == "error"]
-    if mode == "error" and errors:
+    if mode in ("error", "deep") and errors:
         raise LintError(diagnostics)
     for d in diagnostics:
         Log.warning("lint: %s", d)

@@ -8,12 +8,14 @@ and returns the combined diagnostic list, most severe first.
 
 The shallow passes are pure Python over the descriptors (microseconds;
 the bench smoke gate pins them under 5% of record+compile time). The
-deep pass abstractly evaluates every step's schedule body under jax
-tracing, so it costs about as much as a second trace: it is OFF in the
-in-band `ACCL.sequence()` stage and ON in the corpus CLI
-(tools/accl_lint.py) and the schedule-conformance tests, where its
-job — proving the shipping schedules deadlock-free per rank — earns
-the trace.
+deep tier abstractly evaluates every step's schedule body under jax
+tracing (about the cost of a second trace) and then model-checks the
+batch's per-rank hop programs over EVERY legal match order
+(modelcheck.py — ACCL205/206/207, budgeted): it is OFF in the in-band
+default (`lint="error"`), opted into per batch with `lint="deep"`, and
+ON in the corpus CLI (tools/accl_lint.py) and the schedule-conformance
+tests, where its job — proving the shipping schedules deadlock-free
+under all interleavings — earns the cost.
 """
 
 from __future__ import annotations
@@ -46,6 +48,7 @@ class SequenceLinter:
         deep: bool = False,
         axis_name: str = "ccl",
         arith_table: dict | None = None,
+        budget=None,
     ):
         self.world = world
         self.use_pallas_ring = use_pallas_ring
@@ -55,6 +58,9 @@ class SequenceLinter:
         # the ACTIVE arithmetic configuration (compression-lane pairing,
         # ACCL406): None = the shipping default table
         self.arith_table = arith_table
+        # exploration caps for the deep tier's interleaving checker
+        # (modelcheck.Budget); None = the shipping default
+        self.budget = budget
 
     def ring_steps(self, steps) -> frozenset[int]:
         """Indices that lower to the slot-keyed pallas ring — the same
@@ -93,14 +99,60 @@ class SequenceLinter:
                 steps, self.world, overlap=self.pallas_ring_overlap)
             diags += check_slots(timeline)
         if self.deep and plans is not None and not diags:
-            from .protocol import interpret_schedule
+            from .protocol import (
+                batch_programs_from_hops,
+                check_hops,
+                rank_programs_from_hops,
+                simulate,
+                trace_schedule_hops,
+            )
 
+            # per-step interpretation (interpret_schedule's passes,
+            # inlined so each schedule body is abstractly traced ONCE —
+            # the trace is the deep tier's dominant cost, and the batch
+            # checker below reuses the same hops)
+            hops_per_step = []
             for k, (opts, plan) in enumerate(zip(steps, plans)):
-                for d in interpret_schedule(opts, plan, self.world,
-                                            self.axis_name):
+                hops = trace_schedule_hops(opts, plan, self.world,
+                                           self.axis_name)
+                hops_per_step.append(hops)
+                step_diags = check_hops(hops, self.world)
+                if not step_diags:  # malformed perms confuse the matcher
+                    step_diags = simulate(
+                        rank_programs_from_hops(hops, self.world),
+                        blocking_sends=False)
+                for d in step_diags:
                     diags.append(Diagnostic(d.code, d.message, step=k,
                                             rank=d.rank))
+            if not diags:
+                # exhaustive-interleaving tier: certify the BATCH's
+                # per-rank hop programs over every legal match order
+                # (per-step interpretation above saw one step and one
+                # schedule at a time). The checker's static router skips
+                # exploration when the matching is provably unique.
+                programs = batch_programs_from_hops(hops_per_step,
+                                                    self.world)
+                diags += self.check_interleavings(programs)
         return self._sorted(diags)
+
+    def check_interleavings(self, programs) -> list[Diagnostic]:
+        """Model-check per-rank event programs over every legal match
+        order (the deep tier's last pass; also the entry point
+        tools/accl_lint.py uses for `rank_programs` fixtures). The
+        static pin analysis routes: a batch where every endpoint has a
+        provably unique partner (which subsumes the no-MatchNote case —
+        a multi-eligible recv is never uniquely pinned) admits exactly
+        one matching and skips exploration outright."""
+        from .modelcheck import (
+            Budget,
+            diagnose_programs,
+            statically_deterministic,
+        )
+
+        if statically_deterministic(programs):
+            return []
+        return diagnose_programs(programs,
+                                 budget=self.budget or Budget())
 
     @staticmethod
     def _sorted(diags: list[Diagnostic]) -> list[Diagnostic]:
@@ -113,9 +165,13 @@ def lint_sequence(steps, world: int, *, mode: str = "error",
                   plans=None, buffer_widths=None, **kw) -> list[Diagnostic]:
     """One-shot convenience: lint a batch and apply `mode`
     (`"error"` raises LintError on error-severity findings, `"warn"`
-    logs, `"off"` skips). Returns the diagnostics either way."""
+    logs, `"off"` skips, `"deep"` adds the exhaustive-interleaving
+    tier and enforces like `"error"`). Returns the diagnostics either
+    way."""
     if mode == "off":
         return []
+    if mode == "deep":
+        kw.setdefault("deep", True)
     diags = SequenceLinter(world, **kw).lint(
         steps, plans, buffer_widths=buffer_widths)
     enforce(diags, mode)

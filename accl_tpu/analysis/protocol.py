@@ -35,7 +35,9 @@ from ..constants import Operation, TAG_ANY
 from .diagnostics import Diagnostic, make
 
 __all__ = [
+    "ANY_SRC",
     "Event",
+    "MatchNote",
     "send",
     "recv",
     "coll",
@@ -43,9 +45,17 @@ __all__ = [
     "rank_programs_from_options",
     "trace_schedule_hops",
     "rank_programs_from_hops",
+    "batch_programs_from_hops",
+    "batch_rank_programs",
     "check_hops",
     "interpret_schedule",
 ]
+
+# Wildcard source for recv events: matches a send from ANY rank (the
+# native executor's recvs are source-exact, but descriptor chains built
+# for single-controller or RDMA executors can be any-source; the model
+# checker explores every eligible sender).
+ANY_SRC = -2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,8 +88,29 @@ def _tags_match(a: int, b: int) -> bool:
     return a == b or TAG_ANY in (a, b)
 
 
+def _src_matches(sender: int, ev: Event) -> bool:
+    """A recv's source constraint: exact peer, or the ANY_SRC wildcard."""
+    return ev.peer == ANY_SRC or sender == ev.peer
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchNote:
+    """One ambiguous match observed during the canonical `simulate` run:
+    a recv for which MULTIPLE posted sends (or sender heads) were
+    eligible. The canonical run commits to the first-posted candidate;
+    the note records that the real executor had a choice — the cheap
+    single-run precursor that routes a batch into the deep
+    interleaving checker (modelcheck.py)."""
+
+    rank: int  # receiving rank
+    pc: int  # recv's index in its rank's program
+    candidates: tuple[str, ...]  # human-readable eligible sends
+
+
 def simulate(programs: list[list[Event]],
-             *, blocking_sends: bool = True) -> list[Diagnostic]:
+             *, blocking_sends: bool = True,
+             notes: list[MatchNote] | None = None,
+             outcome: list[bool] | None = None) -> list[Diagnostic]:
     """Run the blocking-match game over per-rank event lists and report
     every protocol defect found.
 
@@ -90,18 +121,31 @@ def simulate(programs: list[list[Event]],
     the semantics of hop-derived programs, where every ppermute hop's
     sends are posted collectively before any recv completes.
 
+    This explores exactly ONE interleaving — the canonical schedule:
+    ranks advance in index order, the posted buffer drains FIFO, and a
+    TAG_ANY recv takes the FIRST-POSTED eligible send. `notes`, when a
+    list is passed, collects a `MatchNote` per recv that had more than
+    one eligible candidate: the signal that other interleavings exist
+    and the batch needs the deep checker. `outcome`, when a list is
+    passed, receives one bool: did the canonical run CONSUME everything
+    (no stuck rank, no leftover posted send)? This is the structural
+    completion signal the deep tier's ACCL206 gate keys on — never
+    inferred from diagnostic text.
+
     Termination: each iteration of the outer loop advances at least one
     program counter or exits."""
     diags: list[Diagnostic] = []
     world = len(programs)
     pc = [0] * world
     posted: list[tuple[int, Event]] = []  # buffered (sender, send) FIFO
+    noted: set[tuple[int, int]] = set()  # (rank, pc) already noted
 
     def head(r: int) -> Event | None:
         return programs[r][pc[r]] if pc[r] < len(programs[r]) else None
 
     def bad_peer(r: int, ev: Event) -> bool:
-        if 0 <= ev.peer < world:
+        if 0 <= ev.peer < world or (ev.kind == "recv"
+                                    and ev.peer == ANY_SRC):
             return False
         diags.append(make(
             "ACCL402",
@@ -109,6 +153,11 @@ def simulate(programs: list[list[Event]],
             rank=r))
         pc[r] += 1
         return True
+
+    def note(r: int, cands: list[str]) -> None:
+        if notes is not None and len(cands) > 1 and (r, pc[r]) not in noted:
+            noted.add((r, pc[r]))
+            notes.append(MatchNote(r, pc[r], tuple(cands)))
 
     while True:
         progressed = False
@@ -120,35 +169,56 @@ def simulate(programs: list[list[Event]],
                         posted.append((r, ev))
                         pc[r] += 1
                     progressed = True
-            # recvs drain the buffer in arrival order
+            # recvs drain the buffer in arrival order (first-posted
+            # eligible send wins — the FIFO contract the native
+            # executor's seqn-ordered links implement)
             for r in range(world):
                 ev = head(r)
                 if ev is None or ev.kind != "recv" or bad_peer(r, ev):
                     continue
-                for i, (s, sev) in enumerate(posted):
-                    if (s == ev.peer and sev.peer == r
-                            and sev.comm == ev.comm
-                            and _tags_match(sev.tag, ev.tag)):
-                        if sev.count != ev.count:
-                            diags.append(make(
-                                "ACCL201",
-                                f"rank {s} sends {sev.count} elements "
-                                f"to rank {r}, which posted a recv for "
-                                f"{ev.count}", rank=r))
-                        posted.pop(i)
-                        pc[r] += 1
-                        progressed = True
-                        break
+                eligible = [
+                    i for i, (s, sev) in enumerate(posted)
+                    if (_src_matches(s, ev) and sev.peer == r
+                        and sev.comm == ev.comm
+                        and _tags_match(sev.tag, ev.tag))]
+                note(r, [f"r{posted[i][0]}:send(tag {posted[i][1].tag})"
+                         for i in eligible])
+                if eligible:
+                    i = eligible[0]
+                    s, sev = posted[i]
+                    if sev.count != ev.count:
+                        diags.append(make(
+                            "ACCL201",
+                            f"rank {s} sends {sev.count} elements "
+                            f"to rank {r}, which posted a recv for "
+                            f"{ev.count}", rank=r))
+                    posted.pop(i)
+                    pc[r] += 1
+                    progressed = True
         else:
             # point-to-point rendezvous: a send whose partner's CURRENT
-            # event is the matching recv completes both
+            # event is the matching recv completes both. An ANY_SRC recv
+            # head with several sender heads targeting it is ambiguous —
+            # note it, then commit to the lowest-ranked sender (the
+            # canonical order).
+            for d in range(world):
+                rv = head(d)
+                if rv is None or rv.kind != "recv" or rv.peer != ANY_SRC:
+                    continue
+                cands = [
+                    s for s in range(world)
+                    if (sv := head(s)) is not None and sv.kind == "send"
+                    and sv.peer == d and sv.comm == rv.comm
+                    and _tags_match(sv.tag, rv.tag)]
+                note(d, [f"r{s}:send(tag {head(s).tag})"  # type: ignore[union-attr]
+                         for s in cands])
             for r in range(world):
                 ev = head(r)
                 if ev is None or ev.kind != "send" or bad_peer(r, ev):
                     continue
                 pev = head(ev.peer)
                 if (pev is not None and pev.kind == "recv"
-                        and pev.peer == r and pev.comm == ev.comm
+                        and _src_matches(r, pev) and pev.comm == ev.comm
                         and _tags_match(ev.tag, pev.tag)):
                     if ev.count != pev.count:
                         diags.append(make(
@@ -173,6 +243,10 @@ def simulate(programs: list[list[Event]],
                 continue
         break
 
+    if outcome is not None:
+        outcome.append(not posted and all(
+            pc[r] >= len(programs[r]) for r in range(world)))
+
     # stuck-state decomposition
     for s, sev in posted:
         diags.append(make(
@@ -191,7 +265,7 @@ def simulate(programs: list[list[Event]],
 
     def waits_on(r: int) -> list[int]:
         ev = cur(r)
-        if ev.kind == "coll":
+        if ev.kind == "coll" or (ev.kind == "recv" and ev.peer == ANY_SRC):
             return [p for p in range(world) if p != r and p in stuck]
         return [ev.peer] if 0 <= ev.peer < len(programs) else []
 
@@ -202,7 +276,7 @@ def simulate(programs: list[list[Event]],
         if ev.kind != "send" or ev.peer not in stuck:
             continue
         pev = cur(ev.peer)
-        if pev.kind == "recv" and pev.peer == r:
+        if pev.kind == "recv" and _src_matches(r, pev):
             if ev.comm != pev.comm:
                 diags.append(make(
                     "ACCL403",
@@ -428,17 +502,53 @@ def check_hops(hops, world: int, step: int | None = None):
     return diags
 
 
-def rank_programs_from_hops(hops, world: int) -> list[list[Event]]:
+def rank_programs_from_hops(hops, world: int,
+                            tag_base: int = 0) -> list[list[Event]]:
     """Expand hop perms into per-rank blocking programs: hop h's pair
-    (s, d) is a send at s and a recv at d, both on channel h (the hop
-    index as tag), so matching is exact per hop."""
+    (s, d) is a send at s and a recv at d, both on channel
+    `tag_base + h` (the hop index as tag), so matching is exact per
+    hop. `tag_base` namespaces hops when several calls' programs are
+    concatenated into one batch — without it, step k's hop 0 and step
+    k+1's hop 0 would alias one channel and fabricate match choices."""
     programs: list[list[Event]] = [[] for _ in range(world)]
     for h, perm in enumerate(hops):
         for s, d in perm:
             if 0 <= s < world and 0 <= d < world:
-                programs[s].append(send(d, tag=h))
-                programs[d].append(recv(s, tag=h))
+                programs[s].append(send(d, tag=tag_base + h))
+                programs[d].append(recv(s, tag=tag_base + h))
     return programs
+
+
+# Hop-tag stride between steps of one batch: no shipping schedule moves
+# anywhere near 2**12 hops per call, and the namespaced tag stays far
+# below TAG_ANY (0xFFFFFFFF).
+_STEP_TAG_STRIDE = 1 << 12
+
+
+def batch_programs_from_hops(hops_per_step, world: int) -> list[list[Event]]:
+    """Concatenate per-step hop lists into whole-batch per-rank
+    programs, tag-namespaced per step. This is the input the deep
+    tier's interleaving checker explores — the cross-step view that
+    per-step `interpret_schedule` cannot see. Takes ALREADY-TRACED hops
+    so callers that interpreted each step (the linter's deep tier) pay
+    for jax abstract tracing once, not twice."""
+    programs: list[list[Event]] = [[] for _ in range(world)]
+    for k, hops in enumerate(hops_per_step):
+        for r, prog in enumerate(
+                rank_programs_from_hops(hops, world,
+                                        tag_base=k * _STEP_TAG_STRIDE)):
+            programs[r].extend(prog)
+    return programs
+
+
+def batch_rank_programs(steps, plans, world: int,
+                        axis_name: str = "ccl") -> list[list[Event]]:
+    """Per-rank event programs for a WHOLE descriptor batch: each step's
+    schedule body is abstractly interpreted (trace_schedule_hops) and
+    its hops appended in step order via `batch_programs_from_hops`."""
+    return batch_programs_from_hops(
+        [trace_schedule_hops(opts, plan, world, axis_name)
+         for opts, plan in zip(steps, plans)], world)
 
 
 def interpret_schedule(options, plan, world: int,

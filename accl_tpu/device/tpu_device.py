@@ -355,9 +355,12 @@ class TPUDevice(CCLODevice):
         `lint` gates the batch through the static analyzer
         (accl_tpu/analysis/) BEFORE anything compiles: "error" rejects
         hazardous batches with a typed LintError, "warn" logs the
-        diagnostics and proceeds, "off" skips the stage. Results are
-        cached under the same composite signature the compiled program
-        is, so a re-recorded batch re-lints nothing."""
+        diagnostics and proceeds, "off" skips the stage, "deep" adds
+        the exhaustive-interleaving model checker (ACCL205/206,
+        budgeted) on top of "error" enforcement. Results are cached
+        under the same composite signature the compiled program is —
+        keyed per tier, so a re-recorded batch re-lints nothing and
+        the default tier never pays for the deep one."""
         from ..descriptor import SequenceDescriptor
         from ..request import SequenceRequest
         from ..sequencer.sequence import SequencePlan
@@ -429,15 +432,17 @@ class TPUDevice(CCLODevice):
                 if addr and buf is not None and addr not in widths:
                     widths[addr] = buf.shape[-1]
                     canon.append(widths[addr])
+        deep = mode == "deep"
         key = (desc.signature(), plans, ctx.world, tuple(canon),
                ctx.compiler.use_pallas_ring,
-               ctx.compiler.pallas_ring_overlap)
+               ctx.compiler.pallas_ring_overlap, deep)
         diags = self._lint_cache.get(key)
         if diags is None:
             linter = SequenceLinter(
                 ctx.world,
                 use_pallas_ring=ctx.compiler.use_pallas_ring,
                 pallas_ring_overlap=ctx.compiler.pallas_ring_overlap,
+                deep=deep,
                 axis_name=self.axis_name,
                 # lint against the lanes this device will LOWER with: a
                 # custom arith_config's extra rows must not be rejected,

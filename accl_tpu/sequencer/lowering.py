@@ -199,7 +199,9 @@ class ScheduleCompiler:
             self._cache[key] = fn
         return fn
 
-    def _body(self, options: CallOptions, plan: Plan, arithcfg):
+    def _body(self, options: CallOptions, plan: Plan,
+              arithcfg) -> tuple[Callable, int]:
+        body: Callable
         axis, world = self.axis_name, self.world
         op = options.scenario
         root = options.root_src_dst
@@ -286,9 +288,10 @@ class ScheduleCompiler:
                 # the reduce stage's tree shape comes from plan.stages.
                 reduce_body = self._reduce_body(plan.stages[0], 0, func, common)
 
-                def body(x, *, _c=common, _rb=reduce_body):
+                def _rs_composed(x, *, _c=common, _rb=reduce_body):
                     return schedules.scatter_schedule(_rb(x), root=0, **_c)
 
+                body = _rs_composed
             else:
                 body = functools.partial(
                     schedules.reduce_scatter_ring_schedule, func=func, **common
@@ -302,12 +305,14 @@ class ScheduleCompiler:
                 reduce_body = self._reduce_body(plan.stages[0], 0, func, common)
                 bcast_bin = plan.stages[1].algorithm == Algorithm.RNDZV_BIN_TREE
 
-                def body(x, *, _c=common, _rb=reduce_body, _bin=bcast_bin):
+                def _ar_composed(x, *, _c=common, _rb=reduce_body,
+                                 _bin=bcast_bin):
                     red = _rb(x)
                     if _bin:
                         return schedules.bcast_bin_tree_schedule(red, root=0, **_c)
                     return schedules.bcast_flat_schedule(red, root=0, **_c)
 
+                body = _ar_composed
             else:
                 elem_bytes = 1
                 if options.data_type != DataType.none:
@@ -377,8 +382,8 @@ class ScheduleCompiler:
                             func=_f, slot=slot,
                         )
 
-                    def body(x, *, _c=common, _seg=seg_elems,
-                             _overlap=self.pallas_ring_overlap):
+                    def _pallas_ring_body(x, *, _c=common, _seg=seg_elems,
+                                          _overlap=self.pallas_ring_overlap):
                         y = _c["wire"].send(x)  # wire compression outside
                         if _overlap:
                             out = schedules.segmented_apply(
@@ -391,6 +396,7 @@ class ScheduleCompiler:
                             )
                         return _c["wire"].recv(out, x.dtype)
 
+                    body = _pallas_ring_body
                 else:
                     body = functools.partial(
                         schedules.allreduce_ring_schedule,
@@ -411,11 +417,12 @@ class ScheduleCompiler:
         if compressed_domain:
             inner, wd = body, wire_dtype(arithcfg)
 
-            def body(*args, _inner=inner, _wd=wd):
+            def _domain_cast_body(*args, _inner=inner, _wd=wd):
                 orig = args[0].dtype
                 out = _inner(*(a.astype(_wd) for a in args))
                 return out.astype(orig)
 
+            body = _domain_cast_body
         return body, n_in
 
     def _reduce_body(self, stage_plan: Plan, root: int, func, common):

@@ -327,8 +327,8 @@ def select_wire(
 
     table = arith_table or DEFAULT_ARITH_CONFIG
     elem_bytes = dtype_nbytes(data_type)
-    kw = dict(max_eager_size=max_eager_size,
-              eager_rx_buf_size=eager_rx_buf_size, tuning=tuning)
+    kw: dict = dict(max_eager_size=max_eager_size,
+                    eager_rx_buf_size=eager_rx_buf_size, tuning=tuning)
 
     def cost(wire: DataType) -> float:
         comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none

@@ -21,7 +21,7 @@ fuzz pins this).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from typing import Any, Callable
 
 from ..constants import DataType, Operation
 from ..descriptor import SequenceDescriptor
@@ -65,8 +65,8 @@ class _Step:
     """One lowered stage: its descriptor/plan plus the resolved dataflow
     (buffer-table indices and static element counts)."""
 
-    options: object  # CallOptions
-    plan: object  # Plan
+    options: Any  # CallOptions
+    plan: Any  # Plan
     in_idx: tuple[int, ...]
     res_idx: int
     in_elems: int
@@ -148,13 +148,18 @@ class SequencePlan:
     def lint(self, *, use_pallas_ring: bool = False,
              pallas_ring_overlap: bool = True, deep: bool = False,
              buffer_widths: dict[int, int] | None = None,
-             axis_name: str = "ccl", arith_table: dict | None = None):
+             axis_name: str = "ccl", arith_table: dict | None = None,
+             budget=None):
         """Run the static analyzer (accl_tpu/analysis/) over this plan's
         descriptor batch and return the diagnostic list — the same gate
         TPUDevice.start_sequence applies before compile_sequence, here
         callable on a standalone plan (corpus replay, tests). The flags
         mirror the ScheduleCompiler configuration the batch would lower
-        under, so the slot model matches the real launch."""
+        under, so the slot model matches the real launch. `deep=True`
+        (the `lint="deep"` tier) adds the per-step schedule
+        interpretation AND the exhaustive-interleaving model checker
+        (ACCL205-207); `budget` caps its exploration
+        (analysis.modelcheck.Budget)."""
         from ..analysis.linter import SequenceLinter
 
         linter = SequenceLinter(
@@ -164,6 +169,7 @@ class SequencePlan:
             deep=deep,
             axis_name=axis_name,
             arith_table=arith_table,
+            budget=budget,
         )
         return linter.lint(self.descriptor.steps,
                            [st.plan for st in self.steps],

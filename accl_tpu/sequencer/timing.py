@@ -464,7 +464,8 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
         nbytes = (1 << 24) + 1
     while nbytes <= (1 << 24):
         count = max(nbytes // elem_bytes, 1)
-        kw = dict(max_eager_size=max_eager, eager_rx_buf_size=rx_buf_bytes)
+        kw: dict = dict(max_eager_size=max_eager,
+                        eager_rx_buf_size=rx_buf_bytes)
         t_comp = predict(params, Operation.allreduce,
                          select_algorithm(Operation.allreduce, count,
                                           elem_bytes, P, tuning=force_comp,

@@ -252,6 +252,58 @@ def test_simulate_unmatched_and_cycle():
     assert "circular wait" in diags[0].message
 
 
+def test_simulate_buffered_drain_is_first_posted_fifo():
+    """Pins the canonical matching contract the deep checker's ACCL206
+    gate relies on: the buffered drain consumes the FIRST-POSTED
+    eligible send, even when a later-posted one fits the recv's count
+    better. The count mismatch is the tracer: FIFO pairs (8->9, 9->8)
+    and reports both; a best-fit or LIFO matcher would pair silently."""
+    from accl_tpu.constants import TAG_ANY
+
+    progs = [[send(1, tag=TAG_ANY, count=8), send(1, tag=TAG_ANY, count=9)],
+             [recv(0, tag=TAG_ANY, count=9), recv(0, tag=TAG_ANY, count=8)]]
+    diags = simulate(progs, blocking_sends=False)
+    assert [d.code for d in diags] == ["ACCL201", "ACCL201"]
+    assert "sends 8" in diags[0].message  # first-posted went first
+    # aligned counts in posting order: the same FIFO rule drains clean
+    progs = [[send(1, tag=TAG_ANY, count=9), send(1, tag=TAG_ANY, count=8)],
+             [recv(0, tag=TAG_ANY, count=9), recv(0, tag=TAG_ANY, count=8)]]
+    assert simulate(progs, blocking_sends=False) == []
+
+
+def test_simulate_notes_multi_eligible_sends():
+    """The cheap single-run precursor that routes batches into the deep
+    checker: a recv with MORE than one eligible candidate surfaces a
+    MatchNote; unambiguous batches surface none."""
+    from accl_tpu.analysis.protocol import MatchNote
+    from accl_tpu.constants import TAG_ANY
+
+    progs = [[recv(1, tag=TAG_ANY, count=8)],
+             [send(0, tag=1, count=8), send(0, tag=2, count=8)]]
+    notes: list = []
+    simulate(progs, blocking_sends=False, notes=notes)
+    assert notes == [MatchNote(0, 0, ("r1:send(tag 1)", "r1:send(tag 2)"))]
+    # a single eligible candidate is not ambiguity
+    notes = []
+    simulate([[recv(1, tag=TAG_ANY, count=8)], [send(0, tag=1, count=8)]],
+             blocking_sends=False, notes=notes)
+    assert notes == []
+
+
+def test_simulate_any_source_recv():
+    """ANY_SRC recvs match any sender: rank order under the buffered
+    canonical drain, head-to-head (with a note when ambiguous) under
+    rendezvous."""
+    from accl_tpu.analysis.protocol import ANY_SRC
+
+    progs = [[recv(ANY_SRC, tag=5, count=4), recv(ANY_SRC, tag=5, count=4)],
+             [send(0, tag=5, count=4)], [send(0, tag=5, count=4)]]
+    assert simulate(progs, blocking_sends=False) == []
+    notes: list = []
+    assert simulate(progs, blocking_sends=True, notes=notes) == []
+    assert notes and notes[0].rank == 0 and len(notes[0].candidates) == 2
+
+
 # ---------------------------------------------------------------------------
 # slot timeline
 # ---------------------------------------------------------------------------

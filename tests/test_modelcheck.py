@@ -1,0 +1,397 @@
+"""Exhaustive-interleaving model checker (analysis/modelcheck.py).
+
+Pins the deep lint tier's contract: ACCL205 wildcard races and ACCL206
+schedule-dependent deadlocks are found over ALL legal match orders
+(with the witness interleaving rendered), the reduced search agrees
+with brute-force enumeration on random tiny programs, exploration
+budgets truncate LOUDLY (ACCL207), the facade accepts `lint="deep"`
+with its own cache row, and — the reality check — the
+schedule-dependent-deadlock fixture actually wedges on the native
+emulator when the fault-injection delay lever forces the adverse
+ordering.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from accl_tpu import ReduceFunction, TAG_ANY
+from accl_tpu.analysis.modelcheck import (
+    Budget,
+    canonical_completes,
+    check_interleavings,
+    diagnose_programs,
+    statically_deterministic,
+)
+from accl_tpu.analysis.protocol import ANY_SRC, coll, recv, send, simulate
+
+CORPUS = pathlib.Path(__file__).parent.parent / "tools" / "lint_corpus"
+ANY = TAG_ANY
+
+
+def _deadlock_progs():
+    """The bad_schedule_dependent_deadlock.json programs: canonical FIFO
+    drain completes, the wildcard-takes-tag-2 interleaving wedges."""
+    return [
+        [recv(1, tag=ANY, count=8), recv(1, tag=2, count=8)],
+        [send(0, tag=1, count=8), send(0, tag=2, count=8)],
+    ]
+
+
+# ---------------------------------------------------------------------------
+# verdicts on the canonical examples
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_dependent_deadlock_found_with_witness():
+    progs = _deadlock_progs()
+    # the canonical single-run linter passes this batch ...
+    assert simulate(progs, blocking_sends=False) == []
+    assert canonical_completes(progs, blocking_sends=False)
+    # ... the checker does not
+    res = check_interleavings(progs, semantics="buffered")
+    assert res.canonical_complete and res.complete_reachable
+    assert res.stuck_trace is not None
+    diags = diagnose_programs(progs)
+    assert [d.code for d in diags] == ["ACCL206"]
+    # the witness interleaving rides the diagnostic, worked-example
+    # style: the wildcard's adverse match, then the stranded recv
+    msg = diags[0].message
+    assert "canonical schedule completes" in msg
+    assert "tag ANY) matched r1:send(tag 2" in msg
+    assert "stuck state" in msg and "r0:recv#1" in msg
+
+
+def test_wildcard_race_found_only_across_completing_runs():
+    # both orders complete, payloads swap -> ACCL205 on both recvs
+    progs = [
+        [recv(1, tag=ANY, count=8), recv(1, tag=ANY, count=8)],
+        [send(0, tag=1, count=8), send(0, tag=2, count=8)],
+    ]
+    codes = [d.code for d in diagnose_programs(progs)]
+    assert codes == ["ACCL205", "ACCL205"]
+    # the deadlock fixture is NOT also a race: its adverse matching
+    # never completes, and data a doomed interleaving would have
+    # delivered is not a result
+    assert [d.code for d in diagnose_programs(_deadlock_progs())] \
+        == ["ACCL206"]
+
+
+def test_source_pinned_wildcard_fanin_is_clean_and_skips_exploration():
+    progs = [
+        [recv(1, tag=ANY, count=8), recv(2, tag=ANY, count=8),
+         recv(3, tag=ANY, count=8)],
+        [send(0, tag=7, count=8)],
+        [send(0, tag=7, count=8)],
+        [send(0, tag=7, count=8)],
+    ]
+    assert diagnose_programs(progs) == []
+    # every endpoint is statically pinned: the router can certify the
+    # batch without exploring a single interleaving
+    assert statically_deterministic(progs)
+    assert not statically_deterministic(_deadlock_progs())
+
+
+def test_any_source_recv_explores_every_sender():
+    # one ANY_SRC recv, two eligible senders, second sender's payload
+    # must also reach SOME recv: whoever the wildcard takes, the exact
+    # recv wants rank 1 specifically -> one interleaving strands it
+    progs = [
+        [recv(ANY_SRC, tag=5, count=4), recv(1, tag=5, count=4)],
+        [send(0, tag=5, count=4)],
+        [send(0, tag=5, count=4)],
+    ]
+    res = check_interleavings(progs, semantics="buffered")
+    assert res.stuck_trace is not None
+    # canonically stuck too (wildcard takes rank 1 first in FIFO order,
+    # stranding the exact recv) -> the single-run linter already
+    # rejects it; no ACCL206 double report
+    assert not res.canonical_complete
+    assert "ACCL206" not in [d.code for d in diagnose_programs(progs)]
+
+
+def test_rendezvous_any_source_contention():
+    # under rendezvous an ANY_SRC recv head with two sender heads is
+    # the only branch point; one choice leaves the tagged recv of rank
+    # 1's payload stranded
+    progs = [
+        [recv(ANY_SRC, tag=ANY, count=4), recv(2, tag=ANY, count=4)],
+        [send(0, tag=1, count=4)],
+        [send(0, tag=2, count=4)],
+    ]
+    res = check_interleavings(progs, semantics="rendezvous")
+    assert res.canonical_complete  # canonical takes the lowest sender
+    assert res.stuck_trace is not None  # ANY <- r2 strands recv(2)
+    assert "ACCL206" in [d.code for d in diagnose_programs(progs)]
+
+
+def test_collectives_and_barriers_modelchecked():
+    # matching collectives release; a rank that finished early makes
+    # the barrier unreachable -> stuck in every interleaving AND
+    # canonically -> no ACCL206 (single-run territory)
+    good = [[coll("allreduce", 16)], [coll("allreduce", 16)]]
+    res = check_interleavings(good, semantics="buffered")
+    assert res.complete_reachable and res.stuck_trace is None
+    bad = [[coll("allreduce", 16)], []]
+    res = check_interleavings(bad, semantics="buffered")
+    assert res.stuck_trace is not None and not res.canonical_complete
+
+
+def test_budget_truncation_is_loud_never_silent():
+    # heavily contended program, absurdly small state budget
+    progs = [
+        [recv(1, tag=ANY, count=1)] * 4,
+        [send(0, tag=t, count=1) for t in range(4)],
+    ]
+    diags = diagnose_programs(progs, budget=Budget(max_states=3))
+    assert any(d.code == "ACCL207" for d in diags)
+    assert all(d.severity == "warning" for d in diags
+               if d.code == "ACCL207")
+    assert "UNVERIFIED" in [d for d in diags
+                            if d.code == "ACCL207"][0].message
+
+
+# ---------------------------------------------------------------------------
+# reduced search vs brute-force enumeration (the acceptance fuzz)
+# ---------------------------------------------------------------------------
+
+
+def _random_programs(rng):
+    """Random <=3-rank programs, <=6 events total: sends/recvs with
+    small tag alphabets (TAG_ANY weighted in), occasional ANY_SRC and
+    collectives — dense enough that races, deadlocks, and clean runs
+    all occur."""
+    world = int(rng.integers(2, 4))
+    n_events = int(rng.integers(2, 7))
+    progs = [[] for _ in range(world)]
+    for _ in range(n_events):
+        r = int(rng.integers(world))
+        kind = rng.choice(["send", "recv", "recv", "coll"],
+                          p=[0.45, 0.225, 0.225, 0.1])
+        tag = int(rng.choice([1, 2, ANY], p=[0.4, 0.3, 0.3]))
+        peer = int(rng.integers(world))
+        if kind == "send":
+            progs[r].append(send(peer, tag=tag, count=4))
+        elif kind == "recv":
+            if rng.random() < 0.2:
+                peer = ANY_SRC
+            progs[r].append(recv(peer, tag=tag, count=4))
+        else:
+            progs[r].append(coll("allreduce", count=4))
+    return progs
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_fuzz_reduced_agrees_with_brute_force(seed):
+    rng = np.random.default_rng(4200 + seed)
+    progs = _random_programs(rng)
+    for sem in ("buffered", "rendezvous"):
+        fast = check_interleavings(progs, semantics=sem, reduce=True)
+        slow = check_interleavings(progs, semantics=sem, reduce=False)
+        assert not fast.truncated and not slow.truncated
+        ctx = f"seed {seed} {sem} {progs}"
+        assert fast.complete_reachable == slow.complete_reachable, ctx
+        assert (fast.stuck_trace is None) == (slow.stuck_trace is None), ctx
+        assert fast.races == slow.races, ctx
+        # the reduction must never explore MORE states
+        assert fast.states <= slow.states, ctx
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_fuzz_checker_contains_the_canonical_schedule(seed):
+    """`simulate`'s canonical interleaving is one of the explored ones:
+    if it completes, completion is reachable; if it wedges, a stuck
+    state is reachable."""
+    rng = np.random.default_rng(7700 + seed)
+    progs = _random_programs(rng)
+    for sem, blocking in (("buffered", False), ("rendezvous", True)):
+        res = check_interleavings(progs, semantics=sem)
+        assert res.canonical_complete == canonical_completes(
+            progs, blocking_sends=blocking)
+        if res.canonical_complete:
+            assert res.complete_reachable, f"seed {seed} {sem} {progs}"
+        else:
+            assert res.stuck_trace is not None, f"seed {seed} {sem} {progs}"
+
+
+# ---------------------------------------------------------------------------
+# facade + plan wiring: the lint="deep" tier
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def accl4(mesh4):
+    from accl_tpu.accl import ACCL
+
+    return ACCL(mesh4)
+
+
+def test_sequence_accepts_deep_tier_and_caches_it_separately(accl4):
+    n = 16
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, n)).astype(np.float32)
+    a = accl4.create_buffer(n, data=x)
+    b = accl4.create_buffer(n)
+    with accl4.sequence(lint="deep") as s:
+        s.allreduce(a, b, n, ReduceFunction.SUM)
+        s.bcast(b, n, 0)
+    np.testing.assert_allclose(np.asarray(b.device)[0], x.sum(0),
+                               rtol=1e-5, atol=1e-5)
+    dev = accl4.cclo
+    # the deep row keys with deep=True; the default tier re-lints under
+    # its own key rather than inheriting deep diagnostics (or cost)
+    assert any(k[-1] is True for k in dev._lint_cache)
+    a2 = accl4.create_buffer(n, data=x)
+    b2 = accl4.create_buffer(n)
+    with accl4.sequence() as s:
+        s.allreduce(a2, b2, n, ReduceFunction.SUM)
+        s.bcast(b2, n, 0)
+    assert any(k[-1] is False for k in dev._lint_cache)
+
+
+def test_sequence_deep_mode_validated(accl4):
+    with pytest.raises(ValueError, match="lint must be"):
+        accl4.sequence(lint="deeper")
+    # "deep" itself is legal
+    accl4.sequence(lint="deep")
+
+
+def test_sequence_plan_lint_deep_runs_modelcheck():
+    from accl_tpu.constants import (
+        DEFAULT_EAGER_RX_BUF_SIZE,
+        DEFAULT_MAX_EAGER_SIZE,
+        DEFAULT_MAX_RENDEZVOUS_SIZE,
+        DataType,
+        Operation,
+        TuningParams,
+        dtype_nbytes,
+    )
+    from accl_tpu.descriptor import CallOptions, SequenceDescriptor
+    from accl_tpu.sequencer.plan import select_algorithm
+    from accl_tpu.sequencer.sequence import SequencePlan
+
+    steps = tuple(
+        CallOptions(scenario=op, count=16, root_src_dst=0,
+                    function=int(ReduceFunction.SUM),
+                    data_type=DataType.float32, addr_0=a0, addr_2=a2)
+        for op, a0, a2 in ((Operation.allreduce, 0x10, 0x20),
+                          (Operation.allgather, 0x20, 0x30)))
+    plans = [
+        select_algorithm(
+            o.scenario, o.count, dtype_nbytes(o.data_type), 4,
+            max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+            eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+            tuning=TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE))
+        for o in steps]
+    sp = SequencePlan(SequenceDescriptor(steps), plans, 4)
+    assert sp.lint(deep=True, budget=Budget(max_states=5000)) == []
+
+
+def test_lint_sequence_mode_deep():
+    from accl_tpu.analysis import lint_sequence
+    from accl_tpu.constants import DataType, Operation
+    from accl_tpu.descriptor import CallOptions
+
+    steps = [CallOptions(scenario=Operation.copy, count=16,
+                         data_type=DataType.float32, addr_0=1, addr_2=2)]
+    assert lint_sequence(steps, 4, mode="deep") == []
+    with pytest.raises(ValueError, match="lint mode"):
+        lint_sequence(steps, 4, mode="bogus")
+
+
+# ---------------------------------------------------------------------------
+# cross-validation against reality: the native emulator wedges
+# ---------------------------------------------------------------------------
+
+
+def _fixture_counts():
+    fx = json.loads(
+        (CORPUS / "bad_schedule_dependent_deadlock.json").read_text())
+    progs = fx["programs"]
+    assert progs[0][0]["tag"] == TAG_ANY  # the wildcard recv
+    return fx
+
+
+def test_schedule_dependent_deadlock_wedges_on_native_emulator(monkeypatch):
+    """The checker's ACCL206 verdict on bad_schedule_dependent_deadlock
+    is not just self-consistent: the SAME per-rank chains complete on
+    the native emulator under benign timing (the canonical schedule)
+    and WEDGE — bounded RECEIVE_TIMEOUT, not a hang — when the
+    ACCL_RT_FAULT_DELAY_TAIL_MS lever forces the adverse ordering.
+
+    Correspondence note: the emulator's links are seqn-ordered, so the
+    literal adverse MATCHING (wildcard takes the tag-2 message) is
+    unreachable there; the lever instead realizes the adverse SCHEDULE
+    in which the wildcard recv's committed match never completes
+    inside its deadline. Both are executions of the same batch that
+    reach a stuck state the canonical run says cannot exist — exactly
+    the schedule-dependence ACCL206 asserts."""
+    from accl_tpu import ACCLError, CallOptions
+    from accl_tpu.constants import CfgFunc, Operation, from_numpy_dtype
+    from accl_tpu.device.emu_device import EmuWorld
+
+    _fixture_counts()  # the fixture still has the replayed shape
+    count = 192  # 3 wire segments at rx_buf=256: a multi-segment M1
+    f32 = from_numpy_dtype(np.dtype(np.float32))
+    rng = np.random.default_rng(99)
+    m1 = rng.standard_normal(count).astype(np.float32)
+    m2 = rng.standard_normal(count).astype(np.float32)
+
+    def run_world(adverse: bool):
+        if adverse:
+            monkeypatch.setenv("ACCL_RT_FAULT_DELAY_TAIL_MS", "800")
+        else:
+            monkeypatch.delenv("ACCL_RT_FAULT_DELAY_TAIL_MS",
+                               raising=False)
+        w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=256)
+        try:
+            def body(rank, i):
+                import time
+
+                if i == 1:  # the fixture's rank 1: send tag 1, then 2
+                    rank.send(m1.copy(), count, dst=0, tag=1)
+                    if adverse:  # delayed tail must land before M2
+                        time.sleep(1.2)  # (wire-order precondition)
+                    rank.send(m2.copy(), count, dst=0, tag=2)
+                    return None
+                # the fixture's rank 0: wildcard recv, then tag-2 recv
+                rank.call(CallOptions(scenario=Operation.config,
+                                      function=int(CfgFunc.set_timeout),
+                                      count=300 if adverse else 5000))
+                out_any = np.zeros(count, np.float32)
+                h = rank.start(
+                    CallOptions(scenario=Operation.recv, count=count,
+                                root_src_dst=1, tag=TAG_ANY,
+                                data_type=f32), res=out_any)
+                wedged = False
+                try:
+                    rank.wait(h)
+                except ACCLError as e:
+                    assert "RECEIVE_TIMEOUT" in str(e)
+                    wedged = True
+                rank.call(CallOptions(scenario=Operation.config,
+                                      function=int(CfgFunc.set_timeout),
+                                      count=5000))
+                out_t2 = np.zeros(count, np.float32)
+                rank.recv(out_t2, count, src=1, tag=2)
+                return wedged, out_any, out_t2
+            return w.run(body)
+        finally:
+            w.close()
+
+    # benign timing: the canonical schedule completes with the
+    # canonical dataflow (wildcard <- first-posted tag-1 send)
+    wedged, out_any, out_t2 = run_world(adverse=False)[0]
+    assert not wedged
+    np.testing.assert_allclose(out_any, m1, rtol=0)
+    np.testing.assert_allclose(out_t2, m2, rtol=0)
+
+    # adverse timing: the wildcard recv's match never completes in
+    # deadline — the chain wedges with a BOUNDED timeout, while the
+    # tag-2 message remains deliverable (the stranded-event shape of
+    # the checker's witness)
+    wedged, _, out_t2 = run_world(adverse=True)[0]
+    assert wedged
+    np.testing.assert_allclose(out_t2, m2, rtol=0)

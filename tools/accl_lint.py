@@ -11,10 +11,18 @@ Three modes, combinable:
   --schedules      abstractly interpret every shipping schedule family
                    in sequencer/schedules.py (both protocol regimes,
                    several worlds/roots) and require zero diagnostics
+  --deep           force the deep tier everywhere: fixtures run the
+                   exhaustive-interleaving model checker (ACCL205-207)
+                   even without "deep": true, and --schedules
+                   model-checks every config's hop programs over all
+                   match orders (budgeted; truncation fails the gate)
+  --sample N       deterministically subsample the --schedules sweep
+                   to ~N configs (the CI slice for the deep tier)
   FILE...          lint individual fixture files
 
 Exit status is 0 only when every expectation holds — the CI lint job
-runs `accl_lint.py --corpus --schedules` as a gate.
+runs `accl_lint.py --corpus --schedules` (default tier) and
+`accl_lint.py --deep --corpus --schedules --sample N` as gates.
 
 Fixture schema (JSON):
   kind "sequence":       "steps" (descriptor dicts: op/count/dtype/
@@ -24,7 +32,10 @@ Fixture schema (JSON):
                          "use_pallas_ring", "overlap", "buffer_widths"
   kind "rank_programs":  "programs": per-rank event lists
                          ({kind: send|recv|coll, peer, tag, count,
-                         comm, op}), optional "blocking_sends"
+                         comm, op} — peer "any" is the any-source
+                         wildcard), optional "blocking_sends", "deep"
+                         (run the interleaving checker over the
+                         programs), "budget_states"
   kind "slots":          "num_slots", "instances" [[step, seg, slot]],
                          "deps" [[from, to]]
   all kinds:             "expect": diagnostic codes that MUST surface
@@ -61,7 +72,14 @@ from accl_tpu.analysis import (  # noqa: E402
     check_slots,
     simulate,
 )
-from accl_tpu.analysis.protocol import Event, interpret_schedule  # noqa: E402
+from accl_tpu.analysis.modelcheck import Budget  # noqa: E402
+from accl_tpu.analysis.protocol import (  # noqa: E402
+    ANY_SRC,
+    Event,
+    check_hops,
+    rank_programs_from_hops,
+    trace_schedule_hops,
+)
 from accl_tpu.analysis.slots import SlotInstance, SlotTimeline  # noqa: E402
 from accl_tpu.sequencer.plan import select_algorithm  # noqa: E402
 
@@ -113,8 +131,16 @@ def _default_plan(opts: CallOptions, world: int):
     )
 
 
-def lint_fixture(fx: dict) -> list:
-    """Run one fixture through the analyzer; returns Diagnostics."""
+def _fixture_budget(fx: dict) -> Budget:
+    if "budget_states" in fx:
+        return Budget(max_states=int(fx["budget_states"]))
+    return Budget()
+
+
+def lint_fixture(fx: dict, deep: bool = False) -> list:
+    """Run one fixture through the analyzer; returns Diagnostics.
+    `deep=True` (the CLI's --deep) forces the exhaustive-interleaving
+    tier even for fixtures that don't opt in with `"deep": true`."""
     kind = fx.get("kind", "sequence")
     world = int(fx.get("world", 4))
     if kind == "sequence":
@@ -131,20 +157,33 @@ def lint_fixture(fx: dict) -> list:
             world,
             use_pallas_ring=bool(fx.get("use_pallas_ring", False)),
             pallas_ring_overlap=bool(fx.get("overlap", True)),
-            deep=bool(fx.get("deep", False)),
+            deep=deep or bool(fx.get("deep", False)),
+            budget=_fixture_budget(fx),
         )
         plans = [_default_plan(o, world) for o in steps]
         return linter.lint(steps, plans, buffer_widths=widths)
     if kind == "rank_programs":
+        def peer_of(e: dict) -> int:
+            p = e.get("peer", -1)
+            return ANY_SRC if p in ("any", "ANY") else int(p)
+
         programs = [
-            [Event(e["kind"], int(e.get("peer", -1)),
+            [Event(e["kind"], peer_of(e),
                    int(e.get("tag", TAG_ANY)), int(e.get("count", 0)),
                    int(e.get("comm", 0)), e.get("op", ""))
              for e in prog]
             for prog in fx["programs"]
         ]
-        return simulate(programs,
-                        blocking_sends=bool(fx.get("blocking_sends", True)))
+        diags = simulate(programs,
+                         blocking_sends=bool(fx.get("blocking_sends",
+                                                    True)))
+        if (deep or fx.get("deep", False)) and not diags:
+            # deep tier: certify the chains over EVERY legal match
+            # order, not just the canonical schedule simulate ran
+            diags = SequenceLinter(
+                world,
+                budget=_fixture_budget(fx)).check_interleavings(programs)
+        return diags
     if kind == "slots":
         timeline = SlotTimeline(
             int(fx["num_slots"]),
@@ -155,9 +194,10 @@ def lint_fixture(fx: dict) -> list:
     raise ValueError(f"unknown fixture kind {kind!r}")
 
 
-def run_fixture_file(path: pathlib.Path) -> tuple[bool, str]:
+def run_fixture_file(path: pathlib.Path,
+                     deep: bool = False) -> tuple[bool, str]:
     fx = json.loads(path.read_text())
-    diags = lint_fixture(fx)
+    diags = lint_fixture(fx, deep=deep)
     got = [d.code for d in diags]
     expect = fx.get("expect", [])
     if expect:
@@ -172,7 +212,7 @@ def run_fixture_file(path: pathlib.Path) -> tuple[bool, str]:
     return ok, f"{path.name:40s} {verdict}{detail}"
 
 
-def run_corpus(corpus_dir: pathlib.Path) -> bool:
+def run_corpus(corpus_dir: pathlib.Path, deep: bool = False) -> bool:
     files = sorted(corpus_dir.glob("*.json"))
     if not files:
         print(f"no fixtures under {corpus_dir}", file=sys.stderr)
@@ -181,7 +221,7 @@ def run_corpus(corpus_dir: pathlib.Path) -> bool:
     n_bad = n_good = 0
     for path in files:
         try:
-            ok, line = run_fixture_file(path)
+            ok, line = run_fixture_file(path, deep=deep)
         except Exception as e:  # a crashing fixture is a failing fixture
             ok, line = False, f"{path.name:40s} ERROR {type(e).__name__}: {e}"
         ok_all &= ok
@@ -194,9 +234,17 @@ def run_corpus(corpus_dir: pathlib.Path) -> bool:
     return ok_all
 
 
-def run_schedules() -> bool:
+def run_schedules(deep: bool = False, sample: int = 0) -> bool:
     """Interpret every shipping schedule family per rank and require it
-    clean — the conformance half of the acceptance gate."""
+    clean — the conformance half of the acceptance gate. `deep=True`
+    additionally model-checks each config's hop programs over every
+    legal match order (ACCL205-207; a truncated exploration FAILS the
+    gate — the sweep must complete within budget, never silently
+    partial). `sample=N` keeps a deterministic ~N-config slice (CI's
+    deep tier)."""
+    import time as _time
+
+    t0 = _time.monotonic()
     ok = True
     rooted = (Operation.bcast, Operation.scatter, Operation.gather,
               Operation.reduce)
@@ -216,7 +264,7 @@ def run_schedules() -> bool:
              Operation.reduce, Operation.allgather, Operation.allreduce,
              Operation.reduce_scatter, Operation.alltoall,
              Operation.barrier, Operation.send)
-    n = 0
+    configs = []
     for world in (2, 4, 8):
         for scen in scens:
             roots = range(world) if scen in rooted else (0,)
@@ -225,29 +273,56 @@ def run_schedules() -> bool:
                     for tname, tuning in tunings.items():
                         if scen == Operation.barrier and count != 16:
                             continue
-                        rsd = root if scen != Operation.send \
-                            else 0 | ((world - 1) << 16)
-                        opts = CallOptions(
-                            scenario=scen, count=count, root_src_dst=rsd,
-                            function=int(ReduceFunction.SUM),
-                            data_type=DataType.float32)
-                        plan = select_algorithm(
-                            scen, count, 4, world,
-                            max_eager_size=DEFAULT_MAX_EAGER_SIZE,
-                            eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
-                            tuning=tuning)
-                        diags = interpret_schedule(opts, plan, world)
-                        n += 1
-                        if diags:
-                            ok = False
-                            print(f" FAIL {scen.name} world={world} "
-                                  f"root={root} count={count} "
-                                  f"tuning={tname} "
-                                  f"{plan.algorithm.name}: "
-                                  f"{[str(d) for d in diags]}")
+                        configs.append((world, scen, root, count,
+                                        tname, tuning))
+    if sample and sample < len(configs):
+        # deterministic slice: every ceil(total/sample)-th config, so
+        # the CI subset is stable across runs and spans all families
+        stride = -(-len(configs) // sample)
+        configs = configs[::stride]
+    n = 0
+    budget = Budget()
+    for world, scen, root, count, tname, tuning in configs:
+        rsd = root if scen != Operation.send \
+            else 0 | ((world - 1) << 16)
+        opts = CallOptions(
+            scenario=scen, count=count, root_src_dst=rsd,
+            function=int(ReduceFunction.SUM),
+            data_type=DataType.float32)
+        plan = select_algorithm(
+            scen, count, 4, world,
+            max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+            eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+            tuning=tuning)
+        # trace each schedule body ONCE (the dominant cost): the hops
+        # feed the per-config interpretation AND, under --deep, the
+        # exhaustive-interleaving checker
+        hops = trace_schedule_hops(opts, plan, world)
+        diags = check_hops(hops, world)
+        if not diags:
+            programs = rank_programs_from_hops(hops, world)
+            diags = simulate(programs, blocking_sends=False)
+            if deep and not diags:
+                diags = SequenceLinter(
+                    world, budget=budget).check_interleavings(programs)
+                # ANY truncation fails the deep gate: a partial sweep
+                # must never read as a clean one
+                if any(d.code == "ACCL207" for d in diags):
+                    ok = False
+        n += 1
+        if diags:
+            ok = False
+            print(f" FAIL {scen.name} world={world} "
+                  f"root={root} count={count} "
+                  f"tuning={tname} "
+                  f"{plan.algorithm.name}: "
+                  f"{[str(d) for d in diags]}")
+    dt = _time.monotonic() - t0
     print(f"schedules: {n} (scenario, world, root, size, tuning) "
-          f"configurations interpreted "
-          + ("clean" if ok else "WITH DEFECTS"))
+          f"configurations interpreted"
+          + (" + model-checked" if deep else "") + " "
+          + ("clean" if ok else "WITH DEFECTS")
+          + f" in {dt:.1f}s")
     return ok
 
 
@@ -260,17 +335,23 @@ def main(argv=None) -> int:
     ap.add_argument("--schedules", action="store_true",
                     help="interpret every shipping schedule and require "
                          "it clean")
+    ap.add_argument("--deep", action="store_true",
+                    help="force the exhaustive-interleaving tier on "
+                         "fixtures and --schedules (ACCL205-207)")
+    ap.add_argument("--sample", type=int, default=0, metavar="N",
+                    help="deterministically subsample --schedules to "
+                         "~N configurations")
     ap.add_argument("files", nargs="*", help="individual fixture files")
     args = ap.parse_args(argv)
     if not (args.corpus or args.schedules or args.files):
         ap.error("nothing to do: pass --corpus, --schedules, or files")
     ok = True
     if args.corpus:
-        ok &= run_corpus(pathlib.Path(args.corpus))
+        ok &= run_corpus(pathlib.Path(args.corpus), deep=args.deep)
     if args.schedules:
-        ok &= run_schedules()
+        ok &= run_schedules(deep=args.deep, sample=args.sample)
     for f in args.files:
-        fok, line = run_fixture_file(pathlib.Path(f))
+        fok, line = run_fixture_file(pathlib.Path(f), deep=args.deep)
         ok &= fok
         print(("  ok  " if fok else " FAIL ") + line)
     return 0 if ok else 1
