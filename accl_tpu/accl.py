@@ -47,6 +47,7 @@ from .errors import (
 )
 from .device.tpu_device import TPUDevice
 from .request import BaseRequest
+from .telemetry import get_tracer
 from .utils.logging import Log
 
 
@@ -315,12 +316,29 @@ class ACCL:
         to_device: bool,
         run_async: bool,
     ):
-        self._stage_in(sync_in, from_device)
-        Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
-                  opts.count, int(opts.compression_flags),
-                  int(opts.stream_flags))
-        req = self.cclo.start(opts)
-        return self._complete(req, sync_out, to_device, run_async)
+        # tracer.span is the shared no-op when telemetry is off (one
+        # predicate; the bench smoke path gates the disabled cost <1%)
+        with get_tracer().span(opts.scenario.name, cat="call",
+                               track="facade") as sp:
+            self._stage_in(sync_in, from_device)
+            Log.debug("call %s count=%d flags=c%x/s%x", opts.scenario.name,
+                      opts.count, int(opts.compression_flags),
+                      int(opts.stream_flags))
+            req = self.cclo.start(opts)
+            ret = self._complete(req, sync_out, to_device, run_async)
+            if get_tracer().enabled:  # attach what the device resolved
+                sp.set(op=opts.scenario.name, count=opts.count,
+                       retcode=req.retcode)
+                if run_async:
+                    sp.set(dispatch_only=True)
+                plan = getattr(req, "plan", None)
+                if plan is not None:
+                    sp.set(algorithm=plan.algorithm.name,
+                           protocol=plan.protocol.name)
+                pred = getattr(req, "predicted_s", None)
+                if pred is not None:
+                    sp.set(predicted_s=pred)
+            return ret
 
     def wait(self, req: BaseRequest):
         """Complete an async request (sync-out deferred at start time)."""
@@ -970,8 +988,22 @@ class SequenceRecorder:
         self._ran = True
         accl = self._accl
         sync_in, sync_out = self._sync_sets()
-        accl._stage_in(sync_in, from_device)
-        Log.debug("sequence of %d: %s", len(self.calls),
-                  "+".join(o.scenario.name for o in self.calls))
-        req = accl.cclo.start_sequence(self.calls, lint=self._lint)
-        return accl._complete(req, sync_out, to_device, run_async)
+        with get_tracer().span("sequence", cat="sequence",
+                               track="facade") as sp:
+            accl._stage_in(sync_in, from_device)
+            Log.debug("sequence of %d: %s", len(self.calls),
+                      "+".join(o.scenario.name for o in self.calls))
+            req = accl.cclo.start_sequence(self.calls, lint=self._lint)
+            ret = accl._complete(req, sync_out, to_device, run_async)
+            if get_tracer().enabled:
+                sp.set(n_steps=len(self.calls),
+                       ops="+".join(o.scenario.name for o in self.calls))
+                if run_async:
+                    sp.set(dispatch_only=True)
+                sig = getattr(req, "signature", None)
+                if sig is not None:
+                    sp.set(signature=sig)
+                pred = getattr(req, "predicted_s", None)
+                if pred is not None:
+                    sp.set(predicted_s=pred)
+            return ret

@@ -290,6 +290,34 @@ LOGP_ALLGATHER_HOP_BYTES = 128 * 1024
 # (runtime.cpp egr_send seg_bytes at its ring-collective call sites)
 STREAM_SEG_BYTES = 1 << 20
 
+
+def log2_floor(world: int) -> int:
+    """floor(log2(world)) by bit scan — the exact arithmetic of the
+    native executor's log2_floor (runtime.cpp), so the crossover rules
+    below can never diverge from it by a rounding convention."""
+    r = 0
+    while (1 << (r + 1)) <= world:
+        r += 1
+    return r
+
+
+def logp_allreduce_max_bytes(world: int) -> int:
+    """Mirror of runtime.cpp logp_max_bytes: the payload ceiling (bytes)
+    under which a power-of-two world runs the recursive halving-doubling
+    allreduce instead of the ring. SINGLE SOURCE for the crossover shape:
+    timing._logp_allreduce and the native rule both read this arithmetic
+    (ring 2(P-1) hops vs halving-doubling 2*log2(P))."""
+    hops_saved = 2 * (world - 1) - 2 * log2_floor(world)
+    return hops_saved * LOGP_ALLREDUCE_HOP_BYTES
+
+
+def logp_allgather_max_bytes(world: int) -> int:
+    """Mirror of runtime.cpp logp_ag_max_bytes: recursive-doubling
+    threshold against the TOTAL gathered payload (ring P-1 hops vs
+    doubling log2(P))."""
+    hops_saved = (world - 1) - log2_floor(world)
+    return hops_saved * LOGP_ALLGATHER_HOP_BYTES
+
 # ---------------------------------------------------------------------------
 # Blockwise int8 wire quantization (the EQuARX-style compression lanes,
 # arxiv 2506.17615): payloads cross each hop as int8 blocks with one fp32

@@ -38,6 +38,25 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+class NativeSpan(ctypes.Structure):
+    """ctypes mirror of accl_rt_span_t (native/include/acclrt.h): one
+    record of the device-resident trace ring per completed call."""
+
+    _fields_ = [
+        ("opcode", ctypes.c_uint32),
+        ("retcode", ctypes.c_uint32),
+        ("detail", ctypes.c_uint32),
+        ("count", ctypes.c_uint32),
+        ("bytes", ctypes.c_uint64),
+        ("start_ns", ctypes.c_uint64),
+        ("end_ns", ctypes.c_uint64),
+        ("d_passes", ctypes.c_uint64),
+        ("d_parks", ctypes.c_uint64),
+        ("d_seek_hit", ctypes.c_uint64),
+        ("d_seek_miss", ctypes.c_uint64),
+    ]
+
+
 def load_native():
     """Load (building if needed) the native runtime library.
 
@@ -97,6 +116,11 @@ def load_native():
         lib.accl_rt_dump_rxbufs.restype = ctypes.c_size_t
         lib.accl_rt_dump_rxbufs.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                             ctypes.c_size_t]
+        lib.accl_rt_trace_read.restype = ctypes.c_size_t
+        lib.accl_rt_trace_read.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(NativeSpan), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         _lib = lib
         return lib
 
@@ -193,6 +217,43 @@ class EmuRank:
         self._lib.accl_rt_get_stats(self._rt, buf)
         return {"passes": buf[0], "parks": buf[1], "park_ns": buf[2],
                 "seek_hit": buf[3], "seek_miss": buf[4]}
+
+    def trace_read(self, chunk: int = 4096) -> tuple[list[dict], int]:
+        """Drain this rank's device-resident trace ring (ACCL_RT_TRACE=1;
+        accl_rt_trace_read): returns (spans, dropped) where each span is
+        a dict in the telemetry subsystem's native-span shape — opcode,
+        count, payload bytes, start/end ns since runtime creation, the
+        sticky retcode, the deferred-mismatch fault detail behind a
+        RECEIVE_TIMEOUT, and the per-call sequencer-counter deltas.
+        Loops until the ring is empty (a raised ACCL_RT_TRACE_CAP must
+        not silently truncate at one chunk). `dropped` is the cumulative
+        count of spans the ring overflowed (oldest first). Empty when
+        tracing is disabled."""
+        spans: list[dict] = []
+        dropped = ctypes.c_uint64(0)
+        while True:
+            buf = (NativeSpan * chunk)()
+            n = self._lib.accl_rt_trace_read(self._rt, buf, chunk,
+                                             ctypes.byref(dropped))
+            spans.extend(
+                {
+                    "opcode": s.opcode,
+                    "retcode": s.retcode,
+                    "detail": s.detail,
+                    "count": s.count,
+                    "bytes": s.bytes,
+                    "start_ns": s.start_ns,
+                    "end_ns": s.end_ns,
+                    "d_passes": s.d_passes,
+                    "d_parks": s.d_parks,
+                    "d_seek_hit": s.d_seek_hit,
+                    "d_seek_miss": s.d_seek_miss,
+                    "rank": self.rank,
+                }
+                for s in buf[:n]
+            )
+            if n < chunk:
+                return spans, int(dropped.value)
 
     def dump_eager_rx_buffers(self) -> str:
         """Slot-by-slot rx-ring snapshot from the native runtime

@@ -13,6 +13,7 @@ executes the collective for every rank in the communicator.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 import jax
@@ -32,6 +33,7 @@ from ..descriptor import CallOptions
 from ..request import BaseRequest, ParkedRecvRequest, TPURequest
 from ..sequencer.lowering import ScheduleCompiler
 from ..sequencer.plan import select_algorithm
+from ..telemetry import get_tracer
 from .base import CCLOAddr, CCLODevice
 
 
@@ -340,7 +342,32 @@ class TPUDevice(CCLODevice):
 
         req = TPURequest(options.scenario.name, [out], on_complete=place)
         req.plan = plan
+        if get_tracer().enabled:
+            # the facade span drains this: every traced call carries its
+            # timing.predict estimate next to the measured duration
+            req.predicted_s = self._predict_call(options, plan, ctx.world)
         return req
+
+    def _predict_call(self, options: CallOptions, plan,
+                      world: int) -> float | None:
+        """timing.predict estimate for one resolved call under the
+        shipped default link (telemetry.feedback.default_link, the same
+        calibration autotune consults); None when no timing model is
+        committed or the plan has no cost shape. Uses the aggregate
+        cost shape — the regime the shipped emulator fit calibrates."""
+        from ..sequencer.timing import predict
+        from ..telemetry.feedback import default_link
+
+        link = default_link()
+        if link is None or plan is None:
+            return None
+        try:
+            return predict(link, options.scenario, plan, options.count,
+                           dtype_nbytes(options.data_type), world,
+                           rx_buf_bytes=self.eager_rx_buf_size,
+                           aggregate=True)
+        except (ValueError, KeyError, ZeroDivisionError):
+            return None
 
     # -- call sequences (device-resident descriptor batches) ---------------
 
@@ -367,38 +394,61 @@ class TPUDevice(CCLODevice):
 
         desc = SequenceDescriptor(tuple(options_list))
         ctx = self._comm_ctx(desc.comm_addr)
-        tuning = self.tuning()  # read the registers once for the batch
-        plans = []
-        endpoints = []
-        for opts in desc.steps:
-            plan, producer, consumer = self._resolve_step(opts, ctx, tuning)
-            plans.append(plan)
-            endpoints.append((producer, consumer))
+        tracer = get_tracer()
+        # the composite signature tags every phase/step span, so one
+        # batch's record -> lint -> compile -> dispatch pipeline can be
+        # followed across tracks in the exported trace. A content digest,
+        # not hash(): enum hashes are PYTHONHASHSEED-salted, and the
+        # signature must match across runs so archived traces correlate.
+        if tracer.enabled:
+            import hashlib
+
+            sig = hashlib.sha256(
+                repr(desc.signature()).encode()).hexdigest()[:16]
+        else:
+            sig = None
+        with tracer.span("record", cat="phase", track="device") as sp:
+            sp.set(signature=sig, n_steps=len(desc.steps))
+            tuning = self.tuning()  # read the registers once for the batch
+            plans = []
+            endpoints = []
+            for opts in desc.steps:
+                plan, producer, consumer = self._resolve_step(opts, ctx,
+                                                              tuning)
+                plans.append(plan)
+                endpoints.append((producer, consumer))
 
         if lint != "off":
-            self._lint_batch(desc, tuple(plans), ctx, lint)
+            with tracer.span("lint", cat="phase", track="device") as sp:
+                sp.set(signature=sig, tier=lint)
+                self._lint_batch(desc, tuple(plans), ctx, lint)
 
-        seq = SequencePlan(desc, plans, ctx.world, endpoints)
-        bufs = {addr: self._buf(addr) for addr in seq.buffer_addrs}
-        for addr, need in seq.min_widths().items():
-            have = bufs[addr].shape[-1]
-            if have < need:
-                raise ValueError(
-                    f"sequence needs {need} elements in buffer "
-                    f"{addr:#x}, which holds {have}")
-        fn = ctx.compiler.compile_sequence(seq)
+        with tracer.span("compile", cat="phase", track="device") as sp:
+            sp.set(signature=sig)
+            seq = SequencePlan(desc, plans, ctx.world, endpoints)
+            bufs = {addr: self._buf(addr) for addr in seq.buffer_addrs}
+            for addr, need in seq.min_widths().items():
+                have = bufs[addr].shape[-1]
+                if have < need:
+                    raise ValueError(
+                        f"sequence needs {need} elements in buffer "
+                        f"{addr:#x}, which holds {have}")
+            fn = ctx.compiler.compile_sequence(seq)
 
-        args = []
-        for addr in seq.buffer_addrs:
-            buf = bufs[addr]
-            if buf.device is None:  # host-only buffer not yet staged
-                buf.sync_to_device()
-            arr = buf.device
-            if ctx.rows is None:
-                args.append(arr)
-            else:
-                args.append(self._rows_to_submesh(arr, ctx, arr.shape[-1]))
-        outs = fn(*args)
+        with tracer.span("dispatch", cat="phase", track="device") as sp:
+            sp.set(signature=sig)
+            args = []
+            for addr in seq.buffer_addrs:
+                buf = bufs[addr]
+                if buf.device is None:  # host-only buffer not yet staged
+                    buf.sync_to_device()
+                arr = buf.device
+                if ctx.rows is None:
+                    args.append(arr)
+                else:
+                    args.append(self._rows_to_submesh(arr, ctx,
+                                                      arr.shape[-1]))
+            outs = fn(*args)
 
         out_bufs = [bufs[a] for a in seq.out_addrs]
 
@@ -412,6 +462,30 @@ class TPUDevice(CCLODevice):
                     buf.device = self._scatter_rows(buf.device, ctx, out)
 
         req = SequenceRequest(list(outs), plans, on_complete=place)
+        if tracer.enabled:
+            # per-step marker spans: the fused program executes the steps
+            # inside ONE dispatch, so each step carries its timing.predict
+            # estimate (and the batch signature) rather than a host-
+            # measured duration — instants, not intervals, honestly
+            req.signature = sig
+            preds = [self._predict_call(o, p, ctx.world)
+                     for o, p in zip(desc.steps, plans)]
+            known = [p for p in preds if p is not None]
+            req.predicted_s = sum(known) if known else None
+            now = time.perf_counter_ns()
+            for i, (o, p, pred) in enumerate(zip(desc.steps, plans, preds)):
+                step_args = {
+                    "op": o.scenario.name,
+                    "count": o.count,
+                    "step": i,
+                    "algorithm": p.algorithm.name,
+                    "protocol": p.protocol.name,
+                    "signature": sig,
+                }
+                if pred is not None:
+                    step_args["predicted_s"] = pred
+                tracer.emit(f"step{i}:{o.scenario.name}", "step", "device",
+                            ts_ns=now, dur_ns=0, args=step_args)
         return req
 
     def _lint_batch(self, desc, plans, ctx, mode: str) -> None:

@@ -32,13 +32,13 @@ import math
 
 from ..constants import (
     DataType,
-    LOGP_ALLGATHER_HOP_BYTES,
-    LOGP_ALLREDUCE_HOP_BYTES,
     Operation,
     QUANT_BLOCK_ELEMS,
     QUANT_SCALE_BYTES,
     STREAM_SEG_BYTES,
     dtype_nbytes,
+    logp_allgather_max_bytes,
+    logp_allreduce_max_bytes,
 )
 from .plan import Algorithm, Plan, Protocol
 
@@ -86,20 +86,32 @@ _STREAM_SEG = STREAM_SEG_BYTES
 def _logp_allreduce(world: int, nbytes: int) -> bool:
     """Mirror of the native hop-shape auto rule (runtime.cpp
     logp_max_bytes): power-of-two worlds run recursive halving-doubling
-    while the payload is under the crossover bytes per hop saved."""
+    while the payload is under the crossover bytes per hop saved. The
+    crossover arithmetic lives in constants.logp_allreduce_max_bytes —
+    the single source pinned against runtime.cpp — so a retune cannot
+    desynchronize this model from the executor it predicts."""
     if world & (world - 1):
         return False
-    r = int(math.log2(world))
-    return nbytes <= (2 * (world - 1) - 2 * r) * LOGP_ALLREDUCE_HOP_BYTES
+    return nbytes <= logp_allreduce_max_bytes(world)
 
 
 def _logp_allgather(world: int, total_bytes: int) -> bool:
     """Native logp_ag_max_bytes rule: recursive doubling for small total
-    payloads on power-of-two worlds."""
+    payloads on power-of-two worlds (crossover single-sourced in
+    constants.logp_allgather_max_bytes, like _logp_allreduce)."""
     if world & (world - 1):
         return False
-    r = int(math.log2(world))
-    return total_bytes <= ((world - 1) - r) * LOGP_ALLGATHER_HOP_BYTES
+    return total_bytes <= logp_allgather_max_bytes(world)
+
+
+def _logp_forced(world: int, auto: bool, logp_shape: bool | None) -> bool:
+    """Resolve the logp-vs-ring hop shape: the auto crossover rule by
+    default, or the caller's override mirroring the native executor's
+    ACCL_RT_SHAPE forcing (which, like the native rule, still requires
+    a power-of-two world)."""
+    if logp_shape is None:
+        return auto
+    return logp_shape and not (world & (world - 1))
 
 
 def coefficients(
@@ -110,6 +122,7 @@ def coefficients(
     world: int,
     *,
     rx_buf_bytes: int,
+    logp_shape: bool | None = None,
 ) -> tuple[float, float]:
     """(messages, bytes) on the CRITICAL PATH of the planned schedule —
     the busiest serialized sequence of hops, mirroring the structures in
@@ -117,7 +130,10 @@ def coefficients(
     (address notification + one-sided write). Bytes are WIRE bytes: a
     plan with an active wire_dtype charges the compressed element width
     (+ scale side-channel for the quantized lanes), and its segment
-    counts follow the compressed payload too."""
+    counts follow the compressed payload too. `logp_shape` overrides the
+    allreduce/allgather logp-vs-ring auto rule (True/False = the native
+    ACCL_RT_SHAPE=logp/ring forcing; None = auto) so forced-shape sweep
+    rows are costed on the schedule that actually ran."""
     n = count * wire_elem_bytes(elem_bytes, plan.wire_dtype)
     P = world
     if P <= 1 or plan.algorithm == Algorithm.NONE:
@@ -136,13 +152,14 @@ def coefficients(
         return (P - 1) * _segs(n, rx_buf_bytes), (P - 1) * n
     if alg == Algorithm.EAGER_RING:
         # daisy chain: P-1 sequential whole-payload streamed hops
-        if op == Operation.allgather and _logp_allgather(P, P * n):
+        if op == Operation.allgather and \
+                _logp_forced(P, _logp_allgather(P, P * n), logp_shape):
             # native recursive doubling: log2(P) steps, same volume
             return math.log2(P), (P - 1) * n
         return (P - 1) * _segs(n, _STREAM_SEG), (P - 1) * n
     if alg == Algorithm.EAGER_RING_RS_AG:
         chunk = n / P
-        if _logp_allreduce(P, n):
+        if _logp_forced(P, _logp_allreduce(P, n), logp_shape):
             # native recursive halving-doubling: 2*log2(P) exchange
             # steps carrying n(1-1/P) bytes per phase
             return 2 * math.log2(P), 2 * (P - 1) * chunk
@@ -164,7 +181,7 @@ def coefficients(
         # size now (no per-hop address handshake), so a rendezvous-size
         # allgather costs ring hops, not 2x handshake messages
         if op == Operation.allgather:
-            if _logp_allgather(P, P * n):
+            if _logp_forced(P, _logp_allgather(P, P * n), logp_shape):
                 return math.log2(P), (P - 1) * n
             return (P - 1) * _segs(n, _STREAM_SEG), (P - 1) * n
         return 2 * (P - 1), (P - 1) * n
@@ -204,6 +221,7 @@ def coefficients_aggregate(
     world: int,
     *,
     rx_buf_bytes: int,
+    logp_shape: bool | None = None,
 ) -> tuple[float, float]:
     """(messages, bytes) SUMMED OVER ALL RANKS — the cost shape a
     serialized host actually pays. The emulator runs its whole world on
@@ -214,7 +232,8 @@ def coefficients_aggregate(
     1.15x, where the critical-path shape was 1.9-3x off. The
     critical-path `coefficients` remain the model for parallel hardware
     (the TPU tier and the tuning-register crossovers). Bytes are WIRE
-    bytes (see `coefficients`)."""
+    bytes and `logp_shape` forces the logp-vs-ring hop shape (see
+    `coefficients`)."""
     n = count * wire_elem_bytes(elem_bytes, plan.wire_dtype)
     P = world
     if P <= 1 or plan.algorithm == Algorithm.NONE:
@@ -231,7 +250,7 @@ def coefficients_aggregate(
                             rx_buf_bytes=rx_buf_bytes)
     if alg == Algorithm.EAGER_RING:
         if op == Operation.allgather:
-            if _logp_allgather(P, P * n):
+            if _logp_forced(P, _logp_allgather(P, P * n), logp_shape):
                 return P * r, P * (P - 1) * n
             return P * (P - 1) * _segs(n, _STREAM_SEG), P * (P - 1) * n
         if op == Operation.reduce:
@@ -245,7 +264,7 @@ def coefficients_aggregate(
         return P * (P - 1) / 2 * _segs(n, _STREAM_SEG), P * (P - 1) / 2 * n
     if alg == Algorithm.EAGER_RING_RS_AG:
         chunk = n / P
-        if _logp_allreduce(P, n):
+        if _logp_forced(P, _logp_allreduce(P, n), logp_shape):
             return 2 * P * r, 2 * (P - 1) * n
         return 2 * P * (P - 1) * _segs(int(chunk), _STREAM_SEG), \
             2 * (P - 1) * n
@@ -255,7 +274,7 @@ def coefficients_aggregate(
         return 2 * (P - 1), (P - 1) * n
     if alg == Algorithm.RNDZV_RING:
         if op == Operation.allgather:
-            if _logp_allgather(P, P * n):
+            if _logp_forced(P, _logp_allgather(P, P * n), logp_shape):
                 return P * r, P * (P - 1) * n
             return P * (P - 1) * _segs(n, _STREAM_SEG), P * (P - 1) * n
         return 2 * P * (P - 1), P * (P - 1) * n

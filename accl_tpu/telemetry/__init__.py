@@ -1,0 +1,53 @@
+"""accl-tpu telemetry: tracing and metrics across every executor.
+
+Observability lives next to the data plane (the ACCL posture: hardware
+performance counters and per-call duration registers the host reads back
+after the fact) and one schema threads through every layer:
+
+  - the NATIVE trace ring (runtime.cpp record_span, ACCL_RT_TRACE=1)
+    records per-call spans — opcode, bytes, start/end ns, retcode,
+    deferred-mismatch detail, sequencer-counter deltas — drained through
+    ctypes (EmuRank.trace_read) and lifted into events by
+    telemetry.native;
+  - the HOST tracer (telemetry.tracer) collects facade call spans and
+    the fused-sequence record -> lint -> compile -> dispatch phases,
+    every span carrying its timing.predict estimate where one exists;
+  - telemetry.export renders Chrome trace-event JSON (one track per
+    rank/executor, Perfetto-loadable) and the predicted-vs-measured
+    residual table, validated against EVENT_SCHEMA (jsonschema);
+  - telemetry.feedback closes the loop: measured spans ->
+    timing.calibrate samples -> refit LinkParams -> ACCL.autotune.
+
+Entry points: bench.py --trace emits the full trace + residual section;
+tools/accl_trace.py exports/validates/selftests standalone. Host
+tracing is off by default (ACCL_TELEMETRY=1 or telemetry.enable());
+the disabled path is one predicate per site, gated <1% on the bench
+smoke path. See docs/observability.md for the schema table and the
+calibration-loop walkthrough.
+"""
+
+from .tracer import (  # noqa: F401
+    DEFAULT_CAPACITY,
+    SCHEMA_VERSION,
+    Tracer,
+    disable,
+    enable,
+    get_tracer,
+)
+from .export import (  # noqa: F401
+    EVENT_SCHEMA,
+    read_trace,
+    residual_rows,
+    residual_summary,
+    to_chrome,
+    validate_trace,
+    write_trace,
+)
+from .feedback import (  # noqa: F401
+    autotune_from_trace,
+    calibrate_from_trace,
+    default_link,
+    residual_improvement,
+    residual_report,
+)
+from . import native  # noqa: F401

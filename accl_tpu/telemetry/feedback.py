@@ -1,0 +1,156 @@
+"""The measured-vs-predicted feedback loop.
+
+timing.predict answers "how long should this call take"; the trace ring
+answers "how long did it take". This module closes the loop (the HiCCL
+posture: a timing model continuously calibrated from measured
+collectives is what makes algorithm selection trustworthy):
+
+  - calibrate_from_trace(): spans that carry their aggregate cost
+    coefficients (telemetry.native attaches coef_messages/coef_bytes at
+    drain time) become timing.calibrate samples, yielding refit
+    LinkParams;
+  - residual_improvement(): the mechanically-honest scoreboard — median
+    |predicted - measured| / measured under the shipped default link vs
+    under the refit, over the same spans;
+  - autotune_from_trace(): hands the refit link to ACCL.autotune, so
+    the tuning registers the device actually consults move with the
+    measurements.
+
+default_link() loads the shipped calibration the same way ACCL.autotune
+does (accl_log/timing_model.json, bcast per-collective fit), so "the
+shipped defaults" in every residual comparison means exactly what
+autotune would have used.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from ..sequencer.timing import LinkParams, calibrate
+from .export import measured_seconds, median, residual_rows, residual_summary
+
+_MODEL_PATH = (pathlib.Path(__file__).resolve().parents[2]
+               / "accl_log" / "timing_model.json")
+
+
+_default_link_cache: dict = {}
+
+
+def default_link(path=None) -> LinkParams | None:
+    """The shipped emulator-tier LinkParams (the same selection rule as
+    ACCL.autotune: per-collective bcast fit, legacy single-link
+    fallback). None when no timing model is committed. Hits are cached
+    per path (live span emission calls this once per traced call);
+    misses are NOT, so a model fitted and written later in the same
+    process is picked up."""
+    p = pathlib.Path(path) if path else _MODEL_PATH
+    if p in _default_link_cache:
+        return _default_link_cache[p]
+    link = _load_link(p)
+    if link is not None:
+        _default_link_cache[p] = link
+    return link
+
+
+def _load_link(p: pathlib.Path) -> LinkParams | None:
+    # a malformed or partially-written model (hand-edited, interrupted
+    # fit) degrades to "no default link", never to a per-call crash in
+    # the traced hot path
+    try:
+        model = json.loads(p.read_text())
+        lk = (model.get("link_per_collective", {}).get("bcast")
+              or model.get("link"))
+        if not lk:
+            return None
+        return LinkParams(alpha=lk["alpha_us"] * 1e-6,
+                          beta=lk["beta_gbps"] * 1e9)
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        return None
+
+
+def hop_samples(trace: dict) -> list[tuple[float, float, float]]:
+    """(messages, bytes, measured_seconds) samples from every span that
+    carries its aggregate cost coefficients and a positive measurement —
+    the exact input shape timing.calibrate fits."""
+    samples = []
+    for sp in trace.get("spans", []):
+        args = sp.get("args", {})
+        if "coef_messages" not in args or "coef_bytes" not in args:
+            continue
+        m = float(args["coef_messages"])
+        b = float(args["coef_bytes"])
+        if m <= 0 and b <= 0:
+            continue  # cost-free spans (world==1 degenerate calls)
+        t = measured_seconds(sp)
+        if t <= 0:
+            continue
+        samples.append((m, b, t))
+    return samples
+
+
+def calibrate_from_trace(trace: dict) -> LinkParams:
+    """Refit LinkParams from a trace's measured hop spans. Raises
+    ValueError when the trace carries no calibratable spans (a trace
+    from a run with tracing off, or pure host-phase spans)."""
+    samples = hop_samples(trace)
+    if len(samples) < 2:
+        raise ValueError(
+            f"trace has {len(samples)} calibratable span(s); need >= 2 "
+            "(native spans with coef_messages/coef_bytes — run with "
+            "ACCL_RT_TRACE=1 and drain through telemetry.native)")
+    return calibrate(samples)
+
+
+def _rel_errs(trace: dict, link: LinkParams) -> list[float]:
+    errs = []
+    for m, b, t in hop_samples(trace):
+        pred = link.seconds(m, b)
+        errs.append(abs(pred - t) / t)
+    return errs
+
+
+def residual_improvement(trace: dict,
+                         default: LinkParams | None = None) -> dict:
+    """Median relative residual under the shipped default link vs under
+    the trace's own refit, over the same calibratable spans. The bench
+    --trace gate requires refit <= default: if refitting on the very
+    measurements cannot beat the shipped constants, the feedback loop
+    is broken (or the cost shapes regressed)."""
+    if default is None:
+        default = default_link()
+    refit = calibrate_from_trace(trace)
+    out = {
+        "samples": len(hop_samples(trace)),
+        "refit": {"alpha_us": refit.alpha * 1e6,
+                  "beta_gbps": refit.beta / 1e9},
+        "median_rel_err_refit": median(_rel_errs(trace, refit)),
+    }
+    if default is not None:
+        out["default"] = {"alpha_us": default.alpha * 1e6,
+                          "beta_gbps": default.beta / 1e9}
+        out["median_rel_err_default"] = median(_rel_errs(trace, default))
+        out["improved"] = (out["median_rel_err_refit"]
+                           <= out["median_rel_err_default"])
+    return out
+
+
+def autotune_from_trace(accl, trace: dict, **autotune_kw):
+    """Close the loop into the tuning registers: refit LinkParams from
+    the trace and apply ACCL.autotune with them. Returns the applied
+    TuningParams (the registers the device now consults per call)."""
+    link = calibrate_from_trace(trace)
+    return accl.autotune(link=link, **autotune_kw)
+
+
+def residual_report(trace: dict) -> dict:
+    """The residual section bench.py --trace embeds in its JSON: the
+    span-level residual summary (spans carrying predicted_s) plus the
+    default-vs-refit improvement over the calibratable samples."""
+    rows = residual_rows(trace)
+    report = {"span_residuals": residual_summary(rows)}
+    try:
+        report["calibration"] = residual_improvement(trace)
+    except ValueError as e:
+        report["calibration"] = {"error": str(e)}
+    return report

@@ -1,0 +1,210 @@
+"""Native trace-ring drain: device-resident spans -> telemetry events.
+
+The native runtime records one accl_rt_span_t per completed call in a
+per-rank ring (ACCL_RT_TRACE=1, runtime.cpp record_span); EmuRank
+.trace_read drains the raw structs through ctypes. This module lifts
+those raw records into the SPAN v1 event schema (tracer.py), attaching
+the things only the host knows:
+
+  - the Operation name behind the opcode;
+  - the Plan the shared selection rules would resolve for that call (so
+    the span names its algorithm honestly — the native runtime applies
+    the SAME rules, plan.py's single-rule-set contract);
+  - the aggregate cost coefficients (messages, wire bytes) of that plan
+    from timing.coefficients_aggregate — the shape the serialized
+    emulator host actually pays — which is what lets
+    feedback.calibrate_from_trace turn measured spans into
+    timing.calibrate samples;
+  - the timing.predict estimate under a given LinkParams, so every
+    native span carries its prediction next to its measurement.
+
+Per-rank tracks are named "emu/r<rank>" — the one-track-per-rank layout
+the Chrome export renders.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..constants import Operation, TuningParams, dtype_nbytes, DataType
+from ..sequencer.plan import select_algorithm
+from ..sequencer.timing import LinkParams, coefficients_aggregate
+
+# THE eager/rx geometry of the emulator sweeps — the single source
+# (tools/bench_emulator.py imports these as MAX_EAGER/RX_BUF): the
+# default config under which native spans are re-planned when the
+# caller does not say otherwise. Retuning here moves the sweep, the
+# protocol labeler, and every telemetry cost computation together.
+DEFAULT_MAX_EAGER = 4096
+DEFAULT_RX_BUF = 4096
+
+
+def span_cost(
+    op: Operation,
+    count: int,
+    elem_bytes: int,
+    world: int,
+    *,
+    max_eager_size: int = DEFAULT_MAX_EAGER,
+    rx_buf_bytes: int = DEFAULT_RX_BUF,
+    tuning: TuningParams | None = None,
+    logp_shape: bool | None = None,
+):
+    """(plan, messages, wire_bytes) for one native call under the shared
+    selection rules and the AGGREGATE cost shape (the serialized-host
+    regime the emulator tier is calibrated on). Returns (None, 0, 0)
+    for calls with no data-plane cost shape (config/nop). `logp_shape`
+    mirrors a forced ACCL_RT_SHAPE in the measured executor (True =
+    logp, False = ring, None = the shared auto rule) so forced-shape
+    sweeps are costed on the schedule that actually ran."""
+    if op in (Operation.config, Operation.nop):
+        return None, 0.0, 0.0
+    plan = select_algorithm(
+        op, count, elem_bytes, world,
+        max_eager_size=max_eager_size,
+        eager_rx_buf_size=rx_buf_bytes,
+        tuning=tuning if tuning is not None else TuningParams.default(),
+    )
+    m, b = coefficients_aggregate(op, plan, count, elem_bytes, world,
+                                  rx_buf_bytes=rx_buf_bytes,
+                                  logp_shape=logp_shape)
+    return plan, m, b
+
+
+def aggregate_wire_gbps(
+    op_name: str,
+    nbytes: int,
+    world: int,
+    seconds: float,
+    *,
+    max_eager_size: int = DEFAULT_MAX_EAGER,
+    rx_buf_bytes: int = DEFAULT_RX_BUF,
+    tuning: TuningParams | None = None,
+    logp_shape: bool | None = None,
+) -> float:
+    """Aggregate wire-bytes bandwidth of one measured sweep row: the
+    TOTAL bytes the planned schedule moves across all ranks
+    (timing.coefficients_aggregate) divided by the measured seconds —
+    the volume-honest column the r5 verdict asked the emulator sweep
+    tables to carry (payload GB/s understates collectives that move
+    (P-1)x their payload)."""
+    if seconds <= 0 or nbytes <= 0:
+        return float("nan")
+    op = Operation[op_name]
+    count = max(nbytes // 4, 1)
+    _plan, _m, agg_bytes = span_cost(
+        op, count, 4, world, max_eager_size=max_eager_size,
+        rx_buf_bytes=rx_buf_bytes, tuning=tuning, logp_shape=logp_shape)
+    return agg_bytes / seconds / 1e9
+
+
+def native_event(
+    raw: dict,
+    *,
+    world: int,
+    track: str | None = None,
+    link: LinkParams | None = None,
+    max_eager_size: int = DEFAULT_MAX_EAGER,
+    rx_buf_bytes: int = DEFAULT_RX_BUF,
+    tuning: TuningParams | None = None,
+    ts_base_ns: int | None = None,
+    logp_shape: bool | None = None,
+) -> dict:
+    """Lift one raw EmuRank.trace_read record into a SPAN v1 event.
+
+    `ts_base_ns` rebases the runtime-relative native clock into the
+    host perf_counter_ns domain (pass the host ns that corresponds to
+    the runtime's creation; default anchors 0 at drain time minus the
+    span's own end, which keeps relative order within a rank)."""
+    op = Operation(raw["opcode"])
+    count = int(raw["count"])
+    nbytes = int(raw["bytes"])
+    elem_bytes = max(nbytes // count, 1) if count else 4
+    plan, m, b = span_cost(
+        op, count, elem_bytes, world, max_eager_size=max_eager_size,
+        rx_buf_bytes=rx_buf_bytes, tuning=tuning, logp_shape=logp_shape)
+    dur = max(int(raw["end_ns"]) - int(raw["start_ns"]), 0)
+    if ts_base_ns is None:
+        ts_base_ns = time.perf_counter_ns() - int(raw["end_ns"])
+    args = {
+        "op": op.name,
+        "count": count,
+        "bytes": nbytes,
+        "world": world,
+        "rank": int(raw.get("rank", 0)),
+        "retcode": int(raw["retcode"]),
+        "detail": int(raw["detail"]),
+        "measured_s": dur / 1e9,
+        "d_passes": int(raw["d_passes"]),
+        "d_parks": int(raw["d_parks"]),
+        "d_seek_hit": int(raw["d_seek_hit"]),
+        "d_seek_miss": int(raw["d_seek_miss"]),
+    }
+    if plan is not None:
+        args["algorithm"] = plan.algorithm.name
+        args["protocol"] = plan.protocol.name
+        args["coef_messages"] = float(m)
+        args["coef_bytes"] = float(b)
+        if link is not None:
+            args["predicted_s"] = link.seconds(m, b)
+    return {
+        "name": op.name,
+        "cat": "native",
+        "track": track or f"emu/r{raw.get('rank', 0)}",
+        "ts_ns": ts_base_ns + int(raw["start_ns"]),
+        "dur_ns": dur,
+        "args": args,
+    }
+
+
+def drain_world(
+    emu_world,
+    *,
+    link: LinkParams | None = None,
+    max_eager_size: int = DEFAULT_MAX_EAGER,
+    rx_buf_bytes: int = DEFAULT_RX_BUF,
+    tuning: TuningParams | None = None,
+    tracer=None,
+    logp_shape: bool | None = None,
+) -> tuple[list[dict], int]:
+    """Drain every rank of an EmuWorld into SPAN v1 events (one track
+    per rank). Returns (events, total_dropped); when `tracer` is given
+    the events are also appended to its ring."""
+    events: list[dict] = []
+    dropped = 0
+    now = time.perf_counter_ns()
+    for rank in emu_world.ranks:
+        if rank is None:
+            continue
+        raw, d = rank.trace_read()
+        dropped += d
+        # anchor each rank's runtime-relative clock so the LAST span
+        # ends "now" — ranks stay mutually ordered well enough for a
+        # human timeline, and exactly ordered within each rank
+        base = now - max((int(r["end_ns"]) for r in raw), default=0)
+        for r in raw:
+            events.append(native_event(
+                r, world=len(emu_world.ranks),
+                link=link, max_eager_size=max_eager_size,
+                rx_buf_bytes=rx_buf_bytes, tuning=tuning,
+                ts_base_ns=base, logp_shape=logp_shape))
+    if tracer is not None:
+        tracer.extend(events)
+    return events, dropped
+
+
+def default_wire_dtype() -> DataType:
+    """Uncompressed wire (native spans never ride compression lanes in
+    the sweeps this module serves)."""
+    return DataType.none
+
+
+__all__ = [
+    "span_cost",
+    "aggregate_wire_gbps",
+    "native_event",
+    "drain_world",
+    "DEFAULT_MAX_EAGER",
+    "DEFAULT_RX_BUF",
+    "dtype_nbytes",
+]

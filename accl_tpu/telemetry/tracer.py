@@ -1,0 +1,208 @@
+"""In-process span tracer: the host half of the telemetry subsystem.
+
+The CCLO keeps its observability next to the data plane — hardware
+performance counters and per-call duration registers the host reads back
+after the fact (SURVEY.md L2/L4; the native runtime's trace ring is that
+posture rebuilt, runtime.cpp record_span). This module is the HOST side
+of the same contract: a thread-safe, bounded, drop-oldest ring of span
+events that the facade, the sequence machinery, and the device backends
+emit into, and that tools/accl_trace.py / bench.py --trace export as
+Chrome trace-event JSON (telemetry.export).
+
+One stable event schema (SPAN v1) spans every emitter:
+
+    {"name": str,      # operation / phase label ("allreduce", "lint")
+     "cat": str,       # "call" | "step" | "phase" | "sequence" | "native"
+     "track": str,     # render track: "facade", "device", "emu/r3", ...
+     "ts_ns": int,     # start, perf_counter_ns domain (native spans are
+                       #   rebased into it at drain time)
+     "dur_ns": int,    # duration (0 = instant marker, e.g. a recorded
+                       #   sequence step whose time is inside the fused
+                       #   program)
+     "args": {...}}    # schema'd detail keys: op, count, bytes, world,
+                       #   algorithm, protocol, retcode, detail,
+                       #   predicted_s, measured_s, coef_messages,
+                       #   coef_bytes, signature, step, rank, d_passes,
+                       #   d_parks, d_seek_hit, d_seek_miss, ...
+
+Tracing is OFF by default and costs one predicate per instrumented site
+when off (`span()` returns a shared no-op object before any argument
+handling): the bench smoke path gates that disabled overhead under 1%.
+Enable with ACCL_TELEMETRY=1 in the environment or telemetry.enable().
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+SCHEMA_VERSION = "accl-tpu-trace-v1"
+
+# default host ring capacity (spans); the ring drops OLDEST on overflow
+# and counts the drops — mirroring the native ring's contract
+DEFAULT_CAPACITY = 65536
+
+
+class _NullSpan:
+    """Shared no-op span: the disabled-tracing fast path. Reentrant and
+    stateless, so one instance serves every call site."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **_kw) -> "_NullSpan":
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Context manager measuring one span; emitted into the tracer ring
+    on exit. `set()` attaches args discovered mid-span (e.g. the plan a
+    device resolved after dispatch)."""
+
+    __slots__ = ("_tracer", "name", "cat", "track", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, track: str,
+                 args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.track = track
+        self.args = args
+        self._t0 = 0
+
+    def __enter__(self) -> "_LiveSpan":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def set(self, **kw) -> "_LiveSpan":
+        self.args.update(kw)
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb) -> bool:
+        dur = time.perf_counter_ns() - self._t0
+        if exc_type is not None:
+            self.args.setdefault("error", exc_type.__name__)
+        self._tracer.emit(self.name, self.cat, self.track,
+                          ts_ns=self._t0, dur_ns=dur, args=self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded span ring (drop-oldest, counted drops)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("ACCL_TELEMETRY", "0") not in (
+                "", "0", "false", "off")
+        self._enabled = bool(enabled)
+        self.capacity = int(capacity)
+        self._spans: deque = deque()
+        self._mu = threading.Lock()
+        self.drops = 0
+
+    # -- switching ---------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    # -- emission ----------------------------------------------------------
+
+    def span(self, name: str, cat: str = "call", track: str = "host",
+             **args):
+        """Start a span context manager. Disabled tracing returns the
+        shared no-op before touching the arguments."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, cat, track, args)
+
+    def emit(self, name: str, cat: str, track: str, *, ts_ns: int,
+             dur_ns: int, args: dict | None = None) -> None:
+        """Record one already-measured span (the direct form used when
+        draining native rings or replaying recorded timings)."""
+        if not self._enabled:
+            return
+        ev = {
+            "name": name,
+            "cat": cat,
+            "track": track,
+            "ts_ns": int(ts_ns),
+            "dur_ns": int(dur_ns),
+            "args": dict(args or {}),
+        }
+        with self._mu:
+            if len(self._spans) >= self.capacity:
+                self._spans.popleft()
+                self.drops += 1
+            self._spans.append(ev)
+
+    def extend(self, events: list[dict]) -> None:
+        """Bulk-append pre-shaped span events (ring discipline applies)."""
+        if not self._enabled:
+            return
+        with self._mu:
+            for ev in events:
+                if len(self._spans) >= self.capacity:
+                    self._spans.popleft()
+                    self.drops += 1
+                self._spans.append(ev)
+
+    # -- readout -----------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """Non-destructive copy of the current ring contents."""
+        with self._mu:
+            return list(self._spans)
+
+    def drain(self) -> list[dict]:
+        """Remove and return every buffered span."""
+        with self._mu:
+            out = list(self._spans)
+            self._spans.clear()
+            return out
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+            self.drops = 0
+
+    def to_trace(self, meta: dict | None = None) -> dict:
+        """Package the current spans as a schema-versioned trace document
+        (the on-disk / exchange format every exporter consumes)."""
+        m = {"drops": self.drops}
+        if meta:
+            m.update(meta)
+        return {"schema": SCHEMA_VERSION, "meta": m, "spans": self.snapshot()}
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer every built-in emitter uses."""
+    return _tracer
+
+
+def enable() -> None:
+    _tracer.enable()
+
+
+def disable() -> None:
+    _tracer.disable()

@@ -17,6 +17,11 @@ what the available hardware can honestly measure:
 stdout: exactly ONE JSON line {metric, value, unit, vs_baseline}.
 detail: accl_log/profile.csv (Test,Bytes,Seconds,GBps — the reference's
 profile_<rank>.csv shape, fixture.hpp:145-151).
+
+Modes: --smoke (CI fused-vs-eager gate + lint/telemetry overhead
+budgets), --quant-gate (wire-byte reduction gate), --trace (the
+telemetry lane: emit accl_log/trace.json + trace_chrome.json and gate
+the calibrate_from_trace residual improvement — docs/observability.md).
 """
 
 import json
@@ -543,6 +548,180 @@ def _quant_gate_main():
         sys.exit(1)
 
 
+def measure_telemetry_overhead(n=50_000):
+    """Per-site cost of the DISABLED tracing path (the predicate +
+    no-op span the facade pays on every call when ACCL_TELEMETRY is
+    off). The smoke gate multiplies this by the spans-per-chain count
+    and requires the product under 1% of the measured fused-chain time:
+    instrumentation must be free when nobody is watching."""
+    from accl_tpu.telemetry import get_tracer
+
+    tr = get_tracer()
+    was = tr.enabled
+    tr.disable()
+    try:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tr.span("overhead_probe", cat="call", track="facade"):
+                pass
+        return (time.perf_counter() - t0) / n
+    finally:
+        if was:
+            tr.enable()
+
+
+# ~span sites per smoke chain: facade call + sequence + four phases +
+# headroom. ONE constant and ONE budget shared by the --smoke and
+# --trace gates, so retuning either cannot desynchronize them.
+TELEMETRY_SPAN_SITES = 8
+TELEMETRY_OVERHEAD_BUDGET = 0.01
+
+
+def telemetry_disabled_gate(sec_fused):
+    """(per_site_seconds, ratio, ok) for the disabled-instrumentation
+    budget: TELEMETRY_SPAN_SITES no-op spans must cost under
+    TELEMETRY_OVERHEAD_BUDGET of the measured fused chain."""
+    per_site = measure_telemetry_overhead()
+    ratio = TELEMETRY_SPAN_SITES * per_site / max(sec_fused, 1e-9)
+    return per_site, ratio, ratio < TELEMETRY_OVERHEAD_BUDGET
+
+
+def _trace_sweep_native(world=8, sizes=(64 * 1024, 1024 * 1024), iters=2):
+    """The measured-hop source for bench.py --trace: a native EmuWorld
+    sweep with the device-resident trace ring armed (ACCL_RT_TRACE=1),
+    drained into SPAN v1 events with one track per rank and every span
+    carrying its timing.predict estimate + aggregate cost coefficients
+    (telemetry.native). Returns (events, dropped)."""
+    from accl_tpu import ReduceFunction
+    from accl_tpu.device.emu_device import EmuWorld
+    from accl_tpu.telemetry import default_link
+    from accl_tpu.telemetry import native as tnative
+
+    saved = os.environ.get("ACCL_RT_TRACE")
+    os.environ["ACCL_RT_TRACE"] = "1"
+    try:
+        w = EmuWorld(world, max_eager=tnative.DEFAULT_MAX_EAGER,
+                     rx_buf_bytes=tnative.DEFAULT_RX_BUF)
+    finally:
+        if saved is None:
+            os.environ.pop("ACCL_RT_TRACE", None)
+        else:
+            os.environ["ACCL_RT_TRACE"] = saved
+    try:
+        def body(rank, i):
+            for nbytes in sizes:
+                count = nbytes // 4
+                x = np.ones(count, np.float32)
+                out = np.zeros(count, np.float32)
+                ag = np.zeros(count * world, np.float32)
+                for _ in range(iters):
+                    rank.allreduce(x, out, count, ReduceFunction.SUM)
+                    rank.bcast(x, count, root=0)
+                    rank.allgather(x, ag, count)
+
+        w.run(body)
+        return tnative.drain_world(w, link=default_link())
+    finally:
+        w.close()
+
+
+def _trace_main():
+    """bench.py --trace: the telemetry lane. Emits
+
+      - accl_log/trace.json        (SPAN v1 trace document)
+      - accl_log/trace_chrome.json (Chrome trace-event JSON, one track
+                                    per rank/executor, Perfetto-loadable)
+
+    from (a) the facade + fused-sequence chain on the CPU mesh (host
+    spans: every collective call, the record/lint/compile/dispatch
+    phases, per-step predicted times) and (b) a native 8-rank emulator
+    sweep with the device trace ring armed (per-rank measured spans).
+    The JSON line carries the residual section: median
+    |predicted-measured|/measured under the shipped default link vs the
+    calibrate_from_trace() refit — the refit must not be worse, or the
+    feedback loop is broken. Also gates the DISABLED instrumentation
+    cost (<1% of the fused chain)."""
+    import jax
+
+    from accl_tpu import telemetry
+
+    tr = telemetry.get_tracer()
+    tr.enable()
+    world = min(len(jax.devices()), 8)
+
+    # host lane: every collective + a fused sequence, spans into the ring
+    rows, _ = bench_sequence(jax, world)
+    sec_fused = next(s for t, b, s, *_ in rows if "fused" in t)
+
+    # native lane: per-rank measured spans (one track per rank)
+    native_events, native_dropped = _trace_sweep_native(world=world)
+    tr.extend(native_events)
+
+    trace = tr.to_trace({
+        "world": world,
+        "native_dropped": native_dropped,
+        "cost_shape": "aggregate",
+    })
+    from accl_tpu.telemetry import (residual_report, to_chrome,
+                                    validate_trace, write_trace)
+
+    validate_trace(trace)
+    outdir = pathlib.Path(__file__).parent / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    write_trace(outdir / "trace.json", trace)
+    write_trace(outdir / "trace_chrome.json", to_chrome(trace))
+    report = residual_report(trace)
+
+    per_site, overhead_ratio, overhead_ok = telemetry_disabled_gate(
+        sec_fused)
+    tracks = sorted({sp["track"] for sp in trace["spans"]})
+    print(f"  trace: {len(trace['spans'])} spans on {len(tracks)} tracks "
+          f"({', '.join(tracks)}); disabled overhead "
+          f"{per_site * 1e9:.0f} ns/site ({overhead_ratio * 100:.4f}% "
+          "of fused chain)", file=sys.stderr)
+    cal = report.get("calibration", {})
+    # None-safe readout: a checkout without accl_log/timing_model.json
+    # has no default link — the JSON stays valid (null, never NaN) and
+    # the gate below says WHY it failed instead of raising
+    refit_err = cal.get("median_rel_err_refit")
+    default_err = cal.get("median_rel_err_default")
+    print(json.dumps({
+        "metric": "telemetry trace residuals: median |pred-meas|/meas, "
+                  f"shipped default link -> calibrate_from_trace refit "
+                  f"(w{world} native sweep)",
+        "value": round(refit_err, 4) if refit_err is not None else None,
+        "unit": "rel_err",
+        "vs_baseline": (round(refit_err / default_err, 4)
+                        if refit_err is not None and default_err
+                        else None),
+        "residuals": report,
+        "spans": len(trace["spans"]),
+        "tracks": len(tracks),
+        "native_dropped": native_dropped,
+        "telemetry_disabled_overhead_pct": round(overhead_ratio * 100, 4),
+    }))
+    if "error" in cal:
+        print(f"FAIL: no calibratable spans: {cal['error']}",
+              file=sys.stderr)
+        sys.exit(1)
+    if default_err is None:
+        print("FAIL: no shipped timing model to compare against "
+              "(accl_log/timing_model.json missing or unreadable) — the "
+              "residual gate needs the default link", file=sys.stderr)
+        sys.exit(1)
+    if not cal.get("improved", False):
+        print("FAIL: calibrate_from_trace refit did not reduce the "
+              f"median residual (refit {refit_err:.3f} "
+              f"vs default {default_err:.3f})", file=sys.stderr)
+        sys.exit(1)
+    if not overhead_ok:
+        print(f"FAIL: disabled tracing costs {overhead_ratio * 100:.2f}% "
+              "of the fused chain (>= "
+              f"{TELEMETRY_OVERHEAD_BUDGET * 100:.0f}% budget)",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def _smoke_main():
     """bench.py --smoke: the CI-facing quick lane — runs the fused-vs-
     eager sequence benchmark on the virtual CPU mesh and emits ONE JSON
@@ -560,6 +739,15 @@ def _smoke_main():
     print(f"  lint stage {lint_sec*1e6:8.1f} us vs record+compile "
           f"{rc_sec*1e3:8.1f} ms ({lint_ratio*100:.3f}%)",
           file=sys.stderr)
+    # disabled-telemetry overhead against the fused chain this very run
+    # measured — instrumentation must be free when off (shared gate:
+    # telemetry_disabled_gate, same constants as bench.py --trace)
+    sec_fused = next(s for t, b, s, *_ in rows if "fused" in t)
+    tel_site, tel_ratio, tel_ok = telemetry_disabled_gate(sec_fused)
+    rows.append(("telemetry_disabled_overhead", 0, tel_site, tel_ratio,
+                 1.0, True))
+    print(f"  telemetry disabled-path {tel_site*1e9:6.0f} ns/site "
+          f"({tel_ratio*100:.4f}% of fused chain)", file=sys.stderr)
     q_reduction, q_max_rel = bench_quantized_wire(jax, world)
     rows.append(("quantized_allreduce_wire_reduction", 16 * 1024 * 1024,
                  0.0, q_reduction, 1.0, True))
@@ -604,6 +792,13 @@ def _smoke_main():
     if lint_ratio >= 0.05:
         print(f"FAIL: lint stage costs {lint_ratio*100:.1f}% of "
               "record+compile time (>= 5% budget)", file=sys.stderr)
+        sys.exit(1)
+    # the telemetry gate: the disabled tracing path fronts EVERY facade
+    # call, so its cost must stay invisible (shared budget with --trace)
+    if not tel_ok:
+        print(f"FAIL: disabled telemetry costs {tel_ratio*100:.2f}% of "
+              f"the fused chain (>= {TELEMETRY_OVERHEAD_BUDGET*100:.0f}% "
+              "budget)", file=sys.stderr)
         sys.exit(1)
 
 
@@ -930,5 +1125,7 @@ if __name__ == "__main__":
         _smoke_main()
     elif "--quant-gate" in sys.argv:
         _quant_gate_main()
+    elif "--trace" in sys.argv:
+        _trace_main()
     else:
         main()

@@ -91,6 +91,35 @@ void accl_rt_get_stats(accl_rt_t *rt, uint64_t out[5]);
  * report into out (truncated at cap); returns the untruncated length. */
 size_t accl_rt_dump_rxbufs(accl_rt_t *rt, char *out, size_t cap);
 
+/* Device-resident trace ring (ACCL_RT_TRACE=1; ACCL_RT_TRACE_CAP sizes
+ * the ring, default 4096). One record per COMPLETED call: opcode,
+ * element count, payload bytes, start/end ns since runtime creation
+ * (steady clock), the sticky retcode, the deferred-head-mismatch fault
+ * code the timeout detail surfaced (0 when none), and the per-call
+ * delta of the sequencer counters (passes/parks/seek hit/miss) over the
+ * call's lifetime. Zero-cost when tracing is off: the recording path is
+ * a single branch on a bool set at create. */
+typedef struct accl_rt_span {
+  uint32_t opcode;    /* call scenario (desc word 0) */
+  uint32_t retcode;   /* sticky error word of the completed call */
+  uint32_t detail;    /* deferred-mismatch fault code behind a
+                         RECEIVE_TIMEOUT (DMA_TAG_MISMATCH / DMA_SIZE),
+                         0 = none */
+  uint32_t count;     /* element count (desc word 1) */
+  uint64_t bytes;     /* payload bytes (count * dtype width) */
+  uint64_t start_ns;  /* call enqueue, ns since runtime creation */
+  uint64_t end_ns;    /* call completion, ns since runtime creation */
+  uint64_t d_passes, d_parks, d_seek_hit, d_seek_miss; /* counter deltas */
+} accl_rt_span_t;
+
+/* Drain up to cap span records (oldest first) into out; returns the
+ * number copied and removes them from the ring. *dropped (optional)
+ * receives the cumulative count of spans lost to ring overflow (oldest
+ * dropped first; the ring itself never blocks or crashes the data
+ * plane). Returns 0 when tracing is disabled. */
+size_t accl_rt_trace_read(accl_rt_t *rt, accl_rt_span_t *out, size_t cap,
+                          uint64_t *dropped);
+
 /* Data types, matching accl_tpu.constants.DataType. */
 enum accl_rt_dtype {
   ACCL_DT_NONE = 0,

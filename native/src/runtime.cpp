@@ -396,6 +396,16 @@ struct Call {
   std::shared_ptr<std::vector<uint16_t>> c16_op0, c16_op1, c16_res;
   // step-machine scratch (shared with the compressed-domain inner Call)
   std::shared_ptr<CollState> cstate;
+  // trace-ring bookkeeping (ACCL_RT_TRACE=1): sequencer-counter snapshot
+  // at enqueue (the span's per-call delta base) and the deferred-head-
+  // mismatch fault code behind an eventual RECEIVE_TIMEOUT
+  uint64_t ctr0[4] = {0, 0, 0, 0};
+  uint32_t trace_detail = 0;
+  // last_defer.count at this call's first pass: the timeout detail may
+  // only report mismatches recorded DURING the call — a stale note from
+  // an earlier (resolved) deferral must not masquerade as this
+  // timeout's root cause
+  uint64_t defer0 = 0;
 };
 
 struct Completion {
@@ -487,9 +497,13 @@ struct accl_rt {
     uint32_t src = 0;
     uint32_t head_tag = 0, want_tag = 0, head_seqn = 0;
     uint64_t head_msg = 0, head_off = 0, want_msg = 0;
+    // the fault code the mismatch WOULD have raised had the head been
+    // provably stray (DMA_TAG_MISMATCH_ERROR / DMA_SIZE_ERROR): the
+    // NOT_READY softening must not hide which protocol check tripped
+    uint32_t code = 0;
   } last_defer;
   void note_defer_locked(const RxSlot &s, uint32_t want_tag,
-                         uint64_t want_msg) {
+                         uint64_t want_msg, uint32_t code) {
     last_defer.count++;
     last_defer.src = s.src;
     last_defer.head_tag = s.tag;
@@ -498,6 +512,7 @@ struct accl_rt {
     last_defer.head_msg = s.msg_bytes;
     last_defer.head_off = s.msg_off;
     last_defer.want_msg = want_msg;
+    last_defer.code = code;
   }
 
   // Direct-placement eager landing (rxbuf bypass): a parked strict recv
@@ -610,6 +625,47 @@ struct accl_rt {
   // ACCL_RT_STATS=1 diagnostics: sequencer behavior counters
   std::atomic<uint64_t> stat_passes{0}, stat_parks{0}, stat_park_ns{0},
       stat_seek_miss{0}, stat_seek_hit{0};
+
+  // Device-resident trace ring (ACCL_RT_TRACE=1): one accl_rt_span_t
+  // per completed call, fixed capacity (ACCL_RT_TRACE_CAP, default
+  // 4096). Overflow drops the OLDEST span and counts it — tracing can
+  // degrade under load but never blocks or crashes the data plane. The
+  // perf-counter-next-to-the-data-plane posture of the CCLO's duration
+  // registers, with the host draining after the fact
+  // (accl_rt_trace_read -> emu_device.EmuRank.trace_read).
+  bool trace_on = false;
+  size_t trace_cap = 4096;
+  std::deque<accl_rt_span_t> trace_q;
+  uint64_t trace_dropped = 0;
+  std::mutex trace_mu;
+  std::chrono::steady_clock::time_point t_create =
+      std::chrono::steady_clock::now();
+
+  void record_span(const Call &c, uint32_t rc) {
+    accl_rt_span_t s{};
+    s.opcode = c.desc[0];
+    s.retcode = rc;
+    s.detail = c.trace_detail;
+    s.count = c.desc[1];
+    s.bytes = (uint64_t)c.desc[1] * dtype_bytes(c.dtype);
+    auto ns_since = [&](std::chrono::steady_clock::time_point t) {
+      return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+                 t - t_create)
+          .count();
+    };
+    s.start_ns = ns_since(c.t_start);
+    s.end_ns = ns_since(std::chrono::steady_clock::now());
+    s.d_passes = stat_passes.load() - c.ctr0[0];
+    s.d_parks = stat_parks.load() - c.ctr0[1];
+    s.d_seek_hit = stat_seek_hit.load() - c.ctr0[2];
+    s.d_seek_miss = stat_seek_miss.load() - c.ctr0[3];
+    std::lock_guard<std::mutex> g(trace_mu);
+    if (trace_q.size() >= trace_cap) {
+      trace_q.pop_front();  // oldest spans yield to fresh ones
+      trace_dropped++;
+    }
+    trace_q.push_back(s);
+  }
 
   // ACCL_RT_SHAPE=ring|logp overrides the hop-shape auto rule for
   // allreduce/allgather (0 auto, 1 ring, 2 recursive halving/doubling):
@@ -1286,10 +1342,30 @@ struct accl_rt {
           for (int waited = 0; waited < fault_delay_tail_ms && !stop.load();
                waited += 10)
             std::this_thread::sleep_for(std::chrono::milliseconds(10));
-          if (!stop.load())
+          if (!stop.load()) {
+            // delivery-time wire-order assert: the arming contract says
+            // nothing else advances this link while the tail is in
+            // flight (egr_send aborts new eager traffic to dst above).
+            // outbound_seq[dst] past seqn+1 here means a misconfigured
+            // fault test already reordered the wire — fail fast and
+            // loudly instead of delivering a tail that silently
+            // corrupts the stream. The read is ordered by the
+            // fault_tail_pending release/acquire pair: any egr_send
+            // that could advance the counter observes pending==true
+            // first and aborts, so a racing write cannot exist.
+            if (outbound_seq[dst] != seqn + 1) {
+              fprintf(stderr,
+                      "[r%u] FATAL: ACCL_RT_FAULT_DELAY_TAIL_MS wire-order "
+                      "violation at delivery: outbound_seq[r%u]=%u advanced "
+                      "past the delayed tail seqn=%u before the helper "
+                      "thread delivered it\n",
+                      rank, dst, outbound_seq[dst], seqn);
+              abort();
+            }
             frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, payload.data(),
                       seg, /*host=*/0, /*msg_bytes=*/bytes,
                       /*msg_off=*/off);
+          }
           fault_tail_pending.store(false, std::memory_order_release);
         });
         return NO_ERROR;
@@ -1358,7 +1434,7 @@ struct accl_rt {
     if (!(tag == TAG_ANY || s.tag == tag || s.tag == TAG_ANY)) {
       if (strict_tag) {
         if (!head_is_claimable()) return DMA_TAG_MISMATCH_ERROR;
-        note_defer_locked(s, tag, want_msg);
+        note_defer_locked(s, tag, want_msg, DMA_TAG_MISMATCH_ERROR);
         return NOT_READY;
       }
       return NOT_READY;
@@ -1375,7 +1451,7 @@ struct accl_rt {
     if (msg_start && (s.msg_bytes != want_msg || s.msg_off != 0)) {
       if (strict_tag) {
         if (!head_is_claimable()) return DMA_SIZE_ERROR;
-        note_defer_locked(s, tag, want_msg);
+        note_defer_locked(s, tag, want_msg, DMA_SIZE_ERROR);
         return NOT_READY;
       }
       return NOT_READY;
@@ -2383,6 +2459,8 @@ struct accl_rt {
       c.deadline = std::chrono::steady_clock::now() +
                    std::chrono::milliseconds(timeout_ms);
       c.deadline_set = true;
+      std::lock_guard<std::mutex> g(rx_mu);
+      c.defer0 = last_defer.count;
     }
     uint32_t step_before = c.current_step;
     uint64_t off_before = c.cstate->off;
@@ -2404,21 +2482,33 @@ struct accl_rt {
           // a strict-recv head mismatch softened into a defer
           // (head_is_claimable) is the likeliest cause of an otherwise
           // bare timeout: echo the recorded mismatch so the protocol
-          // fault stays diagnosable
+          // fault stays diagnosable. Gated on defers recorded DURING
+          // this call (> defer0) — an earlier call's resolved deferral
+          // must not be reported as this timeout's cause.
           std::lock_guard<std::mutex> g(rx_mu);
-          if (last_defer.count)
+          if (last_defer.count > c.defer0) {
             fprintf(stderr,
                     "[r%u] RECEIVE_TIMEOUT detail scenario=%u step=%u: "
                     "%llu deferred head mismatch(es); last from r%u "
                     "head(tag=%u seqn=%u msg=%llu off=%llu) vs "
-                    "wanted(tag=%u msg=%llu)\n",
+                    "wanted(tag=%u msg=%llu) fault=%s(0x%x)\n",
                     rank, c.desc[0], c.current_step,
                     (unsigned long long)last_defer.count, last_defer.src,
                     last_defer.head_tag, last_defer.head_seqn,
                     (unsigned long long)last_defer.head_msg,
                     (unsigned long long)last_defer.head_off,
                     last_defer.want_tag,
-                    (unsigned long long)last_defer.want_msg);
+                    (unsigned long long)last_defer.want_msg,
+                    last_defer.code == DMA_TAG_MISMATCH_ERROR
+                        ? "DMA_TAG_MISMATCH"
+                        : last_defer.code == DMA_SIZE_ERROR
+                              ? "DMA_SIZE_ERROR"
+                              : "NONE",
+                    last_defer.code);
+            // the span drained through accl_rt_trace_read carries the
+            // original fault code alongside the RECEIVE_TIMEOUT retcode
+            c.trace_detail = last_defer.code;
+          }
         }
         revoke_call_postings(c);
         return RECEIVE_TIMEOUT_ERROR;
@@ -2719,6 +2809,7 @@ struct accl_rt {
       // terminal (success OR error): any stream ownership this call holds
       // must not outlive it — its CollState is about to be destroyed
       if (c.cstate) release_rx_ownership(c.cstate.get());
+      if (trace_on) record_span(c, rc);
       auto dur = std::chrono::steady_clock::now() - c.t_start;
       if (comm_serialized(c.desc[0])) {
         // release the communicator's serialization slot: a deferred
@@ -2775,6 +2866,12 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     rt->fault_delay_tail_ms = atoi(s);
   if (const char *s = getenv("ACCL_RT_FAULT_DROP_TAIL"))
     rt->fault_drop_tail = atoi(s) != 0;
+  if (const char *s = getenv("ACCL_RT_TRACE"))
+    rt->trace_on = atoi(s) != 0;
+  if (const char *s = getenv("ACCL_RT_TRACE_CAP")) {
+    long cap = atol(s);
+    if (cap > 0) rt->trace_cap = (size_t)cap;
+  }
 
   if (transport == ACCL_RT_TRANSPORT_LOCAL) {
     // intra-process POE: no sockets, no rx threads — the sender's
@@ -3004,6 +3101,14 @@ int64_t accl_rt_start(accl_rt_t *rt, const uint32_t desc[15],
   c.op1 = op1;
   c.res = res;
   c.t_start = std::chrono::steady_clock::now();
+  if (rt->trace_on) {
+    // counter-snapshot base for the span's per-call deltas (global
+    // sequencer activity over this call's lifetime)
+    c.ctr0[0] = rt->stat_passes.load();
+    c.ctr0[1] = rt->stat_parks.load();
+    c.ctr0[2] = rt->stat_seek_hit.load();
+    c.ctr0[3] = rt->stat_seek_miss.load();
+  }
   int64_t h;
   {
     std::lock_guard<std::mutex> lk(rt->comp_mu);
@@ -3075,6 +3180,19 @@ void accl_rt_get_stats(accl_rt_t *rt, uint64_t out[5]) {
 
 void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value) {
   rt->wr(addr, value);
+}
+
+// Drain the device-resident trace ring, oldest first (see acclrt.h).
+size_t accl_rt_trace_read(accl_rt_t *rt, accl_rt_span_t *out, size_t cap,
+                          uint64_t *dropped) {
+  std::lock_guard<std::mutex> g(rt->trace_mu);
+  if (dropped) *dropped = rt->trace_dropped;
+  size_t n = 0;
+  while (n < cap && !rt->trace_q.empty()) {
+    out[n++] = rt->trace_q.front();
+    rt->trace_q.pop_front();
+  }
+  return n;
 }
 
 // Snapshot of the eager rx ring (the reference's dump_eager_rx_buffers,

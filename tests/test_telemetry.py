@@ -1,0 +1,507 @@
+"""Telemetry subsystem tests: the native trace ring (including under
+wire faults), the host tracer, the Chrome/Perfetto export + event
+schema, and the measured-vs-predicted feedback loop.
+
+The native-ring fault cases are the satellite-4 coverage: a wedged
+call's span must carry its retcode AND the deferred-head-mismatch fault
+code the RECEIVE_TIMEOUT detail surfaces (runtime.cpp note_defer_locked
+-> execute timeout path -> record_span), and ring overflow must drop
+the OLDEST spans, count them, and never crash the data plane.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, CallOptions, ReduceFunction
+from accl_tpu.constants import (
+    CfgFunc,
+    ErrorCode,
+    Operation,
+    from_numpy_dtype,
+    logp_allgather_max_bytes,
+    logp_allreduce_max_bytes,
+)
+from accl_tpu.device.emu_device import EmuWorld
+from accl_tpu import telemetry
+from accl_tpu.telemetry import native as tnative
+from accl_tpu.telemetry.tracer import Tracer
+
+F32 = from_numpy_dtype(np.dtype(np.float32))
+RNG = np.random.default_rng(42)
+
+
+@pytest.fixture
+def fault_env(monkeypatch):
+    """Set/clear native-runtime env levers around one test (read at
+    runtime creation)."""
+    def set_env(**kv):
+        for k, v in kv.items():
+            monkeypatch.setenv(k, str(v))
+    yield set_env
+
+
+@pytest.fixture
+def tracer():
+    """A fresh, enabled, process-global tracer; restored after."""
+    tr = telemetry.get_tracer()
+    was = tr.enabled
+    tr.clear()
+    tr.enable()
+    yield tr
+    tr.clear()
+    if not was:
+        tr.disable()
+
+
+# ---------------------------------------------------------------------------
+# native trace ring
+# ---------------------------------------------------------------------------
+
+
+def test_native_ring_records_completed_calls(fault_env):
+    """Every completed call lands one span: opcode, bytes, monotonic
+    start/end, retcode 0, and counter deltas. Tracing off (the default)
+    records nothing."""
+    fault_env(ACCL_RT_TRACE=1)
+    w = EmuWorld(2, max_eager=4096, rx_buf_bytes=4096)
+    try:
+        def body(rank, i):
+            x = np.ones(512, np.float32)
+            out = np.zeros(512, np.float32)
+            rank.allreduce(x, out, 512, ReduceFunction.SUM)
+            rank.bcast(x, 512, root=0)
+        w.run(body)
+        spans, dropped = w.ranks[0].trace_read()
+    finally:
+        w.close()
+    assert dropped == 0
+    ops = [s["opcode"] for s in spans]
+    assert int(Operation.allreduce) in ops and int(Operation.bcast) in ops
+    ar = spans[ops.index(int(Operation.allreduce))]
+    assert ar["retcode"] == 0 and ar["detail"] == 0
+    assert ar["bytes"] == 512 * 4 and ar["count"] == 512
+    assert ar["end_ns"] > ar["start_ns"]
+    assert ar["d_passes"] >= 1  # at least one execute pass happened
+
+
+def test_native_ring_disabled_is_empty():
+    w = EmuWorld(2, max_eager=4096, rx_buf_bytes=4096)
+    try:
+        def body(rank, i):
+            rank.barrier()
+        w.run(body)
+        spans, dropped = w.ranks[0].trace_read()
+    finally:
+        w.close()
+    assert spans == [] and dropped == 0
+
+
+def test_native_ring_overflow_drops_oldest_never_crashes(fault_env):
+    """Satellite-4 overflow case: with a 4-slot ring and 10 completed
+    copies, the drop counter says 6, exactly 4 spans survive, and they
+    are the NEWEST 4 (oldest dropped first)."""
+    fault_env(ACCL_RT_TRACE=1, ACCL_RT_TRACE_CAP=4)
+    w = EmuWorld(2, max_eager=4096, rx_buf_bytes=4096)
+    try:
+        r0 = w.ranks[0]
+        src = np.arange(16, dtype=np.float32)
+        dst = np.zeros(16, np.float32)
+        for k in range(10):
+            r0.copy(src, dst, k + 1)  # count encodes the call's index
+        spans, dropped = r0.trace_read()
+    finally:
+        w.close()
+    assert dropped == 6
+    assert len(spans) == 4
+    # oldest-first drain of the newest four calls (counts 7, 8, 9, 10)
+    assert [s["count"] for s in spans] == [7, 8, 9, 10]
+
+
+def test_wedged_call_span_carries_retcode_and_fault_counters(fault_env):
+    """Satellite 4 x ACCL_RT_FAULT_*: a recv that dies mid-message
+    (delayed tail outlives its deadline) must complete with
+    RECEIVE_TIMEOUT and its span must carry that retcode plus the
+    park-heavy counter signature of the wedge."""
+    fault_env(ACCL_RT_TRACE=1, ACCL_RT_FAULT_DELAY_TAIL_MS=700)
+    rx_buf = 256
+    count = (3 * rx_buf) // 4  # 3 wire segments
+    m1 = RNG.standard_normal(count).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf)
+    try:
+        def body(rank, i):
+            import time
+
+            if i == 1:
+                rank.send(m1.copy(), count, dst=0, tag=5)  # tail delayed
+                time.sleep(1.0)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=300))
+            buf = np.zeros(count, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.recv, count=count,
+                                       root_src_dst=1, tag=5,
+                                       data_type=F32), res=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            return None
+
+        w.run(body)
+        spans, _ = w.ranks[0].trace_read()
+    finally:
+        w.close()
+    recvs = [s for s in spans if s["opcode"] == int(Operation.recv)]
+    assert len(recvs) == 1
+    wedged = recvs[0]
+    assert wedged["retcode"] & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    # the wedge parked the sequencer while waiting on the delayed tail
+    assert wedged["d_parks"] >= 1
+    assert wedged["end_ns"] - wedged["start_ns"] >= 250e6  # ~the deadline
+
+
+def test_wedged_span_carries_deferred_mismatch_detail(fault_env):
+    """Satellite 4 x satellite 1: a strict collective recv meeting a
+    young MISMATCHED head (another message's head on the same link)
+    defers (NOT_READY) instead of erroring; when the call then times
+    out, its span must carry the RECEIVE_TIMEOUT retcode AND the
+    original fault code the mismatch would have raised
+    (DMA_SIZE_ERROR here: message-length mismatch)."""
+    fault_env(ACCL_RT_TRACE=1)
+    c_p2p, c_bcast = 256, 128  # different msg_bytes on the same link
+    w = EmuWorld(2, max_eager=4096, rx_buf_bytes=4096)
+    try:
+        def body(rank, i):
+            if i == 1:
+                # the p2p head lands first on r0's link; the bcast
+                # payload queues behind it at the next seqns
+                rank.send(np.ones(c_p2p, np.float32), c_p2p, dst=0, tag=9)
+                rank.bcast(np.ones(c_bcast, np.float32), c_bcast, root=1)
+                return None
+            # timeout (150 ms) well inside the claimable-head grace
+            # window (250 ms): every pass defers on the mismatched
+            # young head, then the deadline converts the defer into
+            # RECEIVE_TIMEOUT (a pass landing past the grace window
+            # would fail fast with DMA_SIZE_ERROR instead — the margin
+            # keeps a starved CI scheduler from flipping the outcome)
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=150))
+            buf = np.zeros(c_bcast, np.float32)
+            h = rank.start(CallOptions(scenario=Operation.bcast,
+                                       count=c_bcast, root_src_dst=1,
+                                       data_type=F32), op0=buf)
+            with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                rank.wait(h)
+            return None
+
+        w.run(body)
+        spans, _ = w.ranks[0].trace_read()
+    finally:
+        w.close()
+    bcasts = [s for s in spans if s["opcode"] == int(Operation.bcast)]
+    assert len(bcasts) == 1
+    wedged = bcasts[0]
+    assert wedged["retcode"] & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    assert wedged["detail"] == int(ErrorCode.DMA_SIZE_ERROR)
+
+
+# ---------------------------------------------------------------------------
+# native span lifting (telemetry.native)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_world_attaches_plans_and_predictions(fault_env):
+    fault_env(ACCL_RT_TRACE=1)
+    from accl_tpu.sequencer.timing import LinkParams
+
+    link = LinkParams(alpha=1e-5, beta=1e9)
+    w = EmuWorld(4, max_eager=4096, rx_buf_bytes=4096)
+    try:
+        def body(rank, i):
+            x = np.ones(1024, np.float32)
+            out = np.zeros(1024, np.float32)
+            rank.allreduce(x, out, 1024, ReduceFunction.SUM)
+        w.run(body)
+        events, dropped = tnative.drain_world(w, link=link)
+    finally:
+        w.close()
+    assert dropped == 0
+    assert {e["track"] for e in events} == {f"emu/r{r}" for r in range(4)}
+    for e in events:
+        args = e["args"]
+        assert args["algorithm"] == "EAGER_RING_RS_AG"
+        assert args["coef_messages"] > 0 and args["coef_bytes"] > 0
+        assert args["predicted_s"] == pytest.approx(
+            link.seconds(args["coef_messages"], args["coef_bytes"]))
+        assert args["measured_s"] > 0
+
+
+def test_aggregate_wire_gbps_reflects_total_volume():
+    """The aggregate column charges schedule volume, not payload: an
+    8-world eager-ring allreduce moves ~2n(P-1) bytes, so at equal
+    (payload, seconds) its aggregate bandwidth is far above payload/s."""
+    nbytes, world, secs = 1 << 20, 8, 0.01
+    agg = tnative.aggregate_wire_gbps("allreduce", nbytes, world, secs)
+    payload = nbytes / secs / 1e9
+    assert agg > 5 * payload
+
+
+# ---------------------------------------------------------------------------
+# host tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_disabled_span_is_noop_singleton():
+    tr = Tracer(enabled=False)
+    s1 = tr.span("a", cat="call", track="x")
+    s2 = tr.span("b", cat="phase", track="y")
+    assert s1 is s2  # the shared null span: no allocation when off
+    with s1 as sp:
+        sp.set(anything=1)
+    assert tr.snapshot() == []
+
+
+def test_tracer_ring_drops_oldest_and_counts():
+    tr = Tracer(capacity=3, enabled=True)
+    for i in range(5):
+        tr.emit(f"s{i}", "call", "t", ts_ns=i, dur_ns=1, args={})
+    assert tr.drops == 2
+    assert [s["name"] for s in tr.snapshot()] == ["s2", "s3", "s4"]
+
+
+def test_tracer_span_measures_and_attaches_args():
+    tr = Tracer(enabled=True)
+    with tr.span("op", cat="call", track="facade", count=4) as sp:
+        sp.set(algorithm="RING")
+    (ev,) = tr.drain()
+    assert ev["name"] == "op" and ev["cat"] == "call"
+    assert ev["dur_ns"] >= 0
+    assert ev["args"] == {"count": 4, "algorithm": "RING"}
+
+
+def test_tracer_span_records_exception_and_propagates():
+    tr = Tracer(enabled=True)
+    with pytest.raises(ValueError):
+        with tr.span("bad", cat="phase", track="t"):
+            raise ValueError("x")
+    (ev,) = tr.drain()
+    assert ev["args"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# export: schema + chrome
+# ---------------------------------------------------------------------------
+
+
+def _mini_trace():
+    tr = Tracer(enabled=True)
+    tr.emit("allreduce", "native", "emu/r0", ts_ns=10, dur_ns=100,
+            args={"op": "allreduce", "coef_messages": 2.0,
+                  "coef_bytes": 1000.0, "measured_s": 1e-3,
+                  "predicted_s": 2e-3, "retcode": 0})
+    tr.emit("lint", "phase", "device", ts_ns=5, dur_ns=0, args={})
+    return tr.to_trace({"world": 2})
+
+
+def test_schema_accepts_valid_and_rejects_drift():
+    jsonschema = pytest.importorskip("jsonschema")
+    trace = _mini_trace()
+    telemetry.validate_trace(trace)
+    bad = json.loads(json.dumps(trace))
+    bad["spans"][0]["cat"] = "mystery"  # unknown category
+    with pytest.raises(jsonschema.ValidationError):
+        telemetry.validate_trace(bad)
+    bad2 = json.loads(json.dumps(trace))
+    del bad2["spans"][0]["ts_ns"]  # missing required field
+    with pytest.raises(jsonschema.ValidationError):
+        telemetry.validate_trace(bad2)
+    bad3 = json.loads(json.dumps(trace))
+    bad3["spans"][0]["args"]["predicted_s"] = "fast"  # wrong type
+    with pytest.raises(jsonschema.ValidationError):
+        telemetry.validate_trace(bad3)
+
+
+def test_chrome_export_one_named_track_per_rank():
+    trace = _mini_trace()
+    chrome = telemetry.to_chrome(trace)
+    metas = [e for e in chrome["traceEvents"] if e["ph"] == "M"]
+    xs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in metas} == {"emu/r0", "device"}
+    assert len(xs) == 2
+    # zero-duration phase span stretched to stay clickable
+    assert all(e["dur"] > 0 for e in xs)
+    # args ride through verbatim for the Perfetto detail pane
+    ar = next(e for e in xs if e["name"] == "allreduce")
+    assert ar["args"]["coef_messages"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# feedback loop
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_trace(alpha=1e-4, beta=1e9, n=12, skew=1.0):
+    tr = Tracer(enabled=True)
+    for k in range(n):
+        m = float(2 + k)
+        b = float(1 << (12 + k % 8))
+        t = (alpha * m + b / beta) * skew
+        tr.emit("allreduce", "native", f"emu/r{k % 4}", ts_ns=k,
+                dur_ns=int(t * 1e9),
+                args={"coef_messages": m, "coef_bytes": b,
+                      "measured_s": t})
+    return tr.to_trace()
+
+
+def test_calibrate_from_trace_recovers_link():
+    trace = _synthetic_trace(alpha=1e-4, beta=1e9)
+    link = telemetry.calibrate_from_trace(trace)
+    assert link.alpha == pytest.approx(1e-4, rel=0.05)
+    assert link.beta == pytest.approx(1e9, rel=0.05)
+
+
+def test_calibrate_from_trace_rejects_span_free_trace():
+    tr = Tracer(enabled=True)
+    tr.emit("lint", "phase", "device", ts_ns=0, dur_ns=5, args={})
+    with pytest.raises(ValueError, match="calibratable"):
+        telemetry.calibrate_from_trace(tr.to_trace())
+
+
+def test_residual_improvement_refit_beats_wrong_default():
+    from accl_tpu.sequencer.timing import LinkParams
+
+    trace = _synthetic_trace(alpha=1e-4, beta=1e9)
+    wrong = LinkParams(alpha=1e-5, beta=4e9)
+    out = telemetry.residual_improvement(trace, default=wrong)
+    assert out["improved"]
+    assert out["median_rel_err_refit"] < out["median_rel_err_default"]
+
+
+def test_autotune_from_trace_applies_registers(mesh8):
+    """The loop closes into the device: autotune_from_trace refits from
+    the trace and writes the tuning registers the executors consult."""
+    from accl_tpu.accl import ACCL
+
+    accl = ACCL(mesh8)
+    trace = _synthetic_trace(alpha=5e-4, beta=0.5e9)
+    tuning = telemetry.autotune_from_trace(accl, trace)
+    assert accl.cclo.tuning().bcast_flat_tree_max_ranks == \
+        tuning.bcast_flat_tree_max_ranks
+    assert tuning.reduce_flat_tree_max_count >= 1
+
+
+# ---------------------------------------------------------------------------
+# facade + sequence emission (the host half of the tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_facade_and_sequence_spans(tracer, mesh8):
+    from accl_tpu.accl import ACCL
+
+    accl = ACCL(mesh8)
+    n = 8192
+    chunk = n // 8
+    a = accl.create_buffer(n, data=RNG.standard_normal((8, n))
+                           .astype(np.float32))
+    b = accl.create_buffer(chunk)
+    c = accl.create_buffer(n)
+    accl.allreduce(a, c, n, ReduceFunction.SUM)
+    with accl.sequence() as seq:
+        seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+        seq.allgather(b, c, chunk)
+    spans = tracer.snapshot()
+    by_cat: dict = {}
+    for s in spans:
+        by_cat.setdefault(s["cat"], []).append(s)
+
+    # eager call span with plan + prediction
+    call = next(s for s in by_cat["call"] if s["name"] == "allreduce")
+    assert call["args"]["algorithm"] == "EAGER_RING_RS_AG"
+    assert call["args"]["predicted_s"] > 0
+    assert call["dur_ns"] > 0
+
+    # the record -> lint -> compile -> dispatch pipeline, one signature
+    phases = {s["name"] for s in by_cat["phase"]}
+    assert {"record", "lint", "compile", "dispatch"} <= phases
+    sigs = {s["args"]["signature"] for s in by_cat["phase"]}
+    assert len(sigs) == 1
+
+    # per-step markers carry step index, op, and the predict estimate
+    steps = sorted(by_cat["step"], key=lambda s: s["args"]["step"])
+    assert [s["args"]["op"] for s in steps] == ["reduce_scatter",
+                                               "allgather"]
+    assert all(s["args"]["signature"] in sigs for s in steps)
+    assert all(s["args"]["predicted_s"] > 0 for s in steps)
+
+    # the sequence span ties it together and sums the step predictions
+    (seq_span,) = by_cat["sequence"]
+    assert seq_span["args"]["n_steps"] == 2
+    assert seq_span["args"]["signature"] in sigs
+    assert seq_span["args"]["predicted_s"] == pytest.approx(
+        sum(s["args"]["predicted_s"] for s in steps))
+
+    # the whole thing round-trips the event schema and the exporter
+    trace = tracer.to_trace()
+    telemetry.validate_trace(trace)
+    chrome = telemetry.to_chrome(trace)
+    assert {m["args"]["name"]
+            for m in chrome["traceEvents"] if m["ph"] == "M"} == \
+        {"facade", "device"}
+
+
+def test_tracing_off_emits_nothing(mesh8):
+    from accl_tpu.accl import ACCL
+
+    tr = telemetry.get_tracer()
+    tr.clear()
+    assert not tr.enabled  # the default; fault_env never leaks it on
+    accl = ACCL(mesh8)
+    n = 1024
+    a = accl.create_buffer(n)
+    c = accl.create_buffer(n)
+    accl.allreduce(a, c, n, ReduceFunction.SUM)
+    assert tr.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the logp crossovers are single-sourced
+# ---------------------------------------------------------------------------
+
+
+def test_logp_crossovers_single_sourced():
+    """timing._logp_* must flip exactly at constants.logp_*_max_bytes —
+    the same arithmetic runtime.cpp compiles (hops_saved * HOP_BYTES
+    with bit-scan log2) — so a retune of the constants moves model and
+    executor together."""
+    from accl_tpu.sequencer.timing import _logp_allgather, _logp_allreduce
+
+    for world in (2, 4, 8, 16, 32, 64):
+        ar_cross = logp_allreduce_max_bytes(world)
+        assert _logp_allreduce(world, ar_cross)
+        assert not _logp_allreduce(world, ar_cross + 1)
+        ag_cross = logp_allgather_max_bytes(world)
+        assert _logp_allgather(world, ag_cross)
+        assert not _logp_allgather(world, ag_cross + 1)
+    # non-power-of-two worlds never take the logp shape
+    from accl_tpu.sequencer.timing import _logp_allreduce as f
+
+    assert not f(6, 1)
+
+
+def test_logp_crossover_formula_pinned_to_native_source():
+    """The C++ rule bodies must use the same hops-saved formulas the
+    Python single source encodes (the definition pin in test_timing.py
+    covers the HOP_BYTES values; this pins the SHAPE)."""
+    import pathlib
+
+    src = (pathlib.Path(__file__).parent.parent / "native" / "src"
+           / "runtime.cpp").read_text()
+    assert "2 * (world - 1) - 2 * log2_floor(world)" in src
+    assert "(world - 1) - log2_floor(world)" in src
+    # and the Python source delegates to constants, not local math
+    tsrc = (pathlib.Path(__file__).parent.parent / "accl_tpu"
+            / "sequencer" / "timing.py").read_text()
+    assert "logp_allreduce_max_bytes(world)" in tsrc
+    assert "logp_allgather_max_bytes(world)" in tsrc

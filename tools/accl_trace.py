@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""Trace exporter / validator CLI for the telemetry subsystem.
+
+Thin client of accl_tpu.telemetry: takes a SPAN v1 trace document
+(bench.py --trace writes accl_log/trace.json) and
+
+  --validate            check it against the jsonschema event contract
+                        (telemetry.export.EVENT_SCHEMA)
+  --chrome OUT          export Chrome trace-event JSON (Perfetto /
+                        chrome://tracing loadable, one track per
+                        rank/executor)
+  --residuals           print the predicted-vs-measured residual table
+                        and the default-vs-refit calibration summary
+  --selftest            run the full contract against the COMMITTED
+                        golden trace (accl_log/golden_trace.json):
+                        schema validation, Chrome conversion structure,
+                        and the feedback-loop invariant (refit link
+                        beats the golden trace's embedded default) —
+                        the CI telemetry step runs this so the schema
+                        and the emitters cannot drift apart silently
+  --make-golden         regenerate the golden trace (deterministic
+                        synthetic spans; run after an intentional
+                        schema change and commit the result)
+
+Exit code 0 = every requested check passed.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+GOLDEN = REPO / "accl_log" / "golden_trace.json"
+
+
+def make_golden() -> dict:
+    """Deterministic synthetic trace exercising every span category the
+    emitters produce: facade calls, sequence + phases + steps, and
+    native per-rank spans whose measurements follow a known link
+    (alpha=120us, beta=0.8 GB/s) with deterministic multiplicative
+    skew — so calibrate_from_trace provably recovers a better fit than
+    the 'shipped default' embedded in meta."""
+    from accl_tpu.telemetry.tracer import SCHEMA_VERSION
+
+    spans = []
+    t = 1_000_000
+    # facade call + sequence machinery spans
+    spans.append({"name": "allreduce", "cat": "call", "track": "facade",
+                  "ts_ns": t, "dur_ns": 2_000_000,
+                  "args": {"op": "allreduce", "count": 4096,
+                           "algorithm": "EAGER_RING_RS_AG",
+                           "predicted_s": 0.0019, "retcode": 0}})
+    sig = "deadbeefcafef00d"
+    for name, dur in (("record", 50_000), ("lint", 400_000),
+                      ("compile", 3_000_000), ("dispatch", 1_500_000)):
+        t += 100_000
+        spans.append({"name": name, "cat": "phase", "track": "device",
+                      "ts_ns": t, "dur_ns": dur,
+                      "args": {"signature": sig}})
+    for i, op in enumerate(("reduce_scatter", "allgather")):
+        spans.append({"name": f"step{i}:{op}", "cat": "step",
+                      "track": "device", "ts_ns": t, "dur_ns": 0,
+                      "args": {"op": op, "step": i, "signature": sig,
+                               "predicted_s": 0.001 * (i + 1)}})
+    spans.append({"name": "sequence", "cat": "sequence", "track": "facade",
+                  "ts_ns": t, "dur_ns": 6_000_000,
+                  "args": {"n_steps": 2, "signature": sig,
+                           "predicted_s": 0.003}})
+    # native spans: measured = true_link(m, b) * skew, skew cycling over
+    # a fixed pattern; the golden default is deliberately off by 2x beta
+    alpha, beta = 120e-6, 0.8e9
+    default = {"alpha_us": 40.0, "beta_gbps": 2.4}
+    skews = (0.9, 1.0, 1.1, 1.05, 0.95)
+    k = 0
+    for rank in range(4):
+        t0 = 2_000_000
+        for m, b in ((8.0, 65536.0), (16.0, 262144.0), (32.0, 2097152.0),
+                     (64.0, 8388608.0)):
+            true_s = alpha * m + b / beta
+            meas = true_s * skews[k % len(skews)]
+            k += 1
+            dur = int(meas * 1e9)
+            spans.append({
+                "name": "allreduce", "cat": "native",
+                "track": f"emu/r{rank}", "ts_ns": t0, "dur_ns": dur,
+                "args": {"op": "allreduce", "count": int(b // 4),
+                         "bytes": int(b), "world": 4, "rank": rank,
+                         "retcode": 0, "detail": 0,
+                         "measured_s": meas,
+                         "coef_messages": m, "coef_bytes": b,
+                         "predicted_s": default["alpha_us"] * 1e-6 * m
+                         + b / (default["beta_gbps"] * 1e9),
+                         "d_passes": 4, "d_parks": 3,
+                         "d_seek_hit": 4, "d_seek_miss": 3}})
+            t0 += dur + 50_000
+    return {"schema": SCHEMA_VERSION,
+            "meta": {"golden": True, "drops": 0,
+                     "default_link": default},
+            "spans": spans}
+
+
+def cmd_validate(trace: dict) -> None:
+    from accl_tpu.telemetry import validate_trace
+
+    validate_trace(trace)
+    print(f"schema OK: {len(trace['spans'])} spans, "
+          f"{len({s['track'] for s in trace['spans']})} tracks")
+
+
+def cmd_chrome(trace: dict, out: str) -> None:
+    from accl_tpu.telemetry import to_chrome
+
+    chrome = to_chrome(trace)
+    pathlib.Path(out).write_text(json.dumps(chrome, indent=1))
+    print(f"wrote {out} ({len(chrome['traceEvents'])} events)")
+
+
+def cmd_residuals(trace: dict) -> None:
+    from accl_tpu.telemetry import residual_report
+
+    report = residual_report(trace)
+    sr = report["span_residuals"]
+    print(f"spans with predictions: {sr['rows']}  "
+          f"median |pred-meas|/meas: {sr['median_rel_err']:.3f}")
+    for op, err in sr["per_op_median_rel_err"].items():
+        print(f"  {op:20s} {err:.3f}")
+    cal = report["calibration"]
+    if "error" in cal:
+        print(f"calibration: {cal['error']}")
+    else:
+        print(f"calibration over {cal['samples']} samples: refit alpha "
+              f"{cal['refit']['alpha_us']:.1f} us beta "
+              f"{cal['refit']['beta_gbps']:.3f} GB/s -> median rel err "
+              f"{cal['median_rel_err_refit']:.3f}"
+              + (f" (default {cal['median_rel_err_default']:.3f}, "
+                 f"improved={cal['improved']})"
+                 if "median_rel_err_default" in cal else ""))
+
+
+def cmd_selftest() -> int:
+    """The committed-golden contract: schema, Chrome structure, residual
+    machinery, and the feedback-loop invariant."""
+    from accl_tpu.sequencer.timing import LinkParams
+    from accl_tpu.telemetry import (calibrate_from_trace, residual_rows,
+                                    to_chrome, validate_trace)
+    from accl_tpu.telemetry.export import median
+    from accl_tpu.telemetry.feedback import _rel_errs
+
+    if not GOLDEN.exists():
+        print(f"FAIL: no committed golden trace at {GOLDEN}",
+              file=sys.stderr)
+        return 1
+    trace = json.loads(GOLDEN.read_text())
+    validate_trace(trace)
+    chrome = to_chrome(trace)
+    names = [e for e in chrome["traceEvents"] if e["ph"] == "M"]
+    xs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert len(names) == len({s["track"] for s in trace["spans"]}), \
+        "one thread_name metadata event per track"
+    assert len(xs) == len(trace["spans"]), "one X event per span"
+    assert all(e["dur"] > 0 for e in xs), "zero-duration spans stretched"
+    rows = residual_rows(trace)
+    assert rows, "golden trace must carry predicted-vs-measured rows"
+    # feedback-loop invariant: refitting on the golden measurements beats
+    # the deliberately-skewed default link embedded in its meta
+    d = trace["meta"]["default_link"]
+    default = LinkParams(alpha=d["alpha_us"] * 1e-6,
+                         beta=d["beta_gbps"] * 1e9)
+    refit = calibrate_from_trace(trace)
+    e_ref = median(_rel_errs(trace, refit))
+    e_def = median(_rel_errs(trace, default))
+    assert e_ref < e_def, \
+        f"refit {e_ref:.3f} must beat golden default {e_def:.3f}"
+    print(f"selftest OK: {len(trace['spans'])} golden spans, "
+          f"{len(names)} tracks, refit median rel err {e_ref:.3f} < "
+          f"default {e_def:.3f}")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?",
+                    default=str(REPO / "accl_log" / "trace.json"))
+    ap.add_argument("--validate", action="store_true")
+    ap.add_argument("--chrome", metavar="OUT")
+    ap.add_argument("--residuals", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--make-golden", action="store_true")
+    args = ap.parse_args()
+
+    if args.make_golden:
+        from accl_tpu.telemetry import validate_trace
+
+        trace = make_golden()
+        validate_trace(trace)
+        GOLDEN.write_text(json.dumps(trace, indent=1))
+        print(f"wrote {GOLDEN} ({len(trace['spans'])} spans)")
+        return 0
+    if args.selftest:
+        return cmd_selftest()
+
+    trace = json.loads(pathlib.Path(args.trace).read_text())
+    ran = False
+    if args.validate or not (args.chrome or args.residuals):
+        cmd_validate(trace)
+        ran = True
+    if args.chrome:
+        cmd_chrome(trace, args.chrome)
+        ran = True
+    if args.residuals:
+        cmd_residuals(trace)
+        ran = True
+    return 0 if ran else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,11 +19,16 @@ import numpy as np
 REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
-# THE eager/rx geometry of the emulator sweep, single-sourced: the
-# in-file protocol labeler, the EmuWorld bring-up, and the timing-model
-# calibration (tools/timing_model.py) must all agree or rows near the
-# eager/rendezvous boundary get mislabeled / misfitted silently.
-MAX_EAGER = RX_BUF = 4096
+# THE eager/rx geometry of the emulator sweep, single-sourced in
+# accl_tpu.telemetry.native: the in-file protocol labeler, the EmuWorld
+# bring-up, the timing-model calibration (tools/timing_model.py), and
+# the telemetry re-planning (span_cost / aggregate_wire_gbps) must all
+# agree or rows near the eager/rendezvous boundary get mislabeled /
+# misfitted silently.
+from accl_tpu.telemetry.native import (  # noqa: E402
+    DEFAULT_MAX_EAGER as MAX_EAGER,
+    DEFAULT_RX_BUF as RX_BUF,
+)
 MAX_RNDZV = 64 * 1024 * 1024  # passed to EmuWorld AND the skip guard
 
 # Calibration domain of the timing model (tools/timing_model.py):
