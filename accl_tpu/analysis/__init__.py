@@ -18,6 +18,13 @@ diagnostics with stable codes (docs/lint.md has the full table):
   slots.py      overlap-slot collective_id liveness (ACCL301-302)
   validate.py   descriptor structure: roots, counts, dtypes,
                 communicators                  (ACCL401-404)
+  hopdag.py     the hop-DAG IR: schedules as data (send/recv/combine/
+                encode/decode nodes with exact region intervals),
+                executable and mutable — the shared substrate for the
+                semantic certifier, the protocol passes, and future
+                schedule synthesis
+  semantics.py  contribution-set abstract interpretation proving each
+                batch computes its DECLARED collective (ACCL501-504)
   linter.py     the SequenceLinter orchestrator + lint_sequence()
 
 Wired in three places: the opt-out `lint=` stage in `ACCL.sequence()`
@@ -36,6 +43,7 @@ from .modelcheck import (  # noqa: F401
     check_interleavings,
     diagnose_programs,
 )
+from .hopdag import HopDag  # noqa: F401
 from .protocol import (  # noqa: F401
     ANY_SRC,
     Event,
@@ -45,6 +53,15 @@ from .protocol import (  # noqa: F401
     rank_programs_from_options,
     simulate,
     trace_schedule_hops,
+    trace_schedule_jaxpr,
+)
+from .semantics import (  # noqa: F401
+    UnsupportedSchedule,
+    certify,
+    certify_call,
+    check_batch_semantics,
+    collective_spec,
+    lift_call,
 )
 from .slots import (  # noqa: F401
     SlotInstance,

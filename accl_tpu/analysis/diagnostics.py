@@ -8,6 +8,8 @@ same identifiers (docs/lint.md holds the user-facing table):
   ACCL2xx  protocol defects (send/recv matching, deadlock)
   ACCL3xx  overlap-slot / collective_id resource defects
   ACCL4xx  descriptor validation (shape, dtype, root, communicator)
+  ACCL5xx  semantic defects: the batch's final contribution sets differ
+           from the declared collective (semantics.py)
 
 Severity semantics: an `error` is a batch the analyzer can prove wrong
 on SOME shipping executor (stale reads, deadlock, slot cross-talk,
@@ -78,6 +80,19 @@ CODES: dict[str, tuple[str, str, str]] = {
                 "blockwise-quantized wire requested for a payload dtype "
                 "with no quantized lane (or a wire dtype with no "
                 "arithmetic-configuration row)"),
+    "ACCL501": ("wrong-result", "error",
+                "a rank's final contribution set differs from the "
+                "declared collective (misrouted regions, foreign atoms, "
+                "or the wrong reduction)"),
+    "ACCL502": ("partial-contribution", "error",
+                "some rank's input never reaches an output region the "
+                "collective says must include it"),
+    "ACCL503": ("double-count", "error",
+                "a contribution folded into the same non-idempotent "
+                "reduction twice"),
+    "ACCL504": ("stale-read", "error",
+                "a hop forwards a region before its producer wrote it "
+                "(program-order violation in the hop DAG)"),
 }
 
 

@@ -2,20 +2,26 @@
 
 Orchestrates the analysis passes over a recorded descriptor batch —
 structural validation (validate.py), dataflow hazards over the
-canonical renaming (hazards.py), overlap-slot liveness (slots.py), and
-optionally the deep per-rank protocol interpretation (protocol.py) —
-and returns the combined diagnostic list, most severe first.
+canonical renaming (hazards.py), overlap-slot liveness (slots.py), the
+semantic certifier (semantics.py, when per-step Plans are available),
+and optionally the deep per-rank protocol interpretation (protocol.py)
+— and returns the combined diagnostic list, most severe first.
 
 The shallow passes are pure Python over the descriptors (microseconds;
-the bench smoke gate pins them under 5% of record+compile time). The
-deep tier abstractly evaluates every step's schedule body under jax
-tracing (about the cost of a second trace) and then model-checks the
-batch's per-rank hop programs over EVERY legal match order
-(modelcheck.py — ACCL205/206/207, budgeted): it is OFF in the in-band
-default (`lint="error"`), opted into per batch with `lint="deep"`, and
-ON in the corpus CLI (tools/accl_lint.py) and the schedule-conformance
-tests, where its job — proving the shipping schedules deadlock-free
-under all interleavings — earns the cost.
+the bench smoke gate pins the whole default tier under 5% of
+record+compile time). The semantic pass (ACCL501-504) is per-batch
+LINEAR — one contribution-set abstract evaluation per step, verdicts
+cached by static signature — so it rides the DEFAULT tier; only
+pathologically segmented shapes defer to the CLI/CI sweep (see
+semantics._within_inband_budget). The deep tier abstractly evaluates
+every step's schedule body under jax tracing (about the cost of a
+second trace) and then model-checks the batch's per-rank hop programs
+over EVERY legal match order (modelcheck.py — ACCL205/206/207,
+budgeted): it is OFF in the in-band default (`lint="error"`), opted
+into per batch with `lint="deep"`, and ON in the corpus CLI
+(tools/accl_lint.py) and the schedule-conformance tests, where its job
+— proving the shipping schedules deadlock-free under all interleavings
+— earns the cost.
 """
 
 from __future__ import annotations
@@ -98,6 +104,21 @@ class SequenceLinter:
             timeline = ring_slot_timeline(
                 steps, self.world, overlap=self.pallas_ring_overlap)
             diags += check_slots(timeline)
+        if plans is not None and not any(
+                d.severity == "error" for d in diags):
+            # semantic certification (ACCL501-504): per-batch LINEAR —
+            # one contribution-set abstract evaluation per step, cached
+            # by static signature — so it rides the DEFAULT tier, not
+            # just the deep one. Pathologically segmented shapes defer
+            # to the CLI/CI conformance sweep (semantics budget).
+            # Warning-severity findings (WAR/WAW advisories) do NOT
+            # skip it: under lint="error" those batches still dispatch,
+            # so they still need their answer certified.
+            from .semantics import check_batch_semantics
+
+            diags += check_batch_semantics(
+                steps, plans, self.world, self.axis_name,
+                arith_table=self.arith_table)
         if self.deep and plans is not None and not diags:
             from .protocol import (
                 batch_programs_from_hops,

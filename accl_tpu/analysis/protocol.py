@@ -43,6 +43,7 @@ __all__ = [
     "coll",
     "simulate",
     "rank_programs_from_options",
+    "trace_schedule_jaxpr",
     "trace_schedule_hops",
     "rank_programs_from_hops",
     "batch_programs_from_hops",
@@ -395,12 +396,43 @@ def rank_programs_from_options(per_rank) -> list[list[Event]]:
 # ---------------------------------------------------------------------------
 
 
-class _AxisOnlyMesh:
-    """The minimal mesh surface ScheduleCompiler._body consumes (axis
-    size lookup); tracing under make_jaxpr's axis env needs no devices."""
+def trace_schedule_jaxpr(options, plan, world: int,
+                         axis_name: str = "ccl", *,
+                         arith_table: dict | None = None,
+                         semantic_marks: bool = False):
+    """Abstractly evaluate ONE call's schedule body — the REAL
+    lowering-built callable — under jax's axis-env tracing and return
+    `(closed_jaxpr, n_in, in_elems)`. THE tracing seam every jaxpr-level
+    pass shares: the protocol pass reads ppermute perms from it and the
+    semantic certifier lifts its hop DAG from it, so there is exactly
+    one model of what the compiler emits. `semantic_marks=True`
+    activates the compression lanes' named trace boundaries
+    (ops.compression.semantic_boundaries) so the quantized transforms
+    surface as single named equations instead of raw blockwise math."""
+    import contextlib
 
-    def __init__(self, axis_name: str, world: int):
-        self.shape = {axis_name: world}
+    import jax
+    import numpy as np
+
+    from ..constants import DataType, to_numpy_dtype
+    from ..ops.compression import semantic_boundaries
+    from ..sequencer.lowering import analysis_body
+    from ..sequencer.sequence import step_in_elems
+
+    body, n_in = analysis_body(options, plan, world, axis_name,
+                               arith_table=arith_table)
+    if options.scenario == Operation.barrier:
+        avals = [jax.ShapeDtypeStruct((1,), np.float32)]
+    else:
+        elems = step_in_elems(options, world)
+        dtype = (to_numpy_dtype(options.data_type)
+                 if options.data_type != DataType.none else np.float32)
+        avals = [jax.ShapeDtypeStruct((elems,), dtype)] * n_in
+    marks = semantic_boundaries() if semantic_marks \
+        else contextlib.nullcontext()
+    with marks:
+        closed = jax.make_jaxpr(body, axis_env=[(axis_name, world)])(*avals)
+    return closed, n_in, avals[0].shape[-1]
 
 
 def trace_schedule_hops(options, plan, world: int,
@@ -411,29 +443,7 @@ def trace_schedule_hops(options, plan, world: int,
     schedule family expresses the same wire pattern through ppermute,
     which is the surface this pass reads. Hops inside a lax.map/scan
     body appear once (every iteration repeats the same pattern)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from ..constants import DataType, to_numpy_dtype
-    from ..sequencer.lowering import ScheduleCompiler, _arithcfg_for
-    from ..sequencer.sequence import step_in_elems
-
-    comp = ScheduleCompiler(_AxisOnlyMesh(axis_name, world), axis_name,
-                            use_pallas_ring=False)
-    arithcfg = None
-    if options.data_type != DataType.none:
-        arithcfg = _arithcfg_for(comp.arith_table, options)
-    body, n_in = comp._body(options, plan, arithcfg)
-    if options.scenario == Operation.barrier:
-        avals = [jax.ShapeDtypeStruct((1,), np.float32)]
-    else:
-        elems = step_in_elems(options, world)
-        dtype = (to_numpy_dtype(options.data_type)
-                 if options.data_type != DataType.none else np.float32)
-        avals = [jax.ShapeDtypeStruct((elems,), dtype)] * n_in
-    closed = jax.make_jaxpr(body, axis_env=[(axis_name, world)])(*avals)
-    del jnp
+    closed, _, _ = trace_schedule_jaxpr(options, plan, world, axis_name)
     hops: list[tuple] = []
     _collect_ppermutes(closed.jaxpr, hops)
     return hops

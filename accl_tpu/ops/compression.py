@@ -34,6 +34,9 @@ Compressor lane numbering (referenced from ArithConfig rows):
 
 from __future__ import annotations
 
+import contextlib
+from typing import Callable, Iterator
+
 import jax.numpy as jnp
 
 from ..arithconfig import (
@@ -42,6 +45,60 @@ from ..arithconfig import (
     ArithConfig,
 )
 from ..constants import QUANT_BLOCK_ELEMS, QUANT_INV_QMAX, QUANT_QMAX
+
+# -- semantic-boundary hook (analysis.semantics) ----------------------------
+#
+# The contribution-set certifier abstractly interprets schedule bodies at
+# the jaxpr level. The blockwise quantize/dequantize math is elementwise-
+# NONLINEAR (per-block amax mixes every element into the scale), so
+# interpreting it primitive-by-primitive would dissolve exact per-element
+# provenance. Under `semantic_boundaries()` — active ONLY while the
+# certifier traces, never on a compile path — each public transform
+# routes through a named jax.jit wrapper around the SAME jnp reference
+# implementation, so the traced jaxpr carries one `pjit` equation whose
+# `name` identifies the transform (accl_sem_encode / accl_sem_decode /
+# accl_sem_dequant_combine_* / accl_sem_dequant_requant_*) and the
+# certifier can apply the lane's semantic rule (codes carry their
+# payload's provenance) instead of descending. Off the flag, the public
+# functions are byte-for-byte what they were: no extra trace boundary
+# ever reaches a compiled program.
+
+_SEM_BOUNDARY = False
+_SEM_JITS: dict[tuple, Callable] = {}
+# accl_sem_decode keys on the element count, so a long-lived process
+# linting many distinct quantized shapes would otherwise grow this (and
+# each entry's jit trace cache) without bound; trace-time wrappers are
+# cheap to rebuild, so evict oldest-first past the cap
+_SEM_JITS_CAP = 512
+
+
+@contextlib.contextmanager
+def semantic_boundaries() -> Iterator[None]:
+    """Trace-time context: mark the quantized-lane transforms as named
+    jaxpr boundaries for the semantic certifier's lifter."""
+    global _SEM_BOUNDARY
+    prev = _SEM_BOUNDARY
+    _SEM_BOUNDARY = True
+    try:
+        yield
+    finally:
+        _SEM_BOUNDARY = prev
+
+
+def _sem_jit(name: str, fn: Callable, *statics) -> Callable:
+    """A cached jax.jit of `fn` whose pjit equation is named `name`
+    (the statics distinguish closures specialized per shape/dtype)."""
+    key = (name, *statics)
+    jitted = _SEM_JITS.get(key)
+    if jitted is None:
+        import jax
+
+        fn.__name__ = name
+        jitted = jax.jit(fn)
+        while len(_SEM_JITS) >= _SEM_JITS_CAP:
+            _SEM_JITS.pop(next(iter(_SEM_JITS)))
+        _SEM_JITS[key] = jitted
+    return jitted
 
 _COMPRESS_TARGET = {
     0: jnp.float16,
@@ -116,6 +173,13 @@ def quantize_blockwise(x: jnp.ndarray, block: int = QUANT_BLOCK_ELEMS):
     quantized lanes only pair with fp32 payloads (ACCL406 gates anything
     else statically).
     """
+    if _SEM_BOUNDARY:
+        return _sem_jit("accl_sem_encode",
+                        lambda y: _quantize_impl(y, block), block)(x)
+    return _quantize_impl(x, block)
+
+
+def _quantize_impl(x: jnp.ndarray, block: int = QUANT_BLOCK_ELEMS):
     n = x.shape[-1]
     pad = (-n) % block
     xf = x.astype(jnp.float32)
@@ -140,6 +204,17 @@ def dequantize_blockwise(q: jnp.ndarray, scales: jnp.ndarray, n: int,
                          out_dtype=jnp.float32,
                          block: int = QUANT_BLOCK_ELEMS) -> jnp.ndarray:
     """Decode (codes, scales) back to n elements of out_dtype."""
+    if _SEM_BOUNDARY:
+        return _sem_jit(
+            "accl_sem_decode",
+            lambda qq, ss: _dequantize_impl(qq, ss, n, out_dtype, block),
+            n, jnp.dtype(out_dtype).name, block)(q, scales)
+    return _dequantize_impl(q, scales, n, out_dtype, block)
+
+
+def _dequantize_impl(q: jnp.ndarray, scales: jnp.ndarray, n: int,
+                     out_dtype=jnp.float32,
+                     block: int = QUANT_BLOCK_ELEMS) -> jnp.ndarray:
     per_elem = jnp.repeat(scales, block)[: q.shape[-1]]
     x = q.astype(jnp.float32) * per_elem
     return x[:n].astype(out_dtype)
@@ -152,12 +227,21 @@ def dequant_combine(q, scales, local, func_op: str):
     identical-numerics reference everywhere else). The element count is
     local's — q decodes against the operand it combines with, on both
     datapaths."""
+    if _SEM_BOUNDARY:
+        return _sem_jit(
+            f"accl_sem_dequant_combine_{func_op}",
+            lambda qq, ss, ll: _dequant_combine_impl(qq, ss, ll, func_op),
+            func_op)(q, scales, local)
+    return _dequant_combine_impl(q, scales, local, func_op)
+
+
+def _dequant_combine_impl(q, scales, local, func_op: str):
     if _use_quant_pallas():
         from .pallas_kernels import fused_dequant_combine_pallas
 
         return fused_dequant_combine_pallas(q, scales, local, op=func_op,
                                             interpret=False)
-    x = dequantize_blockwise(q, scales, local.shape[-1], jnp.float32)
+    x = _dequantize_impl(q, scales, local.shape[-1], jnp.float32)
     loc = local.astype(jnp.float32)
     out = jnp.add(x, loc) if func_op == "sum" else jnp.maximum(x, loc)
     return out.astype(local.dtype)
@@ -167,6 +251,12 @@ def dequant_combine_requant(q, scales, local, func_op: str):
     """The fused ring-step op: dequantize -> reduce (fp32) -> requantize,
     so only (int8 payload + scales) leave for the next hop while the
     accumulation itself never drops below fp32."""
+    if _SEM_BOUNDARY:
+        return _sem_jit(
+            f"accl_sem_dequant_requant_{func_op}",
+            lambda qq, ss, ll: _quantize_impl(
+                _dequant_combine_impl(qq, ss, ll, func_op)),
+            func_op)(q, scales, local)
     if _use_quant_pallas():
         from .pallas_kernels import fused_dequant_combine_quant_pallas
 

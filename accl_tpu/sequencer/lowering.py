@@ -478,6 +478,34 @@ class ScheduleCompiler:
         return self.compile(options, plan, arithcfg)
 
 
+class AxisOnlyMesh:
+    """The minimal mesh surface `ScheduleCompiler._body` consumes (axis
+    size lookup); tracing under make_jaxpr's axis env needs no
+    devices."""
+
+    def __init__(self, axis_name: str, world: int):
+        self.shape = {axis_name: world}
+
+
+def analysis_body(options: CallOptions, plan: Plan, world: int,
+                  axis_name: str = "ccl",
+                  arith_table: dict | None = None) -> tuple[Callable, int]:
+    """The IR-extraction hook for the static analyzers: build the SAME
+    schedule body the compiler would lower — nothing re-modeled — for
+    abstract evaluation under an axis environment. Pallas lowering is
+    forced off (the lax family expresses the identical wire pattern
+    through ppermute, which is the surface the analyses read); the
+    protocol pass collects the traced body's ppermute perms and the
+    semantic certifier lifts its full hop DAG from it."""
+    comp = ScheduleCompiler(AxisOnlyMesh(axis_name, world), axis_name,
+                            arith_table=arith_table,
+                            use_pallas_ring=False)
+    arithcfg = None
+    if options.data_type != DataType.none:
+        arithcfg = _arithcfg_for(comp.arith_table, options)
+    return comp._body(options, plan, arithcfg)
+
+
 def _arithcfg_for(table, options: CallOptions):
     dt = options.data_type
     if options.compress_dtype != DataType.none:

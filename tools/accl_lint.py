@@ -16,6 +16,11 @@ Three modes, combinable:
                    even without "deep": true, and --schedules
                    model-checks every config's hop programs over all
                    match orders (budgeted; truncation fails the gate)
+  --semantic       semantic certification (ACCL501-504): --schedules
+                   additionally proves every config's contribution sets
+                   equal its declared collective (strict: a schedule
+                   the certifier cannot lift FAILS the gate), and the
+                   corpus replay enforces "expect_semantic" exactly
   --sample N       deterministically subsample the --schedules sweep
                    to ~N configs (the CI slice for the deep tier)
   FILE...          lint individual fixture files
@@ -38,8 +43,18 @@ Fixture schema (JSON):
                          programs), "budget_states"
   kind "slots":          "num_slots", "instances" [[step, seg, slot]],
                          "deps" [[from, to]]
+  kind "hopdag":         "dag" (analysis.hopdag.to_json form) plus
+                         "collective" ({op, count, root, function}) —
+                         the protocol passes run over the DAG's hops
+                         (these must satisfy "expect", [] for the
+                         bad-semantic fixtures: the point is that the
+                         linter/model checker ALONE pass them) and the
+                         semantic certifier checks the DAG against the
+                         declared collective ("expect_semantic")
   all kinds:             "expect": diagnostic codes that MUST surface
-                         ([] = the batch must lint clean), "title"
+                         ([] = the batch must lint clean), "title";
+                         "expect_semantic": ACCL5xx codes the semantic
+                         certifier must emit, EXACTLY (set equality)
 """
 
 import argparse
@@ -81,6 +96,8 @@ from accl_tpu.analysis.protocol import (  # noqa: E402
     trace_schedule_hops,
 )
 from accl_tpu.analysis.slots import SlotInstance, SlotTimeline  # noqa: E402
+from accl_tpu.analysis import hopdag as hopdag_mod  # noqa: E402
+from accl_tpu.analysis import semantics as semantics_mod  # noqa: E402
 from accl_tpu.sequencer.plan import select_algorithm  # noqa: E402
 
 DEFAULT_CORPUS = pathlib.Path(__file__).resolve().parent / "lint_corpus"
@@ -191,6 +208,26 @@ def lint_fixture(fx: dict, deep: bool = False) -> list:
             {(int(a), int(b)) for a, b in fx.get("deps", [])},
         )
         return check_slots(timeline)
+    if kind == "hopdag":
+        # raw hop-DAG fixtures: the protocol/model-check passes see the
+        # DAG's hops as per-rank programs (for the bad-semantic corpus
+        # these must come back CLEAN — the class only the semantic
+        # certifier catches), then the certifier checks the DAG against
+        # its declared collective
+        dag = hopdag_mod.from_json(fx["dag"])
+        programs = hopdag_mod.rank_programs(dag)
+        diags = simulate(programs, blocking_sends=False)
+        if (deep or fx.get("deep", False)) and not diags:
+            diags = SequenceLinter(
+                dag.world,
+                budget=_fixture_budget(fx)).check_interleavings(programs)
+        coll = fx.get("collective")
+        if coll is not None:
+            opts = _step_from_dict(coll)
+            spec = semantics_mod.collective_spec(opts, dag.world)
+            diags = list(diags) + semantics_mod.certify(
+                dag, spec, opts.scenario.name)
+        return diags
     raise ValueError(f"unknown fixture kind {kind!r}")
 
 
@@ -200,7 +237,26 @@ def run_fixture_file(path: pathlib.Path,
     diags = lint_fixture(fx, deep=deep)
     got = [d.code for d in diags]
     expect = fx.get("expect", [])
-    if expect:
+    expect_sem = fx.get("expect_semantic")
+    if expect_sem is not None:
+        # semantic expectations are EXACT (set equality on the ACCL5xx
+        # codes): a bad-semantic fixture must be rejected with its
+        # specific code, and the non-semantic passes must satisfy
+        # "expect" — [] meaning the linter/model checker alone pass it
+        got5 = sorted({c for c in got if c.startswith("ACCL5")})
+        rest = [c for c in got if not c.startswith("ACCL5")]
+        sem_ok = got5 == sorted(set(expect_sem))
+        if expect:
+            rest_ok = not [c for c in expect if c not in rest]
+        else:
+            rest_ok = not rest
+        ok = sem_ok and rest_ok
+        verdict = (f"semantic {got5 or ['clean']}"
+                   + (f" + {sorted(set(rest))}" if rest else "")
+                   if ok else
+                   f"EXPECTED semantic {sorted(set(expect_sem))} got "
+                   f"{got5} (other codes: {sorted(set(rest))})")
+    elif expect:
         missing = [c for c in expect if c not in got]
         ok = not missing
         verdict = (f"rejected with {sorted(set(got))}" if ok else
@@ -225,23 +281,29 @@ def run_corpus(corpus_dir: pathlib.Path, deep: bool = False) -> bool:
         except Exception as e:  # a crashing fixture is a failing fixture
             ok, line = False, f"{path.name:40s} ERROR {type(e).__name__}: {e}"
         ok_all &= ok
-        fx_expect = json.loads(path.read_text()).get("expect", [])
-        n_bad += bool(fx_expect)
-        n_good += not fx_expect
+        fx_d = json.loads(path.read_text())
+        is_bad = bool(fx_d.get("expect")) or bool(
+            fx_d.get("expect_semantic"))
+        n_bad += is_bad
+        n_good += not is_bad
         print(("  ok  " if ok else " FAIL ") + line)
     print(f"corpus: {len(files)} fixtures "
           f"({n_bad} known-bad, {n_good} known-good)")
     return ok_all
 
 
-def run_schedules(deep: bool = False, sample: int = 0) -> bool:
+def run_schedules(deep: bool = False, sample: int = 0,
+                  semantic: bool = False) -> bool:
     """Interpret every shipping schedule family per rank and require it
     clean — the conformance half of the acceptance gate. `deep=True`
     additionally model-checks each config's hop programs over every
     legal match order (ACCL205-207; a truncated exploration FAILS the
     gate — the sweep must complete within budget, never silently
-    partial). `sample=N` keeps a deterministic ~N-config slice (CI's
-    deep tier)."""
+    partial). `semantic=True` additionally certifies every config's
+    contribution sets against its declared collective (ACCL501-504,
+    strict and unbudgeted: a config the certifier cannot lift fails the
+    gate — inability must never read as certified). `sample=N` keeps a
+    deterministic ~N-config slice (CI's deep tier)."""
     import time as _time
 
     t0 = _time.monotonic()
@@ -274,7 +336,16 @@ def run_schedules(deep: bool = False, sample: int = 0) -> bool:
                         if scen == Operation.barrier and count != 16:
                             continue
                         configs.append((world, scen, root, count,
-                                        tname, tuning))
+                                        tname, tuning, DataType.none))
+        # the quantized-wire cells: the families with int8 ring variants
+        # (codes relayed, accumulation only at combine points) — both
+        # the protocol interpretation and the semantic certifier must
+        # hold through the encoded datapath
+        for scen in (Operation.allreduce, Operation.reduce_scatter,
+                     Operation.allgather):
+            for count in (16, 8192):
+                configs.append((world, scen, 0, count, "default",
+                                tunings["default"], DataType.int8))
     if sample and sample < len(configs):
         # deterministic slice: every ceil(total/sample)-th config, so
         # the CI subset is stable across runs and spans all families
@@ -282,18 +353,24 @@ def run_schedules(deep: bool = False, sample: int = 0) -> bool:
         configs = configs[::stride]
     n = 0
     budget = Budget()
-    for world, scen, root, count, tname, tuning in configs:
+    for world, scen, root, count, tname, tuning, wire in configs:
+        from accl_tpu.constants import CompressionFlags
+
         rsd = root if scen != Operation.send \
             else 0 | ((world - 1) << 16)
+        comp_flags = (CompressionFlags.ETH_COMPRESSED
+                      if wire != DataType.none
+                      else CompressionFlags.NO_COMPRESSION)
         opts = CallOptions(
             scenario=scen, count=count, root_src_dst=rsd,
             function=int(ReduceFunction.SUM),
-            data_type=DataType.float32)
+            data_type=DataType.float32,
+            compress_dtype=wire, compression_flags=comp_flags)
         plan = select_algorithm(
-            scen, count, 4, world,
+            scen, count, 4, world, comp_flags,
             max_eager_size=DEFAULT_MAX_EAGER_SIZE,
             eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
-            tuning=tuning)
+            tuning=tuning, compress_dtype=wire)
         # trace each schedule body ONCE (the dominant cost): the hops
         # feed the per-config interpretation AND, under --deep, the
         # exhaustive-interleaving checker
@@ -309,18 +386,29 @@ def run_schedules(deep: bool = False, sample: int = 0) -> bool:
                 # must never read as a clean one
                 if any(d.code == "ACCL207" for d in diags):
                     ok = False
+            if semantic and not diags:
+                # strict: UnsupportedSchedule is a gate failure, never
+                # a silent pass
+                try:
+                    diags = semantics_mod.check_batch_semantics(
+                        [opts], [plan], world, strict=True)
+                except semantics_mod.UnsupportedSchedule as e:
+                    ok = False
+                    print(f" FAIL {scen.name} world={world} "
+                          f"count={count}: certifier cannot lift: {e}")
         n += 1
         if diags:
             ok = False
             print(f" FAIL {scen.name} world={world} "
                   f"root={root} count={count} "
-                  f"tuning={tname} "
+                  f"tuning={tname} wire={wire.name} "
                   f"{plan.algorithm.name}: "
                   f"{[str(d) for d in diags]}")
     dt = _time.monotonic() - t0
-    print(f"schedules: {n} (scenario, world, root, size, tuning) "
+    print(f"schedules: {n} (scenario, world, root, size, tuning, wire) "
           f"configurations interpreted"
-          + (" + model-checked" if deep else "") + " "
+          + (" + model-checked" if deep else "")
+          + (" + semantically certified" if semantic else "") + " "
           + ("clean" if ok else "WITH DEFECTS")
           + f" in {dt:.1f}s")
     return ok
@@ -338,6 +426,10 @@ def main(argv=None) -> int:
     ap.add_argument("--deep", action="store_true",
                     help="force the exhaustive-interleaving tier on "
                          "fixtures and --schedules (ACCL205-207)")
+    ap.add_argument("--semantic", action="store_true",
+                    help="semantically certify every --schedules config "
+                         "against its declared collective "
+                         "(ACCL501-504, strict)")
     ap.add_argument("--sample", type=int, default=0, metavar="N",
                     help="deterministically subsample --schedules to "
                          "~N configurations")
@@ -349,7 +441,8 @@ def main(argv=None) -> int:
     if args.corpus:
         ok &= run_corpus(pathlib.Path(args.corpus), deep=args.deep)
     if args.schedules:
-        ok &= run_schedules(deep=args.deep, sample=args.sample)
+        ok &= run_schedules(deep=args.deep, sample=args.sample,
+                            semantic=args.semantic)
     for f in args.files:
         fok, line = run_fixture_file(pathlib.Path(f), deep=args.deep)
         ok &= fok

@@ -45,6 +45,45 @@ def _fmt_bytes(n: int) -> str:
     return f"{n} B"
 
 
+def section_trajectory(out: list[str]) -> None:
+    """The headline-metric trajectory across committed bench rounds
+    (BENCH_r*.json at the repo root), labeled by the artifact's
+    `platform` field — "tpu" rounds are on-chip measurements comparable
+    to each other and to the pinned TPU artifact; "cpu-fallback" rounds
+    are functional-regime noise recorded because the TPU was
+    unreachable, and must never be read as a perf trend. Older
+    artifacts predate the schema field; for those the label is
+    recovered from the metric prose ("[CPU FALLBACK" marker) and shown
+    with a trailing `*`."""
+    rounds = []
+    for p in sorted(REPO.glob("BENCH_r*.json")):
+        try:
+            d = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        parsed = d.get("parsed") or {}
+        platform = parsed.get("platform")
+        inferred = ""
+        if platform is None:
+            metric = str(parsed.get("metric", ""))
+            platform = ("cpu-fallback" if "[CPU FALLBACK" in metric
+                        else "tpu" if metric else "?")
+            inferred = "*"
+        rounds.append((p.name, parsed.get("value"), parsed.get("unit", ""),
+                       platform + inferred))
+    if not rounds:
+        return
+    out.append("## Headline trajectory (`BENCH_r*.json`)\n")
+    out.append("| Round | Value | Unit | Platform |\n|---|---|---|---|")
+    for name, value, unit, platform in rounds:
+        out.append(f"| {name} | {value} | {unit} | {platform} |")
+    out.append("")
+    out.append("`*` = platform recovered from metric prose (artifact "
+               "predates the `platform` schema field). Only same-"
+               "platform rounds are comparable; cpu-fallback values are "
+               "not a regression signal.\n")
+
+
 def section_tpu(out: list[str]) -> None:
     rows = _read_csv("profile.csv")
     out.append("## On-chip TPU lanes (`profile.csv`)\n")
@@ -285,6 +324,7 @@ def main() -> int:
     out.append("Generated by tools/report_bench.py from committed "
                "artifacts in accl_log/. Reference roles: "
                "parse_bench_results.py + Coyote plot.py.\n")
+    section_trajectory(out)
     section_tpu(out)
     section_flagship(out)
     section_emulator(out)
