@@ -742,7 +742,8 @@ class ACCL:
         return self.cclo.dump_eager_rx_buffers()
 
     def configure_tuning_parameters(self, tuning: TuningParams):
-        """Write the six algorithm-tuning registers to exchange memory
+        """Write the algorithm-tuning registers (the reference's six
+        plus the three synthesized-schedule crossovers) to exchange memory
         (reference configure_tuning_parameters, accl.cpp:1198-1208); both
         executors read them per call."""
         dev = self.cclo
@@ -758,6 +759,12 @@ class ACCL:
                   tuning.reduce_flat_tree_max_count)
         dev.write(CCLOAddr.ALLREDUCE_COMPOSITION_MAX_COUNT,
                   tuning.allreduce_composition_max_count)
+        dev.write(CCLOAddr.SYNTH_ALLREDUCE_MAX_COUNT,
+                  tuning.synth_allreduce_max_count)
+        dev.write(CCLOAddr.SYNTH_ALLGATHER_MAX_COUNT,
+                  tuning.synth_allgather_max_count)
+        dev.write(CCLOAddr.SYNTH_REDUCE_SCATTER_MAX_COUNT,
+                  tuning.synth_reduce_scatter_max_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator",
@@ -777,7 +784,11 @@ class ACCL:
         wire bytes, so byte-threshold registers stretch by the
         compression ratio — the registers MOVE when quantized lanes are
         enabled. Returns the applied TuningParams."""
-        from .sequencer.timing import LinkParams, tuning_crossovers
+        from .sequencer.timing import (
+            LinkParams,
+            emulator_link,
+            tuning_crossovers,
+        )
 
         if tier not in ("emulator", "tpu"):
             raise ValueError(f"unknown autotune tier {tier!r}")
@@ -801,19 +812,7 @@ class ACCL:
                 link = LinkParams(alpha=t["dispatch_alpha_us"] * 1e-6,
                                   beta=t["hbm_stream_gbps"] * 1e9)
             else:
-                # per-collective models tune from the bcast link (the
-                # root-serialized collective whose aggregate and
-                # critical-path shapes coincide, so its alpha/beta are
-                # genuine per-message/per-byte host costs); single-link
-                # models keep the legacy key
-                lk = (model.get("link_per_collective", {}).get("bcast")
-                      or model.get("link"))
-                if not lk:
-                    raise ValueError(
-                        "timing model has neither link_per_collective "
-                        "nor link; re-run tools/timing_model.py")
-                link = LinkParams(alpha=lk["alpha_us"] * 1e-6,
-                                  beta=lk["beta_gbps"] * 1e9)
+                link = emulator_link(model)
         cross = tuning_crossovers(link, world=self.world,
                                   wire_dtype=wire_dtype)
         tuning = TuningParams.from_crossovers(cross)

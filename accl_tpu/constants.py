@@ -357,6 +357,9 @@ class TuningParams:
         reduce_flat_tree_max_ranks: int = 4,
         reduce_flat_tree_max_count: int = 32 * 1024,
         allreduce_composition_max_count: int = 0,
+        synth_allreduce_max_count: int = 0,
+        synth_allgather_max_count: int = 0,
+        synth_reduce_scatter_max_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
@@ -372,6 +375,16 @@ class TuningParams:
         # (accl.cpp:1198-1208); the timing model arbitrates per
         # (size, world) via tuning_crossovers.
         self.allreduce_composition_max_count = allreduce_composition_max_count
+        # Synthesized-schedule crossovers (sequencer/synthesis.py):
+        # payloads up to this many bytes run the search-produced
+        # hop-DAG from the committed library when one exists for the
+        # (op, world) cell. 0 — the default — keeps the hand-written
+        # zoo; ACCL.autotune sets these from the calibrated timing
+        # model's predicted crossovers, the same measured-selection
+        # posture as the other registers.
+        self.synth_allreduce_max_count = synth_allreduce_max_count
+        self.synth_allgather_max_count = synth_allgather_max_count
+        self.synth_reduce_scatter_max_count = synth_reduce_scatter_max_count
 
     @classmethod
     def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
@@ -415,4 +428,15 @@ class TuningParams:
             reduce_flat_tree_max_count=as_reg(
                 cross["reduce_flat_tree_max_count_bytes"]),
             allreduce_composition_max_count=comp,
+            # 0 is meaningful for the synth registers ("never wins on
+            # this link" / no library entry): clamp only the top end
+            synth_allreduce_max_count=min(
+                int(cross.get("synth_allreduce_max_bytes", 0)),
+                max_count_cap),
+            synth_allgather_max_count=min(
+                int(cross.get("synth_allgather_max_bytes", 0)),
+                max_count_cap),
+            synth_reduce_scatter_max_count=min(
+                int(cross.get("synth_reduce_scatter_max_bytes", 0)),
+                max_count_cap),
         )

@@ -34,6 +34,13 @@ class CCLOAddr:
     BCAST_FLAT_TREE_MAX_RANKS = 0x1FCC
     GATHER_FLAT_TREE_MAX_COUNT = 0x1FC8
     GATHER_FLAT_TREE_MAX_FANIN = 0x1FC4
+    # Synthesized-schedule crossover registers (sequencer/synthesis.py):
+    # payloads up to this many bytes run the committed search-produced
+    # hop-DAG for the collective; 0 (the default) keeps the hand-written
+    # zoo. Set by ACCL.autotune from the calibrated timing model.
+    SYNTH_ALLREDUCE_MAX_COUNT = 0x1FC0
+    SYNTH_ALLGATHER_MAX_COUNT = 0x1FBC
+    SYNTH_REDUCE_SCATTER_MAX_COUNT = 0x1FB8
     EGR_RX_BUF_SIZE = 0x4
     NUM_EGR_RX_BUFS = 0x0
     # Start of the dynamically-laid-out region (communicators, arith
@@ -41,7 +48,7 @@ class CCLOAddr:
     DYNAMIC_BASE = 0x200
     # End of the dynamic region: the lowest-addressed register above
     # (keep in sync when adding registers).
-    DYNAMIC_END = 0x1FC4
+    DYNAMIC_END = 0x1FB8
 
 
 # The hardware id this framework reports, with capability bits analogous

@@ -128,6 +128,13 @@ class TPUDevice(CCLODevice):
             # so no `or defaults` fallback
             allreduce_composition_max_count=rd(
                 CCLOAddr.ALLREDUCE_COMPOSITION_MAX_COUNT),
+            # likewise 0 = synthesized schedules off
+            synth_allreduce_max_count=rd(
+                CCLOAddr.SYNTH_ALLREDUCE_MAX_COUNT),
+            synth_allgather_max_count=rd(
+                CCLOAddr.SYNTH_ALLGATHER_MAX_COUNT),
+            synth_reduce_scatter_max_count=rd(
+                CCLOAddr.SYNTH_REDUCE_SCATTER_MAX_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------

@@ -19,7 +19,12 @@ move instructions at runtime, we split the same logic into:
   - sequence.py   recorded descriptor BATCHES -> one fused program (the
                   device-resident call-sequence layer: one dispatch for a
                   whole collective chain, cached under a composite
-                  signature).
+                  signature);
+  - synthesis.py  SCCL-style schedule search over the hop-DAG IR: the
+                  committed synthesized/ library of certified winner
+                  DAGs, selected by plan.py behind measured crossover
+                  registers and lowered by lowering.py like any other
+                  algorithm (docs/synthesis.md).
 """
 
 from .plan import (  # noqa: F401

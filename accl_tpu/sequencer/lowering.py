@@ -205,6 +205,19 @@ class ScheduleCompiler:
         axis, world = self.axis_name, self.world
         op = options.scenario
         root = options.root_src_dst
+
+        if plan.algorithm == Algorithm.SYNTHESIZED:
+            # A search-produced schedule from the committed library:
+            # the certified hop-DAG is regenerated at this call's count
+            # and lowered through the same wire primitives (ppermute
+            # hops, blockwise int8 encode/decode, reduce-lane folds)
+            # the Python bodies use — schedules as data end to end.
+            # int8-wire entries carry their encode/decode lanes inside
+            # the DAG, so the per-hop Wire built below stays off here.
+            from . import synthesis
+
+            return synthesis.lower_plan(plan, options, world, axis)
+
         func = ReduceFunction(options.function) if op in (
             Operation.combine,
             Operation.reduce,

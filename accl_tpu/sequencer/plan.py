@@ -44,6 +44,10 @@ class Algorithm(enum.IntEnum):
     RNDZV_REDUCE_SCATTER = 10  # reduce_scatter = reduce + scatter (.c:1768-1781)
     FLAT_ALLTOALL = 11  # pairwise exchange (.c:2140-2211)
     BARRIER_GATHER_SCATTER = 12  # zero-count notification tree (.c:2078-2120)
+    # A search-produced hop-DAG from the committed synthesized library
+    # (sequencer/synthesis.py): Plan.synth_key names the entry; the
+    # compiler lowers the certified DAG instead of a Python body.
+    SYNTHESIZED = 13
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +80,10 @@ class Plan:
     # wire is active and select_wire() can arbitrate it by predicted
     # time (HiCCL's compression-as-measured-decision posture).
     wire_dtype: DataType = DataType.none
+    # SYNTHESIZED plans: the library entry key (sequencer/synthesis.py)
+    # the compiler lowers. Part of the frozen Plan, so it rides the XLA
+    # cache key like every other selection decision.
+    synth_key: str = ""
 
 
 def is_rendezvous(
@@ -164,6 +172,36 @@ def select_algorithm(
         return Plan(proto, Algorithm.NONE, count, 1)
     if world_size == 1 and scenario != Operation.barrier:
         return Plan(proto, Algorithm.NONE, count, 1)
+
+    # Synthesized schedules (sequencer/synthesis.py): payloads inside a
+    # synth crossover register run the search-produced hop-DAG for this
+    # (op, world) when the committed library carries a certified entry
+    # whose predicted winning window covers the payload. Registers
+    # default 0 (off) and are set by ACCL.autotune from the calibrated
+    # timing model — selection from measured crossovers, the same
+    # posture as every other register. Only exact uncompressed
+    # unstreamed calls are eligible: the library's int8-wire entries
+    # (exchange family re-encodes the running partial every hop) are
+    # NOT rank-consistent — different ranks fold differently-quantized
+    # copies and finish apart by up to the per-block bound — so they
+    # must never silently replace the hand-written quantized ring,
+    # whose rank-consistent round-trip is a documented contract
+    # (docs/api.md). int8 entries stay first-class for explicit use
+    # (synthesis.select_entry(wire="int8"), tools/accl_synth).
+    synth_reg = {
+        Operation.allreduce: tuning.synth_allreduce_max_count,
+        Operation.allgather: tuning.synth_allgather_max_count,
+        Operation.reduce_scatter: tuning.synth_reduce_scatter_max_count,
+    }.get(scenario, 0)
+    if (synth_reg and 0 < bytes_count <= synth_reg
+            and stream == StreamFlags.NO_STREAM
+            and compression == CompressionFlags.NO_COMPRESSION):
+        from . import synthesis
+
+        key = synthesis.select_entry(scenario, world_size, bytes_count)
+        if key is not None:
+            return Plan(Protocol.EAGER, Algorithm.SYNTHESIZED,
+                        count, 1, wire_dtype=wire, synth_key=key)
 
     if scenario in (Operation.send, Operation.recv):
         # send .c:573-649 / recv .c:653-710: rendezvous one-sided write vs

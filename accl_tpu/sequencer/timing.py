@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from typing import Any
 
 from ..constants import (
     DataType,
@@ -70,6 +71,23 @@ class LinkParams:
 
     def seconds(self, messages: float, nbytes: float) -> float:
         return self.alpha * messages + nbytes / self.beta
+
+
+def emulator_link(model: dict[str, Any]) -> LinkParams:
+    """The emulator-tier LinkParams of a timing-model document: the
+    bcast per-collective row (the root-serialized collective whose
+    aggregate and critical-path shapes coincide, so its alpha/beta are
+    genuine per-message/per-byte host costs), with fallback to the
+    legacy single-"link" key. The ONE resolution rule shared by
+    ACCL.autotune, bench.py --check, and tools/accl_synth — a schema
+    change lands here or nowhere."""
+    lk = (model.get("link_per_collective", {}).get("bcast")
+          or model.get("link"))
+    if not lk:
+        raise ValueError("timing model has neither link_per_collective "
+                         "nor link; re-run tools/timing_model.py")
+    return LinkParams(alpha=lk["alpha_us"] * 1e-6,
+                      beta=lk["beta_gbps"] * 1e9)
 
 
 def _segs(nbytes: int, rx_buf_bytes: int) -> int:
@@ -139,6 +157,14 @@ def coefficients(
     if P <= 1 or plan.algorithm == Algorithm.NONE:
         return 0.0, 0.0
     alg = plan.algorithm
+    if alg == Algorithm.SYNTHESIZED:
+        # the cost shape lives with the library entry: per-step send
+        # sizes of the synthesized hop-DAG, wire bytes included (the
+        # int8 entries carry their own encode/decode lanes)
+        from .synthesis import cost_shape, entry_for_key
+
+        return cost_shape(entry_for_key(plan.synth_key).spec, count,
+                          elem_bytes, aggregate=False)
     s = _segs(n, rx_buf_bytes)  # eager segments per full-payload message
 
     if alg == Algorithm.EAGER_SENDRECV:
@@ -239,6 +265,11 @@ def coefficients_aggregate(
     if P <= 1 or plan.algorithm == Algorithm.NONE:
         return 0.0, 0.0
     alg = plan.algorithm
+    if alg == Algorithm.SYNTHESIZED:
+        from .synthesis import cost_shape, entry_for_key
+
+        return cost_shape(entry_for_key(plan.synth_key).spec, count,
+                          elem_bytes, aggregate=True)
     r = math.ceil(math.log2(P)) if P > 1 else 0
 
     if alg in (Algorithm.EAGER_SENDRECV, Algorithm.RNDZV_SENDRECV,
@@ -499,6 +530,41 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
             comp_best = nbytes
         nbytes *= 2
 
+    # Synthesized-schedule crossovers: for each op with committed
+    # library entries at this world, the largest payload where the best
+    # fp32 synthesized schedule still predicts faster than the whole
+    # hand-written zoo (synthesis.hand_written_best forces the
+    # tuning-reachable alternatives too). 0 = no entry or never wins —
+    # the register stays off and selection is unchanged. int8-wire
+    # entries are deliberately excluded: select_algorithm never
+    # auto-substitutes them (they are not rank-consistent — see the
+    # synthesized branch in plan.select_algorithm), so the register
+    # must describe exactly the fp32 window selection will honor.
+    from . import synthesis as _synth
+
+    synth_regs: dict[str, int] = {}
+    for op_key, scen in (("allreduce", Operation.allreduce),
+                         ("allgather", Operation.allgather),
+                         ("reduce_scatter", Operation.reduce_scatter)):
+        entries = [e for e in _synth.library().values()
+                   if e.spec.op == op_key and e.spec.world == P
+                   and not e.spec.wire]
+        best_bytes = 0
+        if entries:
+            sbytes = 1 << 10
+            while sbytes <= (1 << 24):
+                cnt = max(sbytes // elem_bytes, 1)
+                t_synth = min(
+                    _synth.predict_spec(params, e.spec, cnt, elem_bytes)
+                    for e in entries)
+                t_hand = _synth.hand_written_best(
+                    params, scen, cnt, elem_bytes, P,
+                    rx_buf_bytes=rx_buf_bytes)
+                if t_synth < t_hand:
+                    best_bytes = sbytes
+                sbytes *= 2
+        synth_regs[f"synth_{op_key}_max_bytes"] = best_bytes
+
     return {
         "bcast_flat_tree_max_ranks": bcast_max,
         "reduce_flat_tree_max_count_bytes": reduce_cross,
@@ -507,4 +573,5 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
         "allreduce_composition_max_bytes": comp_best,
         "world": P,
         "wire_dtype": wire_dtype.name,
+        **synth_regs,
     }

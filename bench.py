@@ -821,6 +821,271 @@ def _smoke_main():
         sys.exit(1)
 
 
+BASELINE_BENCH = pathlib.Path(__file__).parent / "BASELINE_BENCH.json"
+
+
+def _shipped_link():
+    """LinkParams from the committed calibrated timing model — delegates
+    to synthesis.shipped_link so the model path and resolution rule live
+    in ONE place (bench --check and --verify-library can never read
+    different files)."""
+    from accl_tpu.sequencer.synthesis import shipped_link
+
+    return shipped_link()
+
+
+def _check_sections(jax):
+    """Measure the committed per-(section, size, world) baseline cells
+    on the virtual CPU mesh: each section is one compiled collective
+    program (hand-written vs synthesized where a library entry serves
+    the cell). All cells are compiled and warmed first, then timed
+    INTERLEAVED — one dispatch per cell per round, median across
+    rounds — so a transient load burst lands on both sides of every
+    speedup-gate ratio instead of poisoning whichever cell it happened
+    to coincide with (sequential per-cell timing made the CI gate
+    load-flaky). Returns (rows, world) where rows[section_id] =
+    {seconds, messages, bytes, algorithm} and messages/bytes are the
+    timing-model critical-path coefficients of the plan that actually
+    ran (the refit samples)."""
+    from jax.sharding import Mesh
+
+    from accl_tpu.constants import (
+        DEFAULT_EAGER_RX_BUF_SIZE,
+        DEFAULT_MAX_EAGER_SIZE,
+        DataType,
+        Operation,
+        ReduceFunction,
+        TuningParams,
+    )
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.sequencer.lowering import ScheduleCompiler
+    from accl_tpu.sequencer.plan import Algorithm, select_algorithm
+    from accl_tpu.sequencer.timing import coefficients, tuning_crossovers
+
+    world = min(len(jax.devices()), 8)
+    mesh = Mesh(np.array(jax.devices()[:world]), axis_names=("ccl",))
+    comp = ScheduleCompiler(mesh, use_pallas_ring=False)
+
+    # synth registers from the SHIPPED calibrated link (the autotune
+    # path): selection at the synthesized cells must come from measured
+    # crossovers, not a hand-set override
+    link = _shipped_link()
+    tuning_synth = TuningParams.from_crossovers(
+        tuning_crossovers(link, world=world))
+    tuning_hand = TuningParams.default()
+    kw = dict(max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+              eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE)
+
+    # (name, op, payload bytes, tuning, expect_synth, gate_min_ratio) —
+    # THE one cell table: section ids, the --write-baseline speedup
+    # gates, and the refit-agreement checks are all derived from it
+    # (gate_min_ratio on a synth cell pairs it against its `_hand`
+    # twin; a retuned cell can't silently orphan a gate or a refit
+    # check). All cells stay in the small-payload regime, where
+    # per-dispatch hop latency dominates: that is the region the
+    # synthesized schedules target AND the region where the alpha-beta
+    # model's jumbo-stream story approximates this mesh (large payloads
+    # hit the eager protocol's per-segment re-dispatch, which the wire
+    # model deliberately does not describe — see timing.coefficients)
+    cells = [
+        ("allreduce_hand", Operation.allreduce, 4096, tuning_hand,
+         False, None),
+        ("allreduce_synth", Operation.allreduce, 4096, tuning_synth,
+         True, 1.3),
+        ("reduce_scatter_hand", Operation.reduce_scatter, 16384,
+         tuning_hand, False, None),
+        ("reduce_scatter_synth", Operation.reduce_scatter, 16384,
+         tuning_synth, True, 1.2),
+        ("allgather_hand", Operation.allgather, 16384, tuning_hand,
+         False, None),
+    ]
+    synth_cells = [(name, op, nbytes, ratio)
+                   for name, op, nbytes, _t, _e, ratio in cells
+                   if ratio is not None]
+    rng = np.random.default_rng(1234)
+    prepared = []
+    for name, op, nbytes, tuning, expect_synth, _ratio in cells:
+        count = max(nbytes // 4, 1)
+        plan = select_algorithm(op, count, 4, world, tuning=tuning, **kw)
+        if expect_synth and plan.algorithm != Algorithm.SYNTHESIZED:
+            raise SystemExit(
+                f"FAIL: {name}/w{world}/{nbytes}: measured crossovers "
+                f"did not select a synthesized schedule "
+                f"(got {plan.algorithm.name})")
+        if not expect_synth and plan.algorithm == Algorithm.SYNTHESIZED:
+            raise SystemExit(
+                f"FAIL: {name}/w{world}/{nbytes}: hand-written baseline "
+                "cell unexpectedly selected a synthesized schedule")
+        opts = CallOptions(scenario=op, count=count,
+                           function=int(ReduceFunction.SUM),
+                           data_type=DataType.float32)
+        fn = comp.lower(opts, plan)
+        in_elems = count * world if op == Operation.reduce_scatter \
+            else count
+        x = rng.integers(-50, 50, (world, in_elems)).astype(np.float32)
+        for _ in range(5):
+            jax.block_until_ready(fn(x))
+        sid = f"{name}/w{world}/{nbytes}"
+        m, b = coefficients(op, plan, count, 4, world,
+                            rx_buf_bytes=DEFAULT_EAGER_RX_BUF_SIZE)
+        prepared.append((sid, fn, x, plan, m, b))
+    samples = {sid: [] for sid, *_ in prepared}
+    for _ in range(40):
+        for sid, fn, x, _plan, _m, _b in prepared:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))
+            samples[sid].append(time.perf_counter() - t0)
+    rows = {}
+    for sid, _fn, _x, plan, m, b in prepared:
+        sec = float(np.median(samples[sid]))
+        rows[sid] = {"seconds": sec, "messages": m, "bytes": b,
+                     "algorithm": plan.algorithm.name}
+        print(f"  {sid:36s} {sec * 1e6:10.1f} us  "
+              f"{plan.algorithm.name}", file=sys.stderr)
+    return rows, world, synth_cells
+
+
+def _check_main():
+    """bench.py --check: diff measured section times against the
+    committed BASELINE_BENCH.json tolerance bands, enforce the
+    synthesized-schedule speedup gates, and require the LinkParams
+    refit from this run's samples to AGREE that the synthesized
+    schedules win their measured cells (a flipped verdict means
+    prediction and measurement diverged and the crossover registers are
+    stale) — the perf trajectory as an exit code, not prose (ROADMAP
+    item 5). Refit-vs-shipped median residuals are reported in the JSON
+    artifact but not gated: five cells on a noisy CPU mesh are a
+    verdict check, not a calibration set (bench --trace owns the
+    residual-improvement gate). `--write-baseline` regenerates the
+    table from this run instead."""
+    from accl_tpu.sequencer.timing import calibrate
+
+    write = "--write-baseline" in sys.argv
+    rows, world, synth_cells = _check_sections(__import__("jax"))
+
+    # refit-vs-shipped: fit alpha/beta to this run's (m, b, t) samples
+    # and compare median relative residuals against the shipped link
+    samples = [(r["messages"], r["bytes"], r["seconds"])
+               for r in rows.values()]
+    refit = calibrate(samples)
+    shipped = _shipped_link()
+
+    def med_residual(link):
+        res = [abs(link.seconds(m, b) - t) / t for m, b, t in samples]
+        return float(np.median(res))
+
+    r_refit, r_shipped = med_residual(refit), med_residual(shipped)
+    print(f"  link refit alpha {refit.alpha * 1e6:.1f} us beta "
+          f"{refit.beta / 1e9:.3f} GB/s: median residual "
+          f"{r_refit:.2f} vs shipped {r_shipped:.2f}", file=sys.stderr)
+
+    # refit-vs-shipped agreement on the question the registers answer:
+    # under THIS host's own calibration, the synthesized schedules must
+    # still predict as the winners of their measured cells — if the
+    # refit link flips the verdict, prediction and measurement have
+    # diverged and the crossover registers are stale
+    from accl_tpu.sequencer import synthesis as _synth
+
+    refit_disagreements = []
+    for name, op, nbytes, _ratio in synth_cells:
+        # derived from the one cells table, so every measured synth
+        # cell IS checked — a retuned cell can't silently orphan its
+        # refit-agreement check
+        key_sec = f"{name}/w{world}/{nbytes}"
+        count = max(nbytes // 4, 1)
+        key = _synth.select_entry(op, world, nbytes)
+        if key is None:
+            refit_disagreements.append(
+                f"{key_sec}: no library entry serves the cell")
+            continue
+        spec = _synth.entry_for_key(key).spec
+        t_s = _synth.predict_spec(refit, spec, count, 4)
+        t_h = _synth.hand_written_best(refit, op, count, 4, world)
+        if t_s >= t_h:
+            refit_disagreements.append(
+                f"{key_sec}: refit link predicts synthesized "
+                f"{t_s * 1e6:.0f} us >= hand-written {t_h * 1e6:.0f} us "
+                "— predicted and measured winners disagree")
+
+    if write:
+        doc = {
+            "schema": 1,
+            "host": f"virtual {world}-device CPU mesh (functional CI "
+                    "tier; seconds are NOT hardware numbers)",
+            "tol_rel": 5.0,
+            "sections": {sid: {"seconds": r["seconds"],
+                               "algorithm": r["algorithm"]}
+                         for sid, r in rows.items()},
+            "gates": [
+                {"name": (f"synth_{name[:-len('_synth')]}_beats_hand_"
+                          f"w{world}_{nbytes}B"),
+                 "fast": f"{name}/w{world}/{nbytes}",
+                 "slow": (f"{name[:-len('_synth')]}_hand"
+                          f"/w{world}/{nbytes}"),
+                 "min_ratio": ratio}
+                for name, _op, nbytes, ratio in synth_cells
+            ],
+            "refit": {"alpha_us": refit.alpha * 1e6,
+                      "beta_gbps": refit.beta / 1e9,
+                      "median_residual": r_refit},
+        }
+        BASELINE_BENCH.write_text(json.dumps(doc, indent=1,
+                                             sort_keys=True) + "\n")
+        print(f"wrote {BASELINE_BENCH}", file=sys.stderr)
+
+    base = json.loads(BASELINE_BENCH.read_text())
+    tol = float(base.get("tol_rel", 4.0))
+    failures = []
+    for sid, entry in base["sections"].items():
+        got = rows.get(sid)
+        if got is None:
+            failures.append(f"section {sid} in baseline but not "
+                            "measured (bench drift)")
+            continue
+        if got["algorithm"] != entry.get("algorithm",
+                                         got["algorithm"]):
+            failures.append(
+                f"{sid}: algorithm changed "
+                f"{entry['algorithm']} -> {got['algorithm']} "
+                "(selection regression; re-baseline deliberately)")
+        if got["seconds"] > entry["seconds"] * tol:
+            failures.append(
+                f"{sid}: measured {got['seconds'] * 1e6:.1f} us > "
+                f"baseline {entry['seconds'] * 1e6:.1f} us x{tol:g} "
+                "tolerance band")
+    for gate in base.get("gates", []):
+        fast = rows.get(gate["fast"])
+        slow = rows.get(gate["slow"])
+        if fast is None or slow is None:
+            failures.append(f"gate {gate['name']}: missing section")
+            continue
+        ratio = slow["seconds"] / fast["seconds"]
+        verdict = "ok" if ratio >= gate["min_ratio"] else "FAIL"
+        print(f"  gate {gate['name']}: {ratio:.2f}x "
+              f"(need >= {gate['min_ratio']:g}x) {verdict}",
+              file=sys.stderr)
+        if ratio < gate["min_ratio"]:
+            failures.append(
+                f"gate {gate['name']}: measured speedup {ratio:.2f}x "
+                f"below the {gate['min_ratio']:g}x bar — the "
+                "synthesized-schedule claim no longer holds")
+    failures.extend(refit_disagreements)
+    print(json.dumps({
+        "metric": "bench --check: measured-vs-baseline regression gate "
+                  f"(w{world} CPU mesh, {len(rows)} sections, "
+                  f"{len(base.get('gates', []))} speedup gates)",
+        "value": len(failures),
+        "unit": "regressions",
+        "platform": "cpu-fallback",
+        "refit_median_residual": round(r_refit, 3),
+        "shipped_median_residual": round(r_shipped, 3),
+    }))
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def _flagship_setup(jax):
     """One flagship model configuration shared by the train and decode
     lanes (so both benchmark the SAME model): returns
@@ -1153,5 +1418,7 @@ if __name__ == "__main__":
         _quant_gate_main()
     elif "--trace" in sys.argv:
         _trace_main()
+    elif "--check" in sys.argv or "--write-baseline" in sys.argv:
+        _check_main()
     else:
         main()

@@ -346,6 +346,25 @@ def run_schedules(deep: bool = False, sample: int = 0,
             for count in (16, 8192):
                 configs.append((world, scen, 0, count, "default",
                                 tunings["default"], DataType.int8))
+        # synthesized-schedule cells (sequencer/synthesis.py): payloads
+        # inside the committed library entries' winning windows,
+        # selected via maxed synth crossover registers — the lowered
+        # hop-DAG programs must interpret, model-check and certify
+        # exactly like the hand-written zoo (strict under --semantic).
+        # Cells whose (op, world, size) no entry serves fall through to
+        # the hand-written plan and stay valid sweep rows.
+        synth_tuning = TuningParams(
+            synth_allreduce_max_count=1 << 22,
+            synth_allgather_max_count=1 << 22,
+            synth_reduce_scatter_max_count=1 << 22,
+        )
+        for scen, count, wire in (
+                (Operation.allreduce, 1024, DataType.none),
+                (Operation.allreduce, 1024, DataType.int8),
+                (Operation.reduce_scatter, 1024, DataType.none),
+                (Operation.allgather, 65536, DataType.none)):
+            configs.append((world, scen, 0, count, "synth",
+                            synth_tuning, wire))
     if sample and sample < len(configs):
         # deterministic slice: every ceil(total/sample)-th config, so
         # the CI subset is stable across runs and spans all families

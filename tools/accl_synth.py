@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""accl_synth: search the hop-DAG schedule space, certify winners, and
+manage the committed synthesized-schedule library
+(accl_tpu/sequencer/synthesized/, docs/synthesis.md).
+
+Modes:
+
+  --search            run the synthesize -> certify -> score loop for
+                      every (op, world) in --ops/--worlds and print the
+                      winner table (no files written)
+  --export            like --search, but write every winner to the
+                      library directory and prune in-scope entries
+                      that no longer win any cell (regenerates the
+                      committed JSON hop-DAGs; diff should be empty
+                      unless the generator or the scoring link
+                      changed)
+  --score             print the predicted synth-vs-hand-written time
+                      per (world, size) cell for every committed entry
+  --verify-library    re-certify every committed entry: the spec must
+                      regenerate the committed DAG byte-for-byte, the
+                      DAG must pass the semantic certifier + deep
+                      model checker clean, and the committed win_bytes
+                      window must match fresh scoring under the link
+                      (the CI gate that keeps a stale library, stale
+                      selection window, or a checker change from
+                      silently shipping an uncertified schedule)
+
+The scoring link defaults to the committed calibrated timing model
+(accl_log/timing_model.json, bcast row — the same link ACCL.autotune
+reads); --alpha-us/--beta-gbps override it.
+
+Exit status is 0 only when every requested gate holds.
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from accl_tpu.constants import Operation  # noqa: E402
+from accl_tpu.sequencer import synthesis  # noqa: E402
+from accl_tpu.sequencer.timing import LinkParams, emulator_link  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_MODEL = REPO / "accl_log" / "timing_model.json"
+
+
+def _rel(path: pathlib.Path) -> pathlib.Path:
+    """Repo-relative for display when possible (the library dir can be
+    redirected outside the repo in tests)."""
+    try:
+        return path.relative_to(REPO)
+    except ValueError:
+        return path
+
+
+OPS = {
+    "allreduce": Operation.allreduce,
+    "allgather": Operation.allgather,
+    "reduce_scatter": Operation.reduce_scatter,
+}
+
+
+def load_link(args) -> LinkParams:
+    if args.alpha_us is not None or args.beta_gbps is not None:
+        if args.alpha_us is None or args.beta_gbps is None:
+            raise SystemExit("pass both --alpha-us and --beta-gbps")
+        return LinkParams(alpha=args.alpha_us * 1e-6,
+                          beta=args.beta_gbps * 1e9)
+    model = json.loads(pathlib.Path(args.timing_model).read_text())
+    try:
+        return emulator_link(model)
+    except ValueError as e:
+        raise SystemExit(f"{args.timing_model}: {e}") from e
+
+
+def run_search(args, export: bool) -> bool:
+    link = load_link(args)
+    print(f"scoring link: alpha {link.alpha * 1e6:.2f} us, "
+          f"beta {link.beta / 1e9:.3f} GB/s")
+    n_winners = 0
+    written: set[str] = set()
+    for world in args.worlds:
+        for op_name in args.ops:
+            results = synthesis.search(OPS[op_name], world, link,
+                                       log=lambda m: print("  " + m))
+            for res in results:
+                n_winners += 1
+                if export:
+                    path = synthesis.export_entry(res)
+                    written.add(path.name)
+                    print(f"  wrote {_rel(path)}")
+    print(f"{n_winners} winner(s) across worlds {args.worlds} "
+          f"x ops {args.ops}")
+    if export:
+        # prune in-scope entries that stopped winning: after a timing-
+        # or cost-model change an entry whose fresh window is None is
+        # never rewritten by the loop above, and verify_library would
+        # fail it forever with advice (--export) that otherwise could
+        # not resolve the failure. Out-of-scope entries (ops/worlds not
+        # searched this run) are kept untouched.
+        op_names = {OPS[o].name for o in args.ops}
+        for p in sorted(synthesis.library_dir().glob("*.json")):
+            if p.name in written:
+                continue
+            spec = synthesis.SynthSpec.from_json(
+                json.loads(p.read_text()))
+            if spec.op in op_names and spec.world in args.worlds:
+                p.unlink()
+                print(f"  pruned {_rel(p)} "
+                      "(no longer wins any cell under this link)")
+        synthesis.clear_library_cache()
+    return n_winners > 0
+
+
+def run_score(args) -> bool:
+    link = load_link(args)
+    entries = synthesis.library()
+    if not entries:
+        print("synthesized library is empty", file=sys.stderr)
+        return False
+    print(f"{'entry':44s} {'bytes':>10s} {'synth_us':>10s} "
+          f"{'hand_us':>10s}  verdict")
+    for key, entry in sorted(entries.items()):
+        s = entry.spec
+        for nbytes in synthesis.SIZE_GRID:
+            count = max(nbytes // 4, 1)
+            t_s = synthesis.predict_spec(link, s, count, 4)
+            t_h = synthesis.hand_written_best(
+                link, s.scenario, count, 4, s.world, wire=s.wire)
+            verdict = "WINS" if t_s < t_h else ("tie" if t_s == t_h
+                                                else "loses")
+            print(f"{key:44s} {nbytes:>10d} {t_s * 1e6:>10.1f} "
+                  f"{t_h * 1e6:>10.1f}  {verdict}")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--search", action="store_true",
+                    help="run the search and print winners")
+    ap.add_argument("--export", action="store_true",
+                    help="run the search and (re)write the library")
+    ap.add_argument("--score", action="store_true",
+                    help="predicted synth-vs-hand-written per cell for "
+                         "the committed library")
+    ap.add_argument("--verify-library", action="store_true",
+                    help="re-certify every committed entry (CI gate)")
+    ap.add_argument("--worlds", type=int, nargs="+", default=[2, 4, 8])
+    ap.add_argument("--ops", nargs="+", default=sorted(OPS),
+                    choices=sorted(OPS))
+    ap.add_argument("--timing-model", default=str(DEFAULT_MODEL))
+    ap.add_argument("--alpha-us", type=float, default=None)
+    ap.add_argument("--beta-gbps", type=float, default=None)
+    args = ap.parse_args(argv)
+    if not (args.search or args.export or args.score
+            or args.verify_library):
+        ap.error("nothing to do: pass --search, --export, --score, or "
+                 "--verify-library")
+    ok = True
+    if args.search or args.export:
+        ok &= run_search(args, export=args.export)
+    if args.score:
+        ok &= run_score(args)
+    if args.verify_library:
+        ok &= synthesis.verify_library(log=print, link=load_link(args))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,10 +51,10 @@ def section_trajectory(out: list[str]) -> None:
     `platform` field — "tpu" rounds are on-chip measurements comparable
     to each other and to the pinned TPU artifact; "cpu-fallback" rounds
     are functional-regime noise recorded because the TPU was
-    unreachable, and must never be read as a perf trend. Older
-    artifacts predate the schema field; for those the label is
-    recovered from the metric prose ("[CPU FALLBACK" marker) and shown
-    with a trailing `*`."""
+    unreachable, and must never be read as a perf trend. Every
+    committed round carries the explicit schema field (r01-r05 were
+    backfilled); a round genuinely missing it renders `?*` — the label
+    is never recovered from prose."""
     rounds = []
     for p in sorted(REPO.glob("BENCH_r*.json")):
         try:
@@ -63,14 +63,10 @@ def section_trajectory(out: list[str]) -> None:
             continue
         parsed = d.get("parsed") or {}
         platform = parsed.get("platform")
-        inferred = ""
         if platform is None:
-            metric = str(parsed.get("metric", ""))
-            platform = ("cpu-fallback" if "[CPU FALLBACK" in metric
-                        else "tpu" if metric else "?")
-            inferred = "*"
+            platform = "?*"
         rounds.append((p.name, parsed.get("value"), parsed.get("unit", ""),
-                       platform + inferred))
+                       platform))
     if not rounds:
         return
     out.append("## Headline trajectory (`BENCH_r*.json`)\n")
@@ -78,10 +74,11 @@ def section_trajectory(out: list[str]) -> None:
     for name, value, unit, platform in rounds:
         out.append(f"| {name} | {value} | {unit} | {platform} |")
     out.append("")
-    out.append("`*` = platform recovered from metric prose (artifact "
-               "predates the `platform` schema field). Only same-"
-               "platform rounds are comparable; cpu-fallback values are "
-               "not a regression signal.\n")
+    if any(platform == "?*" for _, _, _, platform in rounds):
+        out.append("`?*` = artifact genuinely missing the `platform` "
+                   "schema field. ")
+    out.append("Only same-platform rounds are comparable; cpu-fallback "
+               "values are not a regression signal.\n")
 
 
 def section_tpu(out: list[str]) -> None:
