@@ -765,14 +765,24 @@ class ACCL:
                   tuning.synth_allgather_max_count)
         dev.write(CCLOAddr.SYNTH_REDUCE_SCATTER_MAX_COUNT,
                   tuning.synth_reduce_scatter_max_count)
+        dev.write(CCLOAddr.HIER_ALLREDUCE_MIN_COUNT,
+                  tuning.hier_allreduce_min_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator",
-                 wire_dtype: DataType = DataType.none) -> TuningParams:
-        """Derive the four switch-point tuning registers from the
+                 wire_dtype: DataType = DataType.none,
+                 tier_links=None) -> TuningParams:
+        """Derive the switch-point tuning registers — the reference's
+        four, the synth windows, and (on a device that declares a
+        two-tier topology) HIER_ALLREDUCE_MIN_COUNT — from the
         calibrated timing model and apply them (gather fan-in keeps its
         structural default): the measured-performance closure of the
-        reference's hand-picked defaults. `link` is a
+        reference's hand-picked defaults. When the hierarchical window
+        opens, the device's per-tier wire dtypes (`hier_wires`) are
+        also set from `plan.select_tier_wires` under the same per-tier
+        calibration (the int8-on-DCN / fp32-on-ICI arbitration), so
+        subsequent fp32 allreduces in the window ship the arbitrated
+        wires. `link` is a
         sequencer.timing.LinkParams; absent, it is loaded from
         `timing_model_path` (default accl_log/timing_model.json, written
         by tools/timing_model.py). tier="tpu" uses the on-chip
@@ -813,10 +823,40 @@ class ACCL:
                                   beta=t["hbm_stream_gbps"] * 1e9)
             else:
                 link = emulator_link(model)
+        # Per-tier crossover: with a per-tier calibration (passed in, or
+        # the shipped link_tiers fit) AND a device that declares a
+        # two-tier topology, the hierarchical-allreduce register moves
+        # to the predicted hier-beats-flat window; otherwise it stays 0
+        # (off) and selection is unchanged.
+        topology = getattr(self.cclo, "hier_topology", None)
+        if tier_links is None:
+            from .telemetry.feedback import default_tier_links
+
+            tier_links = default_tier_links(timing_model_path)
         cross = tuning_crossovers(link, world=self.world,
-                                  wire_dtype=wire_dtype)
+                                  wire_dtype=wire_dtype,
+                                  tier_links=tier_links,
+                                  topology=topology)
         tuning = TuningParams.from_crossovers(cross)
         self.configure_tuning_parameters(tuning)
+        # per-tier wire arbitration rides the same tune: with the
+        # window open, arbitrate each tier's wire at a clearly
+        # bandwidth-bound payload (>= 1 MiB, never below the window
+        # floor — the floor itself can sit in the latency regime where
+        # no compression clears the min-gain bar) for the canonical
+        # fp32 payload; _resolve_step applies these only to fp32
+        # calls, the dtype they were arbitrated for
+        if (tuning.hier_allreduce_min_count > 0 and topology is not None
+                and tier_links is not None
+                and hasattr(self.cclo, "hier_wires")):
+            from .sequencer.plan import select_tier_wires
+
+            cnt = max(tuning.hier_allreduce_min_count, 1 << 20) // 4
+            self.cclo.hier_wires = select_tier_wires(
+                cnt, DataType.float32, topology, tier_links,
+                arith_table=self.arith_config,
+                quantized_ok=getattr(self.cclo,
+                                     "supports_quantized_wire", False))
         return tuning
 
     def soft_reset(self):

@@ -360,6 +360,7 @@ class TuningParams:
         synth_allreduce_max_count: int = 0,
         synth_allgather_max_count: int = 0,
         synth_reduce_scatter_max_count: int = 0,
+        hier_allreduce_min_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
@@ -385,6 +386,19 @@ class TuningParams:
         self.synth_allreduce_max_count = synth_allreduce_max_count
         self.synth_allgather_max_count = synth_allgather_max_count
         self.synth_reduce_scatter_max_count = synth_reduce_scatter_max_count
+        # Hierarchical-allreduce crossover (sequencer/hierarchical.py):
+        # on a device that declares a two-tier topology, allreduce
+        # payloads of AT LEAST this many bytes run the striped two-tier
+        # composition (Algorithm.HIER_RS_AR_AG) — a MIN register,
+        # because the composition wins the bandwidth-bound regime
+        # (large payloads, where moving 1/L of the bytes on the slow
+        # tier dominates) and loses the latency floor to its extra
+        # message count. 0 — the default — keeps the flat selection
+        # everywhere; ACCL.autotune sets it from the calibrated
+        # per-tier crossover (timing.tuning_crossovers with tier_links
+        # + topology), the same measured-selection posture as the synth
+        # registers.
+        self.hier_allreduce_min_count = hier_allreduce_min_count
 
     @classmethod
     def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
@@ -439,4 +453,13 @@ class TuningParams:
             synth_reduce_scatter_max_count=min(
                 int(cross.get("synth_reduce_scatter_max_bytes", 0)),
                 max_count_cap),
+            # 0 is meaningful here too: no per-tier calibration / no
+            # topology / hierarchical never wins on these links. This
+            # is a MIN threshold, so the overflow-safe clamp is OFF —
+            # min(v, cap) would WIDEN the window into the region the
+            # calibration said flat wins.
+            hier_allreduce_min_count=(
+                int(cross.get("hier_allreduce_min_bytes", 0))
+                if int(cross.get("hier_allreduce_min_bytes", 0))
+                <= max_count_cap else 0),
         )

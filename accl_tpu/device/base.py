@@ -41,6 +41,14 @@ class CCLOAddr:
     SYNTH_ALLREDUCE_MAX_COUNT = 0x1FC0
     SYNTH_ALLGATHER_MAX_COUNT = 0x1FBC
     SYNTH_REDUCE_SCATTER_MAX_COUNT = 0x1FB8
+    # Hierarchical-allreduce crossover (sequencer/hierarchical.py):
+    # allreduce payloads of AT LEAST this many bytes run the striped
+    # two-tier composition on a device with a declared (inner, outer)
+    # topology — a MIN threshold: the composition wins the
+    # bandwidth-bound regime, not the latency floor. 0 (the default)
+    # keeps the flat selection. Set by ACCL.autotune from the
+    # calibrated per-tier crossover.
+    HIER_ALLREDUCE_MIN_COUNT = 0x1FB4
     EGR_RX_BUF_SIZE = 0x4
     NUM_EGR_RX_BUFS = 0x0
     # Start of the dynamically-laid-out region (communicators, arith
@@ -48,7 +56,7 @@ class CCLOAddr:
     DYNAMIC_BASE = 0x200
     # End of the dynamic region: the lowest-addressed register above
     # (keep in sync when adding registers).
-    DYNAMIC_END = 0x1FB8
+    DYNAMIC_END = 0x1FB4
 
 
 # The hardware id this framework reports, with capability bits analogous

@@ -33,6 +33,7 @@ from jax.sharding import Mesh
 
 from ..constants import Operation, ReduceFunction
 from ..sequencer.hierarchical import (
+    RankMap,
     hierarchical_allgather_schedule,
     hierarchical_allreduce_schedule,
     hierarchical_alltoall_schedule,
@@ -74,9 +75,17 @@ class DCNCompiler(ScheduleCompiler):
         return self.mesh.shape[self.outer_axis] * self.mesh.shape[self.inner_axis]
 
     def _build(self, options, plan, arithcfg):
+        from ..sequencer.plan import Algorithm
+
         P = self.mesh.shape[self.outer_axis]
         L = self.mesh.shape[self.inner_axis]
         op = options.scenario
+        if plan.algorithm == Algorithm.HIER_RS_AR_AG:
+            # the register-gated striped composition: plan-driven, lowered
+            # by the base compiler's HIER branch over the combined tuple
+            # axis (global perms; the plan's RankMap is outer-major =
+            # this device's process-major numbering)
+            return super()._build(options, plan, arithcfg)
         if P == 1 or L == 1 or op not in self.HIER_OPS:
             # flat over the combined axis: every schedule body takes the
             # (outer, inner) tuple as its axis name; the combined index is
@@ -89,49 +98,51 @@ class DCNCompiler(ScheduleCompiler):
         wire = self._wire(options, arithcfg, func, False)
         common = dict(inner_axis=self.inner_axis, outer_axis=self.outer_axis,
                       inner_world=L, outer_world=P, wire=wire)
+        # the device's rank numbering is outer-major (process-major); all
+        # root/chunk conversions go through the ONE mapping helper
+        rm = RankMap(L, P, "outer_major")
+        root = options.root_src_dst
+        root_outer, root_inner = rm.outer_pos(root), rm.inner_pos(root)
 
         if op == Operation.allreduce:
             body = functools.partial(
                 hierarchical_allreduce_schedule, func=func, **common)
         elif op == Operation.scatter:
-            root = options.root_src_dst
             body = functools.partial(
                 hierarchical_scatter_schedule,
-                root_outer=root // L, root_inner=root % L, **common)
+                root_outer=root_outer, root_inner=root_inner, **common)
         elif op == Operation.gather:
-            root = options.root_src_dst
             body = functools.partial(
                 hierarchical_gather_schedule,
-                root_outer=root // L, root_inner=root % L, **common)
+                root_outer=root_outer, root_inner=root_inner, **common)
         elif op == Operation.reduce:
-            root = options.root_src_dst
             body = functools.partial(
                 hierarchical_reduce_schedule, func=func,
-                root_outer=root // L, root_inner=root % L, **common)
+                root_outer=root_outer, root_inner=root_inner, **common)
         elif op == Operation.barrier:
             body = functools.partial(hierarchical_barrier_schedule, **common)
         elif op == Operation.alltoall:
             # already process-major on both ends — no reorder needed
             body = functools.partial(hierarchical_alltoall_schedule, **common)
         elif op == Operation.bcast:
-            root = options.root_src_dst
             body = functools.partial(
                 hierarchical_bcast_schedule,
-                root_outer=root // L, root_inner=root % L, **common)
+                root_outer=root_outer, root_inner=root_inner, **common)
         elif op == Operation.allgather:
-            # composition output is inner-major (chunk j from device
-            # (p=j%P, l=j//P)); transpose locally to process-major
-            def body(x, *, _c=common, _P=P, _L=L):
+            # composition output is inner-major; relabel locally to the
+            # device's process-major chunk order
+            def body(x, *, _c=common, _rm=rm):
                 raw = hierarchical_allgather_schedule(x, **_c)
-                c = raw.shape[-1] // (_P * _L)
-                return raw.reshape(_L, _P, c).transpose(1, 0, 2).reshape(-1)
+                c = raw.shape[-1] // _rm.world
+                return _rm.reorder_chunks(raw, c, "inner_major",
+                                          "outer_major")
         else:  # reduce_scatter
             # pre-permute the input's process-major chunks to the
             # composition's inner-major layout so each device ends with
             # its own (process-major) chunk
-            def body(x, *, _c=common, _f=func, _P=P, _L=L):
-                c = x.shape[-1] // (_P * _L)
-                xim = x.reshape(_P, _L, c).transpose(1, 0, 2).reshape(-1)
+            def body(x, *, _c=common, _f=func, _rm=rm):
+                c = x.shape[-1] // _rm.world
+                xim = _rm.reorder_chunks(x, c, "outer_major", "inner_major")
                 return hierarchical_reduce_scatter_schedule(
                     xim, func=_f, **_c)
 
@@ -246,6 +257,10 @@ class DCNDevice(TPUDevice):
         self.outer_axis = outer_axis
         self.inner_axis = inner_axis
         self.compiler = DCNCompiler(mesh, outer_axis, inner_axis)
+        # declare the two-tier shape so the register-gated striped
+        # composition is selectable (plan.select_algorithm topology=)
+        self.hier_topology = (mesh.shape[inner_axis],
+                              mesh.shape[outer_axis])
 
     @property
     def world(self) -> int:

@@ -23,6 +23,7 @@ from ..constants import (
     DEFAULT_MAX_EAGER_SIZE,
     DEFAULT_MAX_RENDEZVOUS_SIZE,
     CfgFunc,
+    DataType,
     ErrorCode,
     Operation,
     TAG_ANY,
@@ -44,11 +45,26 @@ class TPUDevice(CCLODevice):
     # front instead of letting a lane-less executor degrade it silently
     supports_quantized_wire = True
 
-    def __init__(self, mesh, axis_name: str = "ccl"):
+    def __init__(self, mesh, axis_name: str = "ccl",
+                 hier_topology: tuple[int, int] | None = None):
         super().__init__()
         self.mesh = mesh
         self.axis_name = axis_name
         self.compiler = ScheduleCompiler(mesh, axis_name)
+        # Two-tier (inner_world, outer_world) shape for the hierarchical
+        # compositions: DCNDevice sets it from its (ici, dcn) mesh; a
+        # flat mesh may declare a VIRTUAL factoring (the bench's
+        # 8-ranks-as-4x2 emulated world). None = flat — and even with a
+        # topology, hierarchical plans stay unreachable until the
+        # HIER_ALLREDUCE_MIN_COUNT register is tuned on.
+        self.hier_topology = hier_topology
+        # Per-tier wire dtypes for hierarchical plans, set by
+        # ACCL.autotune from plan.select_tier_wires (int8 on DCN / fp32
+        # on ICI under the shipped calibration); default exact on both
+        # tiers. Arbitrated for the canonical fp32 payload, so
+        # _resolve_step applies them to fp32 calls only.
+        self.hier_wires: tuple[DataType, DataType] = (DataType.none,
+                                                      DataType.none)
         self.buffers: dict[int, object] = {}  # address -> TPUBuffer
         self.timeout = 1_000_000
         self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
@@ -135,6 +151,9 @@ class TPUDevice(CCLODevice):
                 CCLOAddr.SYNTH_ALLGATHER_MAX_COUNT),
             synth_reduce_scatter_max_count=rd(
                 CCLOAddr.SYNTH_REDUCE_SCATTER_MAX_COUNT),
+            # and 0 = hierarchical composition off
+            hier_allreduce_min_count=rd(
+                CCLOAddr.HIER_ALLREDUCE_MIN_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------
@@ -274,6 +293,11 @@ class TPUDevice(CCLODevice):
         ONE source for both the eager path and the call-sequence path, so
         the fused program can never silently diverge from what eager
         execution would run. Returns (plan, producer, consumer)."""
+        # the two-tier topology applies only to the full-world
+        # communicator: a sub-communicator is its own (usually flat)
+        # world and selects flat schedules
+        topo = self.hier_topology if (
+            self.hier_topology is not None and ctx.rows is None) else None
         plan = select_algorithm(
             options.scenario,
             options.count,
@@ -287,6 +311,12 @@ class TPUDevice(CCLODevice):
             # the wire rides the Plan so timing.predict on recorded
             # plans charges compressed widths (+ scale side-channel)
             compress_dtype=options.compress_dtype,
+            topology=topo,
+            # arbitrated for fp32 (the canonical payload); other dtypes
+            # stay exact on both tiers — their arith rows may not exist
+            tier_wires=(self.hier_wires
+                        if options.data_type == DataType.float32
+                        else (DataType.none, DataType.none)),
         )
         # stream ids ride dedicated descriptor bytes (word 8), so the tag
         # stays available for matching

@@ -40,6 +40,175 @@ def _pad_to(x, m):
     return jnp.pad(x, (0, rem)) if rem else x
 
 
+# ---------------------------------------------------------------------------
+# The global-rank convention, in ONE place
+# ---------------------------------------------------------------------------
+
+
+class RankMap:
+    """THE two-tier global-rank mapping helper.
+
+    Two conventions coexist in a two-tier world and every composition
+    must declare which one it speaks:
+
+      outer-major  g = outer_pos * inner_world + inner_pos
+                   (the DCN backend's process-major numbering: each
+                   host's ranks are contiguous; alltoall/scatter/gather
+                   and the striped allreduce use it)
+      inner-major  g = inner_pos * outer_world + outer_pos
+                   (the raw allgather composition's chunk order: an
+                   inner allgather of outer allgathers interleaves
+                   hosts)
+
+    Everything that converts between (inner_pos, outer_pos) and global
+    ranks — device root resolution, chunk reordering between the
+    conventions, per-tier ring permutes — goes through this class, so
+    the convention can never be re-derived inconsistently at two sites
+    (the pre-PR8 state: allgather was inner-major at hierarchical.py:91
+    while alltoall/scatter/gather were outer-major).
+
+    `inner_pos`/`outer_pos`/`global_rank` accept python ints AND traced
+    scalars (the arithmetic is // and %, which jax traces)."""
+
+    __slots__ = ("inner_world", "outer_world", "order")
+
+    def __init__(self, inner_world: int, outer_world: int,
+                 order: str = "outer_major"):
+        if order not in ("outer_major", "inner_major"):
+            raise ValueError(f"unknown rank order {order!r}")
+        self.inner_world = int(inner_world)
+        self.outer_world = int(outer_world)
+        self.order = order
+
+    @property
+    def world(self) -> int:
+        return self.inner_world * self.outer_world
+
+    def global_rank(self, inner_pos, outer_pos):
+        if self.order == "outer_major":
+            return outer_pos * self.inner_world + inner_pos
+        return inner_pos * self.outer_world + outer_pos
+
+    def inner_pos(self, g):
+        if self.order == "outer_major":
+            return g % self.inner_world
+        return g // self.outer_world
+
+    def outer_pos(self, g):
+        if self.order == "outer_major":
+            return g // self.inner_world
+        return g % self.outer_world
+
+    def inner_perm(self, distance: int = 1) -> list[tuple[int, int]]:
+        """GLOBAL ppermute pairs for one inner-ring hop: every host's
+        inner ring advances by `distance` in lockstep (all pairs stay
+        within their host — on hardware these are the ICI moves)."""
+        L = self.inner_world
+        return [
+            (self.global_rank(i, o), self.global_rank((i + distance) % L, o))
+            for o in range(self.outer_world)
+            for i in range(L)
+        ]
+
+    def outer_perm(self, distance: int = 1) -> list[tuple[int, int]]:
+        """GLOBAL ppermute pairs for one outer-ring hop: every inner
+        row's outer ring advances in lockstep (all pairs cross hosts —
+        the DCN moves)."""
+        P = self.outer_world
+        return [
+            (self.global_rank(i, o), self.global_rank(i, (o + distance) % P))
+            for o in range(P)
+            for i in range(self.inner_world)
+        ]
+
+    def reorder_chunks(self, x, chunk: int, frm: str, to: str):
+        """Relabel a (world * chunk,) buffer whose chunk g holds data
+        for/from global rank g under convention `frm` into convention
+        `to` — a local transpose, no data movement across ranks."""
+        if frm == to:
+            return x
+        L, P = self.inner_world, self.outer_world
+        if frm == "inner_major":  # rows (i, o) -> (o, i)
+            return x.reshape(L, P, chunk).transpose(1, 0, 2).reshape(-1)
+        return x.reshape(P, L, chunk).transpose(1, 0, 2).reshape(-1)
+
+
+class TierWire:
+    """Per-tier datapath configuration: ONE wire per tier, so
+    `select_wire` can arbitrate each link separately — int8 codes riding
+    the slow DCN tier while fp32 stays exact on ICI (the plan fields
+    inner_wire_dtype / outer_wire_dtype resolve to these two Wires)."""
+
+    __slots__ = ("inner", "outer")
+
+    def __init__(self, inner: schedules.Wire | None = None,
+                 outer: schedules.Wire | None = None):
+        self.inner = inner if inner is not None else schedules.Wire(None)
+        self.outer = outer if outer is not None else schedules.Wire(None)
+
+
+def hierarchical_allreduce_striped_schedule(
+    x, *, func: ReduceFunction, axis, rankmap: RankMap,
+    wire: TierWire | None = None, stripes: int = 1,
+):
+    """Striped, software-pipelined two-tier allreduce over GLOBAL ranks:
+    RS(inner) -> AR(outer on the 1/L shard) -> AG(inner), payload split
+    into `stripes` independent stripes.
+
+    Unlike the per-axis composition above, every hop here is a permute
+    over the COMBINED axis with globally-numbered pairs from the
+    RankMap (inner hops stay within a host, outer hops cross hosts), so
+    the same body runs on a real (dcn, ici) mesh, on the DCN device's
+    tuple axis, and on a flat single-axis mesh with a VIRTUAL topology
+    (the 8-dev CPU mesh as 4 pods x 2) — and the static analyzers read
+    it through the ordinary single-axis trace seam with no special
+    casing.
+
+    Striping is the pipelining lever: the stripes' phase chains are
+    data-independent, so while stripe i's shard crosses the slow outer
+    tier, stripe i+1 runs its inner reduce-scatter on the fast tier —
+    XLA overlaps the independent permutes exactly like the reference's
+    segmenter overlaps rx slots. The stripe count is chosen by the cost
+    model (timing.best_stripes), not hardcoded: plan.stripes rides the
+    frozen Plan, so S is part of the XLA cache key.
+
+    Built from the SAME ring bodies the flat path lowers
+    (schedules.reduce_scatter/allreduce/allgather_ring_schedule via the
+    `ring=` embedding), so fused sequences stay bitwise-identical to
+    eager dispatch — nothing is re-modeled."""
+    if wire is None:
+        wire = TierWire()
+    L, P = rankmap.inner_world, rankmap.outer_world
+    n = x.shape[-1]
+    me = lax.axis_index(axis)
+    inner_ring = (rankmap.inner_pos(me), rankmap.inner_perm())
+    outer_ring = (rankmap.outer_pos(me), rankmap.outer_perm())
+
+    S = max(int(stripes), 1)
+    per = -(-n // S)  # ceil: stripe width before the L-padding
+    outs = []
+    for s in range(S):
+        seg = x[s * per: min((s + 1) * per, n)]
+        if seg.shape[-1] == 0:
+            continue
+        padded = _pad_to(seg, L)
+        # fast tier: reduce-scatter so each inner position holds the
+        # host-partial of its 1/L chunk
+        shard = schedules.reduce_scatter_ring_schedule(
+            padded, func=func, axis=axis, world=L, wire=wire.inner,
+            ring=inner_ring)
+        # slow tier: allreduce the 1/L shard across hosts — the only
+        # bytes that ever cross DCN
+        shard = schedules.allreduce_ring_schedule(
+            shard, func=func, axis=axis, world=P, wire=wire.outer,
+            seg_count=shard.shape[-1], ring=outer_ring)
+        # fast tier: rebuild the full stripe from the L shards
+        full = schedules.allgather_ring_schedule(
+            shard, axis=axis, world=L, wire=wire.inner, ring=inner_ring)
+        outs.append(full[: seg.shape[-1]])
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+
 def hierarchical_allreduce_schedule(
     x, *, func: ReduceFunction, inner_axis: str, outer_axis: str,
     inner_world: int, outer_world: int, wire,

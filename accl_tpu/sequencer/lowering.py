@@ -218,6 +218,36 @@ class ScheduleCompiler:
 
             return synthesis.lower_plan(plan, options, world, axis)
 
+        if plan.algorithm == Algorithm.HIER_RS_AR_AG:
+            # Striped two-tier allreduce: every hop is a GLOBAL permute
+            # (inner hops stay within a slice, outer hops cross), so the
+            # same body lowers on a flat axis, the DCN tuple axis, and
+            # the analyzers' single-axis trace seam. Per-tier wires come
+            # from the plan's frozen tier dtypes, resolved against the
+            # arith table exactly like the flat wire path.
+            from . import hierarchical
+
+            func = ReduceFunction(options.function)
+
+            def tier_wire(dt: DataType) -> schedules.Wire:
+                cfg = (self.arith_table.get((options.data_type, dt))
+                       if dt not in (DataType.none, options.data_type)
+                       else None)
+                lane = None
+                if arithcfg is not None:
+                    lane = arithcfg.arith_lanes[int(func)]
+                return schedules.Wire(cfg, lane)
+
+            rm = hierarchical.RankMap(plan.inner_world, plan.outer_world,
+                                      "outer_major")
+            tw = hierarchical.TierWire(tier_wire(plan.inner_wire_dtype),
+                                       tier_wire(plan.outer_wire_dtype))
+            body = functools.partial(
+                hierarchical.hierarchical_allreduce_striped_schedule,
+                func=func, axis=axis, rankmap=rm, wire=tw,
+                stripes=plan.stripes)
+            return body, 1
+
         func = ReduceFunction(options.function) if op in (
             Operation.combine,
             Operation.reduce,

@@ -48,6 +48,13 @@ class Algorithm(enum.IntEnum):
     # (sequencer/synthesis.py): Plan.synth_key names the entry; the
     # compiler lowers the certified DAG instead of a Python body.
     SYNTHESIZED = 13
+    # Striped two-tier allreduce (sequencer/hierarchical.py, HiCCL's
+    # multiply/factor composition): RS(inner) -> AR(outer shard) ->
+    # AG(inner) over Plan.stripes software-pipelined stripes, with
+    # per-tier wire dtypes. Reachable only through the
+    # HIER_ALLREDUCE_MIN_COUNT register window on a device that
+    # declares a two-tier topology.
+    HIER_RS_AR_AG = 14
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +91,18 @@ class Plan:
     # the compiler lowers. Part of the frozen Plan, so it rides the XLA
     # cache key like every other selection decision.
     synth_key: str = ""
+    # HIER_RS_AR_AG plans: the two-tier shape and the per-tier wire
+    # decision. inner/outer_world pin the topology the schedule was
+    # selected for; stripes is the cost-model-chosen pipeline depth;
+    # inner/outer_wire_dtype are the per-tier compression lanes
+    # (select_tier_wires arbitrates each link separately — int8 on DCN
+    # while fp32 stays on ICI). All frozen, so every one of these
+    # decisions rides the Plan/XLA cache key.
+    inner_world: int = 0
+    outer_world: int = 0
+    stripes: int = 1
+    inner_wire_dtype: DataType = DataType.none
+    outer_wire_dtype: DataType = DataType.none
 
 
 def is_rendezvous(
@@ -138,6 +157,9 @@ def select_algorithm(
     eager_rx_buf_size: int,
     tuning: TuningParams,
     compress_dtype: DataType = DataType.none,
+    topology: tuple[int, int] | None = None,
+    tier_wires: tuple[DataType, DataType] = (DataType.none, DataType.none),
+    tier_links=None,
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
@@ -146,6 +168,19 @@ def select_algorithm(
     the wire dtype of an ETH_COMPRESSED call (the descriptor's
     compress_dtype): it rides the Plan so the timing model charges wire
     widths, not payload widths.
+
+    `topology=(inner_world, outer_world)` declares the caller's
+    two-tier shape (a DCN device's (ici, dcn) extents, or a virtual
+    factoring of a flat mesh). With it, allreduce payloads inside the
+    HIER_ALLREDUCE_MIN_COUNT register window run the striped two-tier
+    composition (Algorithm.HIER_RS_AR_AG); the register defaults 0
+    (off) and is set by ACCL.autotune from the calibrated per-tier
+    crossover, so absent a tune the behavior is bit-for-bit the flat
+    selection. `tier_wires=(inner, outer)` are the per-tier wire dtypes
+    (select_tier_wires arbitrates them); `tier_links` is a
+    timing.TierLinks used to pick the stripe count (default: the
+    shipped per-tier calibration, telemetry.feedback.default_tier_links
+    — no calibration means 1 stripe, never a made-up pipeline depth).
     """
     bytes_count = count * dtype_nbytes
     rndzv = is_rendezvous(bytes_count, compression, stream, max_eager_size)
@@ -172,6 +207,45 @@ def select_algorithm(
         return Plan(proto, Algorithm.NONE, count, 1)
     if world_size == 1 and scenario != Operation.barrier:
         return Plan(proto, Algorithm.NONE, count, 1)
+
+    # Striped two-tier allreduce (sequencer/hierarchical.py): reachable
+    # ONLY inside the HIER_ALLREDUCE_MIN_COUNT register window on a
+    # caller that declared a two-tier topology — the same
+    # measured-selection posture as the synth registers (register 0
+    # keeps selection bit-for-bit unchanged). Checked BEFORE the
+    # synthesized library: the synth windows were calibrated on a
+    # uniform link, and on a declared two-tier world their flat
+    # hop-DAGs would drag full payloads across the slow tier — a
+    # caller who declared the topology and tuned the hier register has
+    # asserted the per-tier calibration governs here. Only exact
+    # uncompressed unstreamed calls are eligible; per-tier compression
+    # rides tier_wires/the plan's tier dtypes instead of the
+    # descriptor's global compression flag.
+    if scenario == Operation.allreduce and topology is not None:
+        inner_w, outer_w = topology
+        if (tuning.hier_allreduce_min_count > 0
+                and inner_w > 1 and outer_w > 1
+                and inner_w * outer_w == world_size
+                and bytes_count >= tuning.hier_allreduce_min_count
+                and stream == StreamFlags.NO_STREAM
+                and compression == CompressionFlags.NO_COMPRESSION):
+            from .timing import best_stripes
+
+            iw, ow = tier_wires
+            links = tier_links
+            if links is None:
+                from ..telemetry.feedback import default_tier_links
+
+                links = default_tier_links()
+            stripes = 1
+            if links is not None:
+                stripes = best_stripes(
+                    links, count, dtype_nbytes, inner_w, outer_w,
+                    inner_wire=iw, outer_wire=ow)
+            return Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG,
+                        count, 1, inner_world=inner_w,
+                        outer_world=outer_w, stripes=stripes,
+                        inner_wire_dtype=iw, outer_wire_dtype=ow)
 
     # Synthesized schedules (sequencer/synthesis.py): payloads inside a
     # synth crossover register run the search-produced hop-DAG for this
@@ -387,3 +461,67 @@ def select_wire(
         if t < t_best and (t_none - t) > min_gain * t_none:
             best, t_best = cmp_, t
     return best
+
+
+def select_tier_wires(
+    count: int,
+    data_type: DataType,
+    topology: tuple[int, int],
+    tier_links,
+    *,
+    arith_table: dict | None = None,
+    min_gain: float = 0.05,
+    quantized_ok: bool = True,
+) -> tuple[DataType, DataType]:
+    """Per-tier wire arbitration for the striped hierarchical allreduce:
+    `select_wire`'s predicted-time decision, made ONCE PER LINK.
+
+    The hierarchical cost decomposes by tier (timing.hier_phase_costs
+    charges phases 1/3 to the inner link and phase 2 to the outer), so
+    each tier's wire is chosen independently: the candidate set is the
+    arithmetic-configuration rows for the payload dtype, each costed
+    through predict_tiered with that tier's wire active and the other
+    uncompressed, and a compressed wire wins only when it beats the
+    tier's uncompressed baseline by `min_gain` of the TOTAL call time.
+    The typical calibrated outcome is exactly HiCCL's: int8 codes on
+    the slow DCN tier (where wire bytes dominate), fp32 kept exact on
+    ICI (where the latency term dominates and quantization error buys
+    nothing). Returns (inner_wire, outer_wire) — DataType.none = stay
+    uncompressed — which callers hand to select_algorithm's
+    `tier_wires=`."""
+    from ..arithconfig import DEFAULT_ARITH_CONFIG
+    from ..constants import dtype_nbytes
+    from ..ops.compression import is_quantized
+    from .timing import best_stripes, predict_tiered
+
+    table = arith_table or DEFAULT_ARITH_CONFIG
+    elem_bytes = dtype_nbytes(data_type)
+    inner_w, outer_w = topology
+
+    def cost(iw: DataType, ow: DataType) -> float:
+        stripes = best_stripes(tier_links, count, elem_bytes, inner_w,
+                               outer_w, inner_wire=iw, outer_wire=ow)
+        plan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, count, 1,
+                    inner_world=inner_w, outer_world=outer_w,
+                    stripes=stripes, inner_wire_dtype=iw,
+                    outer_wire_dtype=ow)
+        return predict_tiered(tier_links, plan, count, elem_bytes)
+
+    picks = []
+    for tier in ("inner", "outer"):
+        def with_tier(w: DataType) -> float:
+            return cost(w, DataType.none) if tier == "inner" \
+                else cost(DataType.none, w)
+
+        t_none = with_tier(DataType.none)
+        best, t_best = DataType.none, t_none
+        for (unc, cmp_), row in table.items():
+            if unc != data_type or cmp_ == unc:
+                continue
+            if not quantized_ok and is_quantized(row):
+                continue
+            t = with_tier(cmp_)
+            if t < t_best and (t_none - t) > min_gain * t_none:
+                best, t_best = cmp_, t
+        picks.append(best)
+    return picks[0], picks[1]

@@ -50,6 +50,24 @@ def _ring_perm(world: int, distance: int = 1):
     return [(i, (i + distance) % world) for i in range(world)]
 
 
+def _ring_ctx(axis, world, ring):
+    """Resolve the ring embedding a ring schedule runs on.
+
+    By default a ring schedule IS the axis: position = axis_index, hops
+    = the distance-1 rotation over the axis extent. `ring=(pos, perm)`
+    embeds the same schedule onto a SUB-ring of a wider axis (the
+    two-tier compositions in hierarchical.py): `pos` is this rank's
+    traced position on its ring [0, world) and `perm` the GLOBAL
+    ppermute pairs one ring hop expresses (e.g. every host's inner ring
+    advancing in lockstep). The chunk arithmetic below depends only on
+    (pos, world), so one body serves the flat axis and every tier
+    embedding — which is what keeps the hierarchical compositions
+    bitwise-identical to the flat families they are built from."""
+    if ring is None:
+        return lax.axis_index(axis), _ring_perm(world)
+    return ring
+
+
 def _fast_log2(x: int) -> int:
     return x.bit_length() - 1
 
@@ -286,25 +304,26 @@ def gather_flat_schedule(x, *, root: int, axis, world, wire, fanin: int):
     return out
 
 
-def allgather_ring_schedule(x, *, axis, world, wire):
+def allgather_ring_schedule(x, *, axis, world, wire, ring=None):
     """Ring allgather (eager .c:1402-1499, rendezvous .c:1314-1401): P-1
     relay steps; the step-s arrival originates from rank me-1-s."""
     if wire.quantized:
-        return _allgather_ring_quant(x, axis=axis, world=world, wire=wire)
+        return _allgather_ring_quant(x, axis=axis, world=world, wire=wire,
+                                     ring=ring)
     count = x.shape[-1]
-    me = lax.axis_index(axis)
+    me, perm = _ring_ctx(axis, world, ring)
     out = jnp.zeros((world * count,), x.dtype)
     out = lax.dynamic_update_slice_in_dim(out, x, me * count, axis=-1)
     relay = x
     for s in range(world - 1):
-        recv = wire.ppermute(relay, axis, _ring_perm(world))
+        recv = wire.ppermute(relay, axis, perm)
         origin = (me - 1 - s) % world
         out = lax.dynamic_update_slice_in_dim(out, recv, origin * count, axis=-1)
         relay = recv
     return out
 
 
-def _allgather_ring_quant(x, *, axis, world, wire):
+def _allgather_ring_quant(x, *, axis, world, wire, ring=None):
     """Quantized ring allgather: each rank encodes its chunk ONCE and the
     (codes, scales) pair relays around the ring unchanged — one
     quantization error per chunk total (not per hop), and every rank
@@ -313,13 +332,13 @@ def _allgather_ring_quant(x, *, axis, world, wire):
     encode/decode round trip the remote copies take, which is what makes
     the quantized allreduce's result identical on every rank."""
     count = x.shape[-1]
-    me = lax.axis_index(axis)
+    me, perm = _ring_ctx(axis, world, ring)
     out = jnp.zeros((world * count,), x.dtype)
     enc = wire.encode(x)
     out = lax.dynamic_update_slice_in_dim(
         out, wire.decode(enc, count, x.dtype), me * count, axis=-1)
     for s in range(world - 1):
-        enc = wire.hop(enc, axis, _ring_perm(world))
+        enc = wire.hop(enc, axis, perm)
         origin = (me - 1 - s) % world
         out = lax.dynamic_update_slice_in_dim(
             out, wire.decode(enc, count, x.dtype), origin * count, axis=-1)
@@ -377,28 +396,28 @@ def reduce_bin_tree_schedule(x, *, root: int, func, axis, world, wire):
     return acc
 
 
-def reduce_scatter_ring_schedule(x, *, func, axis, world, wire):
+def reduce_scatter_ring_schedule(x, *, func, axis, world, wire, ring=None):
     """Ring reduce-scatter (.c:1782-1850): P-1 steps; at step s each rank
     combines the arriving partial with its local copy of chunk me-1-s and
     forwards; rank r ends holding reduced chunk r."""
     if wire.quantized:
         return _reduce_scatter_ring_quant(
-            x, func=func, axis=axis, world=world, wire=wire)
+            x, func=func, axis=axis, world=world, wire=wire, ring=ring)
     count = x.shape[-1] // world
-    me = lax.axis_index(axis)
+    me, perm = _ring_ctx(axis, world, ring)
     # Step-0 send is our local copy of chunk me-1; the step-s arrival is the
     # running partial of chunk me-2-s, combined with our local copy and
     # forwarded. After P-1 hops rank r holds fully-reduced chunk r.
     v = lax.dynamic_slice_in_dim(x, ((me - 1) % world) * count, count, axis=-1)
     for s in range(world - 1):
-        recv = wire.ppermute(v, axis, _ring_perm(world))
+        recv = wire.ppermute(v, axis, perm)
         idx = (me - 2 - s) % world
         local = lax.dynamic_slice_in_dim(x, idx * count, count, axis=-1)
         v = wire.combine(func, recv, local)
     return v
 
 
-def _reduce_scatter_ring_quant(x, *, func, axis, world, wire):
+def _reduce_scatter_ring_quant(x, *, func, axis, world, wire, ring=None):
     """Quantized ring reduce-scatter: the fused quantize-reduce ring.
     The traveling partial stays ENCODED between hops — only (int8 codes +
     per-block scales) cross each ppermute — while every combine runs the
@@ -407,12 +426,12 @@ def _reduce_scatter_ring_quant(x, *, func, axis, world, wire):
     lands the fp32 partial directly (one quantization pass per hop on the
     partial's path, P-1 total)."""
     count = x.shape[-1] // world
-    me = lax.axis_index(axis)
+    me, perm = _ring_ctx(axis, world, ring)
     v = lax.dynamic_slice_in_dim(x, ((me - 1) % world) * count, count, axis=-1)
     enc = wire.encode(v)
     out = v  # world == 1 degenerates to the local chunk (plan NONE upstream)
     for s in range(world - 1):
-        enc = wire.hop(enc, axis, _ring_perm(world))
+        enc = wire.hop(enc, axis, perm)
         local = lax.dynamic_slice_in_dim(
             x, ((me - 2 - s) % world) * count, count, axis=-1)
         if s < world - 2:
@@ -422,7 +441,8 @@ def _reduce_scatter_ring_quant(x, *, func, axis, world, wire):
     return out
 
 
-def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):
+def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int,
+                            ring=None):
     """Segmented ring allreduce (.c:1888-2071): per segment, a ring
     reduce-scatter over world-size chunks followed by a ring allgather.
     Segments bound scratch footprint and pipeline across the loop."""
@@ -432,9 +452,10 @@ def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int):
         padded = _pad_to_multiple(seg, world)
         chunk = padded.shape[-1] // world
         red = reduce_scatter_ring_schedule(
-            padded, func=func, axis=axis, world=world, wire=wire
+            padded, func=func, axis=axis, world=world, wire=wire, ring=ring
         )
-        gathered = allgather_ring_schedule(red, axis=axis, world=world, wire=wire)
+        gathered = allgather_ring_schedule(red, axis=axis, world=world,
+                                           wire=wire, ring=ring)
         return gathered[: seg.shape[-1]]
 
     return segmented_apply(one_segment, x, seg_count)

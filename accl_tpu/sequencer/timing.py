@@ -73,6 +73,27 @@ class LinkParams:
         return self.alpha * messages + nbytes / self.beta
 
 
+@dataclasses.dataclass(frozen=True)
+class TierLinks:
+    """Per-tier link parameters of a two-tier world: `inner` is the
+    fast intra-slice link (ICI / local POE), `outer` the slow
+    cross-slice link (DCN / TCP). Each tier is calibrated
+    independently — telemetry.feedback.calibrate_tiers_from_trace
+    refits each from its own tier-tagged spans — so the hierarchical
+    predictions charge every phase's wire bytes to the link it actually
+    crosses (HiCCL's per-tier-model posture)."""
+
+    inner: LinkParams
+    outer: LinkParams
+
+    def of(self, tier: str) -> LinkParams:
+        if tier == "inner":
+            return self.inner
+        if tier == "outer":
+            return self.outer
+        raise ValueError(f"unknown tier {tier!r}")
+
+
 def emulator_link(model: dict[str, Any]) -> LinkParams:
     """The emulator-tier LinkParams of a timing-model document: the
     bcast per-collective row (the root-serialized collective whose
@@ -165,6 +186,12 @@ def coefficients(
 
         return cost_shape(entry_for_key(plan.synth_key).spec, count,
                           elem_bytes, aggregate=False)
+    if alg == Algorithm.HIER_RS_AR_AG:
+        # single-link fallback (the flat-link callers: refit sampling,
+        # facade prediction): all phases summed over all stripes, both
+        # tiers charged to the one link. The calibrated per-tier,
+        # pipelined prediction is predict_tiered.
+        return _hier_flat_cost(plan, count, elem_bytes, aggregate=False)
     s = _segs(n, rx_buf_bytes)  # eager segments per full-payload message
 
     if alg == Algorithm.EAGER_SENDRECV:
@@ -270,6 +297,8 @@ def coefficients_aggregate(
 
         return cost_shape(entry_for_key(plan.synth_key).spec, count,
                           elem_bytes, aggregate=True)
+    if alg == Algorithm.HIER_RS_AR_AG:
+        return _hier_flat_cost(plan, count, elem_bytes, aggregate=True)
     r = math.ceil(math.log2(P)) if P > 1 else 0
 
     if alg in (Algorithm.EAGER_SENDRECV, Algorithm.RNDZV_SENDRECV,
@@ -332,6 +361,124 @@ def coefficients_aggregate(
             _segs(n, _STREAM_SEG)
         return P * (P - 1) * per, P * (P - 1) * n
     raise ValueError(f"no aggregate cost shape for {alg}")
+
+
+def _hier_flat_cost(plan: Plan, count: int, elem_bytes: int, *,
+                    aggregate: bool) -> tuple[float, float]:
+    """All stripes of all phases summed onto ONE link — the cost shape
+    coefficients/coefficients_aggregate expose for HIER plans to
+    single-link consumers."""
+    S = max(plan.stripes, 1)
+    tm = tb = 0.0
+    for _tier, m, b in hier_phase_costs(plan, count, elem_bytes,
+                                        aggregate=aggregate):
+        tm += S * m
+        tb += S * b
+    return tm, tb
+
+
+def hier_phase_costs(
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    *,
+    aggregate: bool = False,
+) -> list[tuple[str, float, float]]:
+    """(tier, messages, bytes) of the three phases of ONE STRIPE of the
+    striped hierarchical allreduce (Algorithm.HIER_RS_AR_AG):
+
+        1. inner reduce-scatter  — (L-1) ring hops of the 1/L chunk
+        2. outer allreduce       — 2(P-1) ring hops of the 1/(L*P) chunk
+        3. inner allgather       — (L-1) ring hops of the 1/L chunk
+
+    Bytes are WIRE bytes PER TIER: phase 1/3 charge the inner wire
+    dtype, phase 2 the outer one — this is the accounting that lets
+    `select_tier_wires` see int8-on-DCN as a win without pretending ICI
+    compressed too. aggregate=True sums over all ranks (the
+    serialized-host regime); default is the per-link critical path."""
+    L, P = max(plan.inner_world, 1), max(plan.outer_world, 1)
+    S = max(plan.stripes, 1)
+    stripe = -(-count // S)  # ceil
+    padded = stripe + (-stripe) % L
+    chunk = padded // L  # elements of one inner chunk == the outer shard
+    n_i = chunk * wire_elem_bytes(elem_bytes, plan.inner_wire_dtype)
+    shard_pad = chunk + (-chunk) % P
+    n_o = (shard_pad // P) * wire_elem_bytes(elem_bytes,
+                                             plan.outer_wire_dtype)
+    m_rs = (L - 1) * _segs(int(n_i), _STREAM_SEG)
+    b_rs = (L - 1) * n_i
+    m_ar = 2 * (P - 1) * _segs(int(n_o), _STREAM_SEG)
+    b_ar = 2 * (P - 1) * n_o
+    if aggregate:
+        # every rank runs every phase; a serialized host pays all of it
+        world = L * P
+        return [("inner", world * m_rs, world * b_rs),
+                ("outer", world * m_ar, world * b_ar),
+                ("inner", world * m_rs, world * b_rs)]
+    return [("inner", m_rs, b_rs), ("outer", m_ar, b_ar),
+            ("inner", m_rs, b_rs)]
+
+
+def predict_tiered(
+    links: TierLinks,
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    *,
+    aggregate: bool = False,
+) -> float:
+    """Expected seconds for a striped hierarchical allreduce plan with
+    each phase charged to ITS OWN tier link, software pipelining
+    included: the S stripes' chains overlap across the two link
+    resources, so
+
+        T = t_rs + t_ar + t_ag + (S - 1) * max(t_rs + t_ag, t_ar)
+
+    — fill + drain of the pipeline plus S-1 repetitions of the
+    bottleneck tier (the inner link runs both RS and AG, the outer link
+    runs the shard allreduce; whichever is busier paces the steady
+    state). aggregate=True models the serialized host, where nothing
+    overlaps: T = S * sum(phases)."""
+    phases = hier_phase_costs(plan, count, elem_bytes, aggregate=aggregate)
+    t = [links.of(tier).seconds(m, b) for tier, m, b in phases]
+    S = max(plan.stripes, 1)
+    if aggregate:
+        return S * sum(t)
+    inner_busy = t[0] + t[2]
+    outer_busy = t[1]
+    return sum(t) + (S - 1) * max(inner_busy, outer_busy)
+
+
+def best_stripes(
+    links: TierLinks,
+    count: int,
+    elem_bytes: int,
+    inner_world: int,
+    outer_world: int,
+    *,
+    inner_wire: DataType = DataType.none,
+    outer_wire: DataType = DataType.none,
+    candidates: tuple[int, ...] = (1, 2, 4, 8),
+    aggregate: bool = False,
+) -> int:
+    """The cost model's stripe count for a hierarchical allreduce: the
+    S minimizing the pipelined prediction (ties break toward fewer
+    stripes — less padding, smaller program). This is the ONLY source
+    of Plan.stripes, so S is a measured-model decision, never a
+    hardcoded constant."""
+    best_s, best_t = 1, float("inf")
+    for s in candidates:
+        if s > max(count, 1):
+            continue
+        plan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, count, 1,
+                    inner_world=inner_world, outer_world=outer_world,
+                    stripes=s, inner_wire_dtype=inner_wire,
+                    outer_wire_dtype=outer_wire)
+        t = predict_tiered(links, plan, count, elem_bytes,
+                           aggregate=aggregate)
+        if t < best_t - 1e-15:
+            best_s, best_t = s, t
+    return best_s
 
 
 def predict(
@@ -430,7 +577,9 @@ def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
 def tuning_crossovers(params: LinkParams, *, world: int = 8,
                       elem_bytes: int = 4,
                       rx_buf_bytes: int = 4096,
-                      wire_dtype: DataType = DataType.none) -> dict:
+                      wire_dtype: DataType = DataType.none,
+                      tier_links: "TierLinks | None" = None,
+                      topology: tuple[int, int] | None = None) -> dict:
     """The model's own switch-over points for the five tuning registers
     (reference defaults accl.cpp:1198-1208: gather fan-in capped above
     32 KB, bcast flat <= 3 ranks, reduce flat <= 4 ranks or <= 32 KB).
@@ -565,7 +714,53 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                 sbytes *= 2
         synth_regs[f"synth_{op_key}_max_bytes"] = best_bytes
 
+    # Hierarchical-allreduce crossover: with per-tier links and a
+    # declared (inner, outer) topology, the START of the CONTIGUOUS
+    # winning SUFFIX — the smallest payload such that the striped
+    # two-tier composition (best stripe count per size) predicts faster
+    # than the flat ring at that size and every LARGER swept size. The
+    # register is a MIN threshold ([min, inf) window) because the
+    # composition's win is the bandwidth regime: it moves 1/L of the
+    # bytes on the slow tier but pays more message latencies, so it
+    # loses the latency floor and wins from some size up. A win set
+    # that does not extend to the top of the sweep cannot be expressed
+    # by the single threshold and is NOT overclaimed (same contiguity
+    # posture as the synth windows). The flat ring over a two-tier
+    # world is paced by its SLOWEST links — every ring step includes
+    # the cross-slice edges — so the flat side is charged to the outer
+    # link. 0 = no tier calibration / no topology / never wins: the
+    # register stays off and selection is bit-for-bit unchanged.
+    hier_min = 0
+    if tier_links is not None and topology is not None:
+        L_in, P_out = topology
+        if L_in > 1 and P_out > 1 and L_in * P_out == P:
+            hkw: dict = dict(max_eager_size=rx_buf_bytes,
+                             eager_rx_buf_size=rx_buf_bytes)
+            nb = 1 << 10
+            while nb <= (1 << 24):
+                cnt = max(nb // elem_bytes, 1)
+                s_best = best_stripes(tier_links, cnt, elem_bytes, L_in,
+                                      P_out)
+                hplan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG,
+                             cnt, 1, inner_world=L_in, outer_world=P_out,
+                             stripes=s_best)
+                t_hier = predict_tiered(tier_links, hplan, cnt,
+                                        elem_bytes)
+                flat = select_algorithm(
+                    Operation.allreduce, cnt, elem_bytes, P,
+                    tuning=ring_only, **hkw)
+                t_flat = predict(tier_links.outer, Operation.allreduce,
+                                 flat, cnt, elem_bytes, P,
+                                 rx_buf_bytes=rx_buf_bytes)
+                if t_hier < t_flat:
+                    if hier_min == 0:
+                        hier_min = nb  # candidate start of the suffix
+                else:
+                    hier_min = 0  # loss above a win: suffix restarts
+                nb *= 2
+
     return {
+        "hier_allreduce_min_bytes": hier_min,
         "bcast_flat_tree_max_ranks": bcast_max,
         "reduce_flat_tree_max_count_bytes": reduce_cross,
         "gather_flat_tree_max_count_bytes": gather_cross,

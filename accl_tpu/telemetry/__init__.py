@@ -46,7 +46,9 @@ from .export import (  # noqa: F401
 from .feedback import (  # noqa: F401
     autotune_from_trace,
     calibrate_from_trace,
+    calibrate_tiers_from_trace,
     default_link,
+    default_tier_links,
     residual_improvement,
     residual_report,
 )

@@ -27,7 +27,7 @@ from __future__ import annotations
 import json
 import pathlib
 
-from ..sequencer.timing import LinkParams, calibrate
+from ..sequencer.timing import LinkParams, TierLinks, calibrate
 from .export import measured_seconds, median, residual_rows, residual_summary
 
 _MODEL_PATH = (pathlib.Path(__file__).resolve().parents[2]
@@ -69,14 +69,24 @@ def _load_link(p: pathlib.Path) -> LinkParams | None:
         return None
 
 
-def hop_samples(trace: dict) -> list[tuple[float, float, float]]:
+def hop_samples(trace: dict,
+                tier: str | None = None) -> list[tuple[float, float, float]]:
     """(messages, bytes, measured_seconds) samples from every span that
     carries its aggregate cost coefficients and a positive measurement —
-    the exact input shape timing.calibrate fits."""
+    the exact input shape timing.calibrate fits. `tier="inner"|"outer"`
+    keeps only spans tagged with that tier (args["tier"], SPAN
+    v1-compatible detail key), the labeled-sample source for the
+    per-tier refit. `tier=None` — the flat fit — keeps only UNTAGGED
+    spans: a tier-tagged span's measurement belongs to that tier's
+    link, and pooling two links with different alpha/beta into one fit
+    would average them into a model of neither (the exact failure the
+    tier labels exist to prevent)."""
     samples = []
     for sp in trace.get("spans", []):
         args = sp.get("args", {})
         if "coef_messages" not in args or "coef_bytes" not in args:
+            continue
+        if args.get("tier") != tier:
             continue
         m = float(args["coef_messages"])
         b = float(args["coef_bytes"])
@@ -89,17 +99,62 @@ def hop_samples(trace: dict) -> list[tuple[float, float, float]]:
     return samples
 
 
-def calibrate_from_trace(trace: dict) -> LinkParams:
-    """Refit LinkParams from a trace's measured hop spans. Raises
-    ValueError when the trace carries no calibratable spans (a trace
-    from a run with tracing off, or pure host-phase spans)."""
-    samples = hop_samples(trace)
+def calibrate_from_trace(trace: dict, tier: str | None = None) -> LinkParams:
+    """Refit LinkParams from a trace's measured hop spans (optionally
+    only the spans tagged with one `tier`). Raises ValueError when the
+    trace carries no calibratable spans (a trace from a run with
+    tracing off, or pure host-phase spans)."""
+    samples = hop_samples(trace, tier=tier)
     if len(samples) < 2:
+        where = f" tagged tier={tier!r}" if tier else ""
         raise ValueError(
-            f"trace has {len(samples)} calibratable span(s); need >= 2 "
-            "(native spans with coef_messages/coef_bytes — run with "
-            "ACCL_RT_TRACE=1 and drain through telemetry.native)")
+            f"trace has {len(samples)} calibratable span(s){where}; "
+            "need >= 2 (native spans with coef_messages/coef_bytes — "
+            "run with ACCL_RT_TRACE=1 and drain through "
+            "telemetry.native)")
     return calibrate(samples)
+
+
+def calibrate_tiers_from_trace(trace: dict) -> TierLinks:
+    """The per-tier form of calibrate_from_trace: each tier of a
+    two-tier world refit INDEPENDENTLY from its own tier-tagged spans
+    (args["tier"] == "inner" / "outer" — the emulated 2-tier bench
+    world tags inner-POE and outer-TCP calls at drain time). This is
+    what makes the hierarchical predictions honest: the DCN link's
+    alpha/beta are fit from DCN measurements only, never averaged with
+    ICI's."""
+    return TierLinks(inner=calibrate_from_trace(trace, tier="inner"),
+                     outer=calibrate_from_trace(trace, tier="outer"))
+
+
+def default_tier_links(path=None) -> TierLinks | None:
+    """The shipped per-tier calibration: the timing model document's
+    `link_tiers` section ({"inner": {alpha_us, beta_gbps}, "outer":
+    {...}}, written by bench.py --hier-gate's per-tier refit). None
+    when the model carries no tier fit — callers (autotune, stripe
+    selection) must then leave hierarchical selection off rather than
+    invent a slow-tier model."""
+    p = pathlib.Path(path) if path else _MODEL_PATH
+    key = (p, "tiers")
+    if key in _default_link_cache:
+        return _default_link_cache[key]
+    try:
+        model = json.loads(p.read_text())
+        tiers = model.get("link_tiers")
+        links: TierLinks | None = TierLinks(
+            inner=LinkParams(alpha=tiers["inner"]["alpha_us"] * 1e-6,
+                             beta=tiers["inner"]["beta_gbps"] * 1e9),
+            outer=LinkParams(alpha=tiers["outer"]["alpha_us"] * 1e-6,
+                             beta=tiers["outer"]["beta_gbps"] * 1e9),
+        )
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        # negative result cached too: this sits on the per-call plan
+        # selection path (an in-window select_algorithm with no caller
+        # tier_links lands here), and re-reading the model file on
+        # every call is hot-path disk I/O for the same None
+        links = None
+    _default_link_cache[key] = links
+    return links
 
 
 def _rel_errs(trace: dict, link: LinkParams) -> list[float]:

@@ -109,13 +109,19 @@ def native_event(
     tuning: TuningParams | None = None,
     ts_base_ns: int | None = None,
     logp_shape: bool | None = None,
+    tier: str | None = None,
 ) -> dict:
     """Lift one raw EmuRank.trace_read record into a SPAN v1 event.
 
     `ts_base_ns` rebases the runtime-relative native clock into the
     host perf_counter_ns domain (pass the host ns that corresponds to
     the runtime's creation; default anchors 0 at drain time minus the
-    span's own end, which keeps relative order within a rank)."""
+    span's own end, which keeps relative order within a rank).
+    `tier` tags the span with the two-tier link it crossed
+    (args["tier"] = "inner" | "outer", a SPAN v1-compatible detail
+    key): Chrome-trace tracks split by it and
+    feedback.calibrate_tiers_from_trace refits each tier from exactly
+    its own labeled samples."""
     op = Operation(raw["opcode"])
     count = int(raw["count"])
     nbytes = int(raw["bytes"])
@@ -140,6 +146,8 @@ def native_event(
         "d_seek_hit": int(raw["d_seek_hit"]),
         "d_seek_miss": int(raw["d_seek_miss"]),
     }
+    if tier is not None:
+        args["tier"] = tier
     if plan is not None:
         args["algorithm"] = plan.algorithm.name
         args["protocol"] = plan.protocol.name
@@ -166,10 +174,15 @@ def drain_world(
     tuning: TuningParams | None = None,
     tracer=None,
     logp_shape: bool | None = None,
+    tier: str | None = None,
+    track_prefix: str = "emu",
 ) -> tuple[list[dict], int]:
     """Drain every rank of an EmuWorld into SPAN v1 events (one track
     per rank). Returns (events, total_dropped); when `tracer` is given
-    the events are also appended to its ring."""
+    the events are also appended to its ring. `tier` tags every
+    drained span (a whole EmuWorld plays one tier of an emulated
+    two-tier world — inner POE groups or the outer TCP group);
+    `track_prefix` keeps the tiers' tracks apart in the export."""
     events: list[dict] = []
     dropped = 0
     now = time.perf_counter_ns()
@@ -185,9 +198,10 @@ def drain_world(
         for r in raw:
             events.append(native_event(
                 r, world=len(emu_world.ranks),
+                track=f"{track_prefix}/r{r.get('rank', 0)}",
                 link=link, max_eager_size=max_eager_size,
                 rx_buf_bytes=rx_buf_bytes, tuning=tuning,
-                ts_base_ns=base, logp_shape=logp_shape))
+                ts_base_ns=base, logp_shape=logp_shape, tier=tier))
     if tracer is not None:
         tracer.extend(events)
     return events, dropped

@@ -685,6 +685,27 @@ struct accl_rt {
   // One-shot by design: the fault arms once per runtime.
   int fault_delay_tail_ms = 0;
   bool fault_drop_tail = false;
+  // ACCL_RT_WAN_ALPHA_US / ACCL_RT_WAN_GBPS: WAN shaper for the socket
+  // transports — every outbound frame pays alpha + bytes/beta on its
+  // per-destination link (inside tx_mu, so frames to one peer
+  // serialize like a real wire) before entering the kernel, turning
+  // loopback sockets into an emulated slow cross-slice (DCN) tier.
+  // Read at create, so one process can hold differently-shaped worlds:
+  // the bench's emulated 2-tier world is unshaped local-POE pods
+  // (fast ICI tier) beside shaped TCP groups (slow DCN tier). The
+  // local POE is never shaped — it IS the fast tier.
+  uint32_t wan_alpha_us = 0;
+  double wan_bytes_per_us = 0.0;
+
+  void wan_charge(size_t payload_len) {
+    if (!wan_alpha_us && wan_bytes_per_us <= 0) return;
+    double us = (double)wan_alpha_us;
+    if (wan_bytes_per_us > 0)
+      us += (double)(sizeof(MsgHeader) + payload_len) / wan_bytes_per_us;
+    if (us >= 1.0)
+      std::this_thread::sleep_for(
+          std::chrono::microseconds((long long)us));
+  }
   std::atomic<bool> fault_armed{false};
   std::vector<std::thread> fault_threads;
   std::mutex fault_mu;
@@ -914,7 +935,10 @@ struct accl_rt {
     }
     if (udp_mode) {
       // sessionless: header + payload in one datagram (udp_packetizer
-      // analog — segment == packet)
+      // analog — segment == packet). The WAN charge has no tx lock to
+      // ride here — the datagram POE has no per-link session to
+      // serialize on in the first place.
+      wan_charge(payload_len);
       std::vector<uint8_t> pkt(sizeof h + payload_len);
       std::memcpy(pkt.data(), &h, sizeof h);
       if (payload_len) std::memcpy(pkt.data() + sizeof h, payload, payload_len);
@@ -923,6 +947,9 @@ struct accl_rt {
       return n == (ssize_t)pkt.size();
     }
     std::lock_guard<std::mutex> g(tx_mu[dst]);
+    // emulated-WAN link charge inside tx_mu: frames to one peer
+    // serialize through their link like a real wire (see wan_alpha_us)
+    wan_charge(payload_len);
     if (getenv("ACCL_RT_DEBUG"))
       fprintf(stderr, "[r%u] tx mt=%u dst=%u fd=%d bytes=%llu\n", rank,
               (unsigned)mt, dst, peer_fd[dst], (unsigned long long)bytes);
@@ -2866,6 +2893,12 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     rt->fault_delay_tail_ms = atoi(s);
   if (const char *s = getenv("ACCL_RT_FAULT_DROP_TAIL"))
     rt->fault_drop_tail = atoi(s) != 0;
+  if (const char *s = getenv("ACCL_RT_WAN_ALPHA_US"))
+    rt->wan_alpha_us = (uint32_t)atoi(s);
+  if (const char *s = getenv("ACCL_RT_WAN_GBPS")) {
+    double g = atof(s);
+    if (g > 0) rt->wan_bytes_per_us = g * 1000.0;  // 1 GB/s = 1000 B/us
+  }
   if (const char *s = getenv("ACCL_RT_TRACE"))
     rt->trace_on = atoi(s) != 0;
   if (const char *s = getenv("ACCL_RT_TRACE_CAP")) {

@@ -717,3 +717,58 @@ def test_cross_executor_p2p_fuzz(cfg):
             np.testing.assert_allclose(
                 res[dst][(g, k)], payloads[(g, k)], rtol=1e-6,
                 err_msg=f"native p2p cfg {i} group {g} msg {k}")
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical two-tier fused-vs-eager fuzz (PR 8): the striped
+# composition through the FULL facade path — register-gated selection,
+# sequence recording, one fused dispatch — must stay bitwise-identical
+# to eager dispatch on the CPU mesh under BOTH virtual factorings.
+# ---------------------------------------------------------------------------
+
+HIER_SEQ_SEEDS = 30
+
+
+@pytest.mark.parametrize("seed", range(HIER_SEQ_SEEDS))
+def test_hier_fused_vs_eager_bitwise(seed):
+    from accl_tpu.accl import ACCL
+    from accl_tpu.device.tpu_device import TPUDevice
+    from accl_tpu.sequencer.plan import Algorithm
+
+    rng = np.random.default_rng(88000 + seed)
+    inner, outer = [(2, 4), (4, 2)][seed % 2]
+    world = inner * outer
+    n = int(rng.integers(8, 3000))
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    dev = TPUDevice(mesh, hier_topology=(inner, outer))
+    accl = ACCL(device=dev)
+    # open the MIN window for every payload: the composition must be
+    # reachable through the REGISTER, not a hand-built plan
+    accl.configure_tuning_parameters(
+        TuningParams(hier_allreduce_min_count=1))
+    plan, _, _ = dev._resolve_step(
+        CallOptions(scenario=Operation.allreduce, count=n,
+                    function=int(ReduceFunction.SUM),
+                    data_type=from_numpy_dtype(np.dtype(np.float32))),
+        dev._comm_ctx(0))
+    assert plan.algorithm == Algorithm.HIER_RS_AR_AG, \
+        f"seed {seed}: register window did not engage ({plan.algorithm})"
+
+    init = rng.integers(-50, 50, (world, n)).astype(np.float32)
+    eager_in = accl.create_buffer(n, data=init)
+    eager_out = accl.create_buffer(n)
+    fused_in = accl.create_buffer(n, data=init)
+    fused_out = accl.create_buffer(n)
+
+    accl.allreduce(eager_in, eager_out, n, ReduceFunction.SUM)
+    rec = accl.sequence()
+    rec.allreduce(fused_in, fused_out, n, ReduceFunction.SUM)
+    req = rec.run()
+    assert req.num_dispatches == 1
+
+    np.testing.assert_array_equal(
+        eager_out.host, fused_out.host,
+        err_msg=f"hier seed {seed} ({inner}x{outer}): fused != eager")
+    np.testing.assert_array_equal(
+        eager_out.host, np.tile(init.sum(0), (world, 1)),
+        err_msg=f"hier seed {seed} ({inner}x{outer}): vs oracle")

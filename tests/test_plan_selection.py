@@ -138,3 +138,128 @@ def test_segmentation_math():
 def test_barrier():
     p = sel(Operation.barrier, 0)
     assert p.algorithm == Algorithm.BARRIER_GATHER_SCATTER and p.seg_count == 0
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical two-tier selection (HIER_ALLREDUCE_MIN_COUNT register)
+# ---------------------------------------------------------------------------
+
+HIER_LINKS = None
+
+
+def _tier_links():
+    global HIER_LINKS
+    if HIER_LINKS is None:
+        from accl_tpu.sequencer.timing import LinkParams, TierLinks
+
+        HIER_LINKS = TierLinks(inner=LinkParams(2e-6, 2e9),
+                               outer=LinkParams(300e-6, 0.25e9))
+    return HIER_LINKS
+
+
+def test_hier_register_off_is_bit_for_bit_flat():
+    """Default registers + a declared topology must change NOTHING: the
+    hierarchical composition is unreachable until autotune moves the
+    MIN register off 0 (the acceptance bar's registers-off clause)."""
+    for count in (64, 4096, 1 << 20):
+        flat = sel(Operation.allreduce, count)
+        with_topo = sel(Operation.allreduce, count, topology=(2, 4),
+                        tier_links=_tier_links())
+        assert with_topo == flat
+
+
+def test_hier_register_window_selects_composition():
+    """Inside the window (payload >= min) with a matching topology the
+    striped composition is selected, tier wires riding the plan; below
+    the min, without a topology, or with a non-factoring topology the
+    flat selection stands."""
+    from accl_tpu.constants import DataType
+
+    t = TuningParams(hier_allreduce_min_count=4096)
+    p = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
+            tier_links=_tier_links(),
+            tier_wires=(DataType.none, DataType.int8))
+    assert p.algorithm == Algorithm.HIER_RS_AR_AG
+    assert (p.inner_world, p.outer_world) == (2, 4)
+    assert p.outer_wire_dtype == DataType.int8
+    assert p.inner_wire_dtype == DataType.none
+    assert p.stripes >= 1
+    # below the min-bytes threshold: flat
+    assert sel(Operation.allreduce, 512, tuning=t, topology=(2, 4),
+               tier_links=_tier_links()).algorithm != \
+        Algorithm.HIER_RS_AR_AG
+    # no topology declared: flat even inside the window
+    assert sel(Operation.allreduce, 4096,
+               tuning=t).algorithm != Algorithm.HIER_RS_AR_AG
+    # topology that does not factor the world: flat
+    assert sel(Operation.allreduce, 4096, tuning=t, topology=(3, 4),
+               tier_links=_tier_links()).algorithm != \
+        Algorithm.HIER_RS_AR_AG
+
+
+def test_hier_takes_precedence_over_synth_window():
+    """With BOTH the synth and hier windows open, a declared two-tier
+    topology selects the hierarchical composition: the synth library's
+    windows were calibrated on a uniform link and its flat hop-DAGs
+    would drag full payloads across the slow tier."""
+    t = TuningParams(synth_allreduce_max_count=1 << 20,
+                     hier_allreduce_min_count=1)
+    p = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
+            tier_links=_tier_links())
+    assert p.algorithm == Algorithm.HIER_RS_AR_AG
+    # same tuning, no topology: the synth window governs as before
+    p2 = sel(Operation.allreduce, 1024, tuning=t)
+    assert p2.algorithm == Algorithm.SYNTHESIZED
+
+
+def test_hier_only_exact_unstreamed_calls():
+    """Streamed or compressed descriptors never take the composition —
+    per-tier compression rides the plan's tier dtypes instead of the
+    descriptor's global compression flag."""
+    t = TuningParams(hier_allreduce_min_count=1)
+    assert sel(Operation.allreduce, 4096, tuning=t, topology=(2, 4),
+               tier_links=_tier_links(),
+               comp=CompressionFlags.ETH_COMPRESSED,
+               ).algorithm != Algorithm.HIER_RS_AR_AG
+    assert sel(Operation.allreduce, 4096, tuning=t, topology=(2, 4),
+               tier_links=_tier_links(),
+               stream=StreamFlags.OP0_STREAM,
+               ).algorithm != Algorithm.HIER_RS_AR_AG
+
+
+def test_hier_tier_fields_ride_the_frozen_plan():
+    """The tier decisions are Plan identity: two plans differing only
+    in a tier wire dtype or stripe count hash and compare apart, so
+    they key different XLA cache entries."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.plan import Plan
+
+    base = dict(seg_count=1024, num_segments=1, inner_world=2,
+                outer_world=4)
+    a = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, stripes=2, **base)
+    b = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, stripes=4, **base)
+    c = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, stripes=2,
+             outer_wire_dtype=DataType.int8, **base)
+    assert a != b and a != c and b != c
+    assert len({hash(a), hash(b), hash(c)}) == 3
+
+
+def test_select_tier_wires_int8_on_slow_outer():
+    """Per-tier wire arbitration lands HiCCL's configuration on a
+    fast-inner/slow-outer calibration: int8 codes on the
+    bandwidth-starved DCN tier, fp32 kept exact on ICI (compression
+    buys nothing against a latency-dominated fast link)."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.plan import select_tier_wires
+    from accl_tpu.sequencer.timing import LinkParams, TierLinks
+
+    links = TierLinks(inner=LinkParams(1e-6, 50e9),
+                      outer=LinkParams(100e-6, 0.05e9))
+    iw, ow = select_tier_wires(1 << 20, DataType.float32, (2, 4), links)
+    assert ow == DataType.int8
+    assert iw == DataType.none
+    # quantized_ok=False: the int8 rows drop out of the outer candidate
+    # set (a cast row may still win)
+    iw2, ow2 = select_tier_wires(1 << 20, DataType.float32, (2, 4),
+                                 links, quantized_ok=False)
+    assert ow2 != DataType.int8

@@ -505,3 +505,112 @@ def test_logp_crossover_formula_pinned_to_native_source():
             / "sequencer" / "timing.py").read_text()
     assert "logp_allreduce_max_bytes(world)" in tsrc
     assert "logp_allgather_max_bytes(world)" in tsrc
+
+
+# ---------------------------------------------------------------------------
+# Tier-tagged spans + per-tier refit (PR 8)
+# ---------------------------------------------------------------------------
+
+
+def _two_tier_trace():
+    """Synthetic trace with two DISTINCT true links labeled by
+    args["tier"], plus a third untagged population on its own link."""
+    true = {"inner": (2e-6, 4e9), "outer": (400e-6, 0.1e9),
+            None: (1e-4, 1e9)}
+    tr = Tracer(enabled=True)
+    for tier, (a, b_) in true.items():
+        for k in range(8):
+            m = float(2 + k)
+            b = float(1 << (14 + k % 6))
+            t = a * m + b / b_
+            args = {"coef_messages": m, "coef_bytes": b,
+                    "measured_s": t}
+            if tier is not None:
+                args["tier"] = tier
+            tr.emit("allreduce", "native",
+                    f"hier/{tier or 'flat'}/r{k % 2}", ts_ns=k,
+                    dur_ns=int(t * 1e9), args=args)
+    return tr.to_trace(), true
+
+
+def test_calibrate_tiers_recovers_each_link_independently():
+    """Each tier refits from exactly its own labeled samples: the fast
+    and slow links come back distinct (a pooled fit would average
+    them into a model of neither)."""
+    trace, true = _two_tier_trace()
+    tiers = telemetry.calibrate_tiers_from_trace(trace)
+    assert tiers.inner.beta == pytest.approx(true["inner"][1], rel=0.05)
+    assert tiers.outer.beta == pytest.approx(true["outer"][1], rel=0.05)
+    assert tiers.inner.alpha == pytest.approx(true["inner"][0], rel=0.1)
+    assert tiers.outer.alpha == pytest.approx(true["outer"][0], rel=0.1)
+    assert tiers.inner.beta > 10 * tiers.outer.beta
+
+
+def test_flat_fit_excludes_tier_tagged_spans():
+    """calibrate_from_trace with no tier keeps only UNTAGGED spans — a
+    tier-tagged measurement belongs to that tier's link, and pooling
+    two different links is the exact failure the labels prevent."""
+    from accl_tpu.telemetry.feedback import hop_samples
+
+    trace, true = _two_tier_trace()
+    flat = telemetry.calibrate_from_trace(trace)
+    assert flat.alpha == pytest.approx(true[None][0], rel=0.05)
+    assert flat.beta == pytest.approx(true[None][1], rel=0.05)
+    assert len(hop_samples(trace)) == 8
+    assert len(hop_samples(trace, tier="inner")) == 8
+    # asking for a tier the trace does not carry raises loudly
+    with pytest.raises(ValueError, match="tier='bogus'"):
+        telemetry.calibrate_from_trace(trace, tier="bogus")
+
+
+def test_drain_world_tier_tag_and_track_prefix(fault_env):
+    """drain_world(tier=, track_prefix=) labels every lifted native
+    span with the tier it crossed and keeps the tiers' tracks apart —
+    the labeled-sample source for the per-tier refit (SPAN v1
+    compatible: `tier` is an ordinary args key)."""
+    fault_env(ACCL_RT_TRACE="1")
+    w = EmuWorld(2, transport="local")
+    try:
+        def body(rank, i):
+            x = np.ones(64, np.float32)
+            out = np.zeros(64, np.float32)
+            rank.allreduce(x, out, 64, ReduceFunction.SUM)
+
+        w.run(body)
+        events, dropped = tnative.drain_world(w, tier="inner",
+                                              track_prefix="hier_pod0")
+    finally:
+        w.close()
+    assert events and dropped == 0
+    for e in events:
+        assert e["args"]["tier"] == "inner"
+        assert e["track"].startswith("hier_pod0/r")
+    from accl_tpu.telemetry.tracer import SCHEMA_VERSION
+
+    telemetry.validate_trace({"schema": SCHEMA_VERSION, "meta": {},
+                              "spans": events})
+
+
+def test_default_tier_links_reads_link_tiers(tmp_path):
+    """The shipped per-tier calibration round-trips through the timing
+    model document; a model without link_tiers yields None (callers
+    must leave hierarchical selection off, never invent a slow-tier
+    model)."""
+    from accl_tpu.telemetry.feedback import default_tier_links
+
+    p = tmp_path / "tm.json"
+    p.write_text(json.dumps({
+        "link_tiers": {
+            "inner": {"alpha_us": 2.0, "beta_gbps": 4.0},
+            "outer": {"alpha_us": 400.0, "beta_gbps": 0.1},
+        }}))
+    tiers = default_tier_links(p)
+    assert tiers is not None
+    assert tiers.inner.alpha == pytest.approx(2e-6)
+    assert tiers.outer.beta == pytest.approx(0.1e9)
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"link": {"alpha_us": 1, "beta_gbps": 1}}))
+    assert default_tier_links(bare) is None
+    # and the COMMITTED model must carry the tier fit (bench --check's
+    # hier cell depends on it; regenerated by bench.py --hier-gate)
+    assert default_tier_links() is not None

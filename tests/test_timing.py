@@ -474,3 +474,190 @@ def test_predict_sequence_fused_vs_eager_gain():
                    for op, plan, count, _ in calls)
     assert t_eager - t_fused == pytest.approx(2 * 2e-4)
     assert t_fused == pytest.approx(per_call + 2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Per-tier links + striped hierarchical cost model (PR 8)
+# ---------------------------------------------------------------------------
+
+
+def _hier_plan(count, stripes=1, inner=2, outer=4, **kw):
+    from accl_tpu.sequencer.plan import Plan, Protocol
+
+    return Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, count, 1,
+                inner_world=inner, outer_world=outer, stripes=stripes,
+                **kw)
+
+
+def _tiers(ia=2e-6, ib=2e9, oa=300e-6, ob=0.25e9):
+    from accl_tpu.sequencer.timing import TierLinks
+
+    return TierLinks(inner=LinkParams(ia, ib), outer=LinkParams(oa, ob))
+
+
+def test_hier_phase_costs_charge_each_tier_its_own_bytes():
+    """One stripe of RS(inner) -> AR(outer) -> AG(inner): phases 1/3
+    bill the inner wire, phase 2 the outer — with an int8 outer wire
+    only the OUTER phase's bytes shrink (the accounting that lets
+    select_tier_wires see int8-on-DCN without pretending ICI
+    compressed too)."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.timing import hier_phase_costs
+
+    count, eb = 8192, 4  # 32 KiB over (2, 4)
+    phases = hier_phase_costs(_hier_plan(count), count, eb)
+    assert [t for t, _m, _b in phases] == ["inner", "outer", "inner"]
+    (t1, m1, b1), (t2, m2, b2), (t3, m3, b3) = phases
+    chunk = count // 2  # inner chunk == outer shard (exact split here)
+    assert b1 == b3 == (2 - 1) * chunk * eb
+    assert b2 == 2 * (4 - 1) * (chunk // 4) * eb
+    q = hier_phase_costs(_hier_plan(count,
+                                    outer_wire_dtype=DataType.int8),
+                         count, eb)
+    assert q[0][2] == b1 and q[2][2] == b3  # inner untouched
+    assert q[1][2] < b2  # outer shrinks to the int8 wire width
+
+
+def test_predict_tiered_pipeline_formula():
+    """T = fill + drain + (S-1) * bottleneck-tier busy time: the S
+    stripes overlap across the two link resources, so S=2 costs one
+    extra bottleneck period of the HALVED stripe, not a second full
+    pass."""
+    from accl_tpu.sequencer.timing import hier_phase_costs, predict_tiered
+
+    tl = _tiers()
+    count = 1 << 16
+    for S in (1, 2, 4):
+        plan = _hier_plan(count, stripes=S)
+        t = [tl.of(tier).seconds(m, b)
+             for tier, m, b in hier_phase_costs(plan, count, 4)]
+        want = sum(t) + (S - 1) * max(t[0] + t[2], t[1])
+        assert predict_tiered(tl, plan, count, 4) == pytest.approx(want)
+    # serialized host: no overlap, S * sum
+    plan = _hier_plan(count, stripes=3)
+    t = [tl.of(tier).seconds(m, b)
+         for tier, m, b in hier_phase_costs(plan, count, 4,
+                                            aggregate=True)]
+    assert predict_tiered(tl, plan, count, 4, aggregate=True) == \
+        pytest.approx(3 * sum(t))
+
+
+def test_best_stripes_is_the_cost_models_choice():
+    """The stripe count is the argmin of the pipelined prediction —
+    never a hardcoded constant. On an alpha-dominated outer link more
+    stripes mean more slow-tier messages, so S=1 wins; ties break
+    toward fewer stripes."""
+    from accl_tpu.sequencer.timing import best_stripes, predict_tiered
+
+    tl = _tiers()
+    s = best_stripes(tl, 1 << 18, 4, 2, 4)
+    best = min(
+        (predict_tiered(tl, _hier_plan(1 << 18, stripes=c), 1 << 18, 4), c)
+        for c in (1, 2, 4, 8))
+    assert predict_tiered(tl, _hier_plan(1 << 18, stripes=s),
+                          1 << 18, 4) == pytest.approx(best[0])
+    # a stripe count can never exceed the payload
+    assert best_stripes(tl, 2, 4, 2, 4) <= 2
+
+
+def test_hier_crossover_is_contiguous_winning_suffix():
+    """The MIN register is the start of the winning suffix: on a
+    fast-inner/slow-outer calibration the composition wins from some
+    size up (window > 0), every swept size above the returned min
+    predicts hier-faster, and an inner link as slow as the outer never
+    opens the window."""
+    from accl_tpu.sequencer.plan import select_algorithm as sel
+    from accl_tpu.sequencer.timing import best_stripes, predict_tiered
+
+    tl = _tiers(ia=2e-6, ib=10e9, oa=300e-6, ob=0.25e9)
+    cross = tuning_crossovers(tl.outer, world=8, tier_links=tl,
+                              topology=(2, 4))
+    lo = cross["hier_allreduce_min_bytes"]
+    assert lo > 0
+    nb = lo
+    while nb <= (1 << 24):
+        cnt = nb // 4
+        s = best_stripes(tl, cnt, 4, 2, 4)
+        t_h = predict_tiered(tl, _hier_plan(cnt, stripes=s), cnt, 4)
+        flat = sel(Operation.allreduce, cnt, 4, 8,
+                   tuning=TuningParams(bcast_flat_tree_max_ranks=0,
+                                       reduce_flat_tree_max_count=0,
+                                       reduce_flat_tree_max_ranks=0,
+                                       gather_flat_tree_max_count=0),
+                   max_eager_size=RX, eager_rx_buf_size=RX)
+        t_f = predict(tl.outer, Operation.allreduce, flat, cnt, 4, 8,
+                      rx_buf_bytes=RX)
+        assert t_h < t_f, f"size {nb} inside the window predicts a loss"
+        nb *= 2
+    # a world the topology does not factor, or no tier links: off
+    assert tuning_crossovers(tl.outer, world=6, tier_links=tl,
+                             topology=(2, 4),
+                             )["hier_allreduce_min_bytes"] == 0
+    assert tuning_crossovers(tl.outer, world=8,
+                             )["hier_allreduce_min_bytes"] == 0
+    # an inner tier even SLOWER than the outer: the composition's extra
+    # inner traffic can only lose, the window stays shut
+    inv = _tiers(ia=3000e-6, ib=0.02e9, oa=300e-6, ob=0.25e9)
+    assert tuning_crossovers(inv.outer, world=8, tier_links=inv,
+                             topology=(2, 4),
+                             )["hier_allreduce_min_bytes"] == 0
+
+
+def test_hier_register_round_trip():
+    """configure_tuning_parameters <-> device.tuning() carries the hier
+    MIN register like the synth trio, and from_crossovers maps the
+    min-bytes crossover onto it."""
+    from accl_tpu.device.base import CCLOAddr, CCLODevice
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    dev = TPUDevice.__new__(TPUDevice)
+    CCLODevice.__init__(dev)
+    dev._comm_extents = {}
+    dev._comm_cache = {}
+    dev.max_rendezvous_size = 32 * 1024
+    dev.write(CCLOAddr.HIER_ALLREDUCE_MIN_COUNT, 1 << 18)
+    t = TPUDevice.tuning(dev)
+    assert t.hier_allreduce_min_count == 1 << 18
+    cross = tuning_crossovers(LinkParams(50e-6, 1e9), world=8,
+                              tier_links=_tiers(), topology=(2, 4))
+    t2 = TuningParams.from_crossovers(cross)
+    assert t2.hier_allreduce_min_count == \
+        cross["hier_allreduce_min_bytes"]
+    assert TuningParams.default().hier_allreduce_min_count == 0
+
+
+def test_facade_autotune_sets_hier_register_and_tier_wires(mesh8):
+    """On a device that declares a two-tier topology, autotune with a
+    per-tier calibration (1) opens the HIER_ALLREDUCE_MIN_COUNT window
+    from the predicted winning suffix, (2) arbitrates the per-tier
+    wires (int8 on the bandwidth-starved outer link, exact inner), and
+    (3) the next in-window fp32 selection through the device carries
+    BOTH — while a non-fp32 call keeps exact tiers (its arith rows may
+    not exist)."""
+    from accl_tpu import CallOptions, DataType, Operation
+    from accl_tpu.accl import ACCL
+    from accl_tpu.device.tpu_device import TPUDevice
+    from accl_tpu.sequencer.plan import Algorithm
+    from accl_tpu.sequencer.timing import TierLinks
+
+    dev = TPUDevice(mesh8, hier_topology=(2, 4))
+    accl = ACCL(device=dev)
+    tl = TierLinks(inner=LinkParams(1e-6, 50e9),
+                   outer=LinkParams(100e-6, 0.05e9))
+    applied = accl.autotune(link=LinkParams(50e-6, 1e9), tier_links=tl)
+    assert applied.hier_allreduce_min_count > 0
+    assert dev.hier_wires[1] == DataType.int8  # slow outer compresses
+    assert dev.hier_wires[0] == DataType.none  # fast inner stays exact
+
+    cnt = max(applied.hier_allreduce_min_count // 4, 1) * 2
+    plan, _, _ = dev._resolve_step(
+        CallOptions(scenario=Operation.allreduce, count=cnt, function=0,
+                    data_type=DataType.float32), dev._comm_ctx(0))
+    assert plan.algorithm == Algorithm.HIER_RS_AR_AG
+    assert plan.outer_wire_dtype == DataType.int8
+    assert plan.inner_wire_dtype == DataType.none
+    p2, _, _ = dev._resolve_step(
+        CallOptions(scenario=Operation.allreduce, count=cnt, function=0,
+                    data_type=DataType.int32), dev._comm_ctx(0))
+    if p2.algorithm == Algorithm.HIER_RS_AR_AG:
+        assert p2.outer_wire_dtype == DataType.none

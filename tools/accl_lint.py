@@ -365,6 +365,24 @@ def run_schedules(deep: bool = False, sample: int = 0,
                 (Operation.allgather, 65536, DataType.none)):
             configs.append((world, scen, 0, count, "synth",
                             synth_tuning, wire))
+    # hierarchical two-tier cells (sequencer/hierarchical.py): the
+    # striped composition selected through the register window for
+    # every (inner, outer) factoring, several stripe depths, and the
+    # per-tier wire combinations — each must interpret, model-check and
+    # certify exactly like the flat zoo. Config tuples grow a trailing
+    # (topology, tier_wires, stripes) extra; None for the flat sweep.
+    # MIN register: any positive payload >= 1 byte selects the
+    # composition, so every sweep size below exercises it
+    hier_tuning = TuningParams(hier_allreduce_min_count=1)
+    for world, factorings in ((4, ((2, 2),)), (8, ((2, 4), (4, 2)))):
+        for L, P in factorings:
+            for count, stripes in ((64, 1), (8192, 2)):
+                for tw in ((DataType.none, DataType.none),
+                           (DataType.none, DataType.int8),
+                           (DataType.float16, DataType.none)):
+                    configs.append((world, Operation.allreduce, 0, count,
+                                    "hier", hier_tuning, DataType.none,
+                                    ((L, P), tw, stripes)))
     if sample and sample < len(configs):
         # deterministic slice: every ceil(total/sample)-th config, so
         # the CI subset is stable across runs and spans all families
@@ -372,7 +390,9 @@ def run_schedules(deep: bool = False, sample: int = 0,
         configs = configs[::stride]
     n = 0
     budget = Budget()
-    for world, scen, root, count, tname, tuning, wire in configs:
+    for cfg in configs:
+        world, scen, root, count, tname, tuning, wire = cfg[:7]
+        hier = cfg[7] if len(cfg) > 7 else None
         from accl_tpu.constants import CompressionFlags
 
         rsd = root if scen != Operation.send \
@@ -385,11 +405,29 @@ def run_schedules(deep: bool = False, sample: int = 0,
             function=int(ReduceFunction.SUM),
             data_type=DataType.float32,
             compress_dtype=wire, compression_flags=comp_flags)
+        hier_kw: dict = {}
+        if hier is not None:
+            topo, tier_wires, stripes = hier
+            from accl_tpu.sequencer.timing import LinkParams, TierLinks
+
+            # a representative fast-inner/slow-outer calibration: only
+            # the stripe count depends on it, and the sweep pins the
+            # depth explicitly below
+            hier_kw = dict(topology=topo, tier_wires=tier_wires,
+                           tier_links=TierLinks(
+                               inner=LinkParams(2e-6, 2e9),
+                               outer=LinkParams(30e-6, 0.25e9)))
         plan = select_algorithm(
             scen, count, 4, world, comp_flags,
             max_eager_size=DEFAULT_MAX_EAGER_SIZE,
             eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
-            tuning=tuning, compress_dtype=wire)
+            tuning=tuning, compress_dtype=wire, **hier_kw)
+        if hier is not None:
+            import dataclasses as _dc
+
+            assert plan.algorithm.name == "HIER_RS_AR_AG", \
+                f"hier config did not select the composition: {plan}"
+            plan = _dc.replace(plan, stripes=hier[2])
         # trace each schedule body ONCE (the dominant cost): the hops
         # feed the per-config interpretation AND, under --deep, the
         # exhaustive-interleaving checker

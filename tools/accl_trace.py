@@ -96,9 +96,41 @@ def make_golden() -> dict:
                          "d_passes": 4, "d_parks": 3,
                          "d_seek_hit": 4, "d_seek_miss": 3}})
             t0 += dur + 50_000
+    # tier-tagged native spans (args["tier"], SPAN v1-compatible detail
+    # key): two tiers with DELIBERATELY different true links, so the
+    # selftest can prove calibrate_tiers_from_trace recovers each from
+    # exactly its own labeled samples (an unlabeled/pooled fit would
+    # average them)
+    tier_true = {"inner": (2e-6, 4.0e9), "outer": (200e-6, 0.1e9)}
+    for tier, (ta, tb) in tier_true.items():
+        t0 = 40_000_000
+        for rank in range(2):
+            for m, b in ((4.0, 131072.0), (8.0, 1048576.0),
+                         (16.0, 4194304.0)):
+                meas = (ta * m + b / tb) * skews[k % len(skews)]
+                k += 1
+                dur = int(meas * 1e9)
+                spans.append({
+                    "name": "reduce_scatter" if tier == "inner"
+                    else "allreduce",
+                    "cat": "native", "track": f"hier/{tier}/r{rank}",
+                    "ts_ns": t0, "dur_ns": dur,
+                    "args": {"op": "reduce_scatter" if tier == "inner"
+                             else "allreduce",
+                             "count": int(b // 4), "bytes": int(b),
+                             "world": 4, "rank": rank, "tier": tier,
+                             "retcode": 0, "detail": 0,
+                             "measured_s": meas,
+                             "coef_messages": m, "coef_bytes": b,
+                             "d_passes": 2, "d_parks": 1,
+                             "d_seek_hit": 2, "d_seek_miss": 1}})
+                t0 += dur + 50_000
     return {"schema": SCHEMA_VERSION,
             "meta": {"golden": True, "drops": 0,
-                     "default_link": default},
+                     "default_link": default,
+                     "tier_true_links": {
+                         t: {"alpha_us": a * 1e6, "beta_gbps": bb / 1e9}
+                         for t, (a, bb) in tier_true.items()}},
             "spans": spans}
 
 
@@ -174,9 +206,31 @@ def cmd_selftest() -> int:
     e_def = median(_rel_errs(trace, default))
     assert e_ref < e_def, \
         f"refit {e_ref:.3f} must beat golden default {e_def:.3f}"
+    # tier-tagged spans (args["tier"]): Chrome tracks split by tier and
+    # the per-tier refit recovers each tier's DISTINCT true link from
+    # exactly its own labeled samples — a pooled (unlabeled) fit would
+    # average the fast and slow tiers together
+    from accl_tpu.telemetry import calibrate_tiers_from_trace
+
+    tier_tracks = {s["track"] for s in trace["spans"]
+                   if s["args"].get("tier")}
+    assert any("inner" in t for t in tier_tracks) and \
+        any("outer" in t for t in tier_tracks), \
+        "golden trace must carry tier-tagged spans on split tracks"
+    tiers = calibrate_tiers_from_trace(trace)
+    true = trace["meta"]["tier_true_links"]
+    for tier, fit in (("inner", tiers.inner), ("outer", tiers.outer)):
+        want = true[tier]["beta_gbps"] * 1e9
+        assert abs(fit.beta - want) / want < 0.25, \
+            f"{tier} refit beta {fit.beta / 1e9:.2f} GB/s far from " \
+            f"true {want / 1e9:.2f}"
+    assert tiers.inner.beta > 10 * tiers.outer.beta, \
+        "per-tier refit must keep the fast and slow links apart"
     print(f"selftest OK: {len(trace['spans'])} golden spans, "
           f"{len(names)} tracks, refit median rel err {e_ref:.3f} < "
-          f"default {e_def:.3f}")
+          f"default {e_def:.3f}; tier refit inner "
+          f"{tiers.inner.beta / 1e9:.2f} GB/s / outer "
+          f"{tiers.outer.beta / 1e9:.3f} GB/s")
     return 0
 
 
