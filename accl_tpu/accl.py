@@ -591,6 +591,61 @@ class ACCL:
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
 
+    def alltoallv(self, sendbuf, recvbuf, count, send_counts, *,
+                  from_device=False, to_device=False, run_async=False,
+                  compress_dtype=None, comm=None, op0_stream=None,
+                  res_stream=None):
+        """Capacity-bounded all-to-all: the buffer keeps alltoall's
+        uniform world-slot layout (`count` elements per peer slot), but
+        peer p receives only the first `send_counts[p]` elements of each
+        source's slot p — the per-peer capacity (the MoE dispatch's
+        expert capacity) — and the overflow tail is dropped to zeros ON
+        THE WIRE (schedules.alltoallv_schedule; each hop moves
+        max(send_counts) elements, so an under-capacity exchange ships
+        fewer bytes than the dense one). An all-`count` vector is the
+        dense alltoall, bit-for-bit. XLA-schedule-tier only: executors
+        without the capacity-masked rotation reject up front."""
+        opts = self._prepare_alltoallv(sendbuf, recvbuf, count, send_counts,
+                                       compress_dtype=compress_dtype,
+                                       comm=comm)
+        self._stream_opts(opts, op0_stream, res_stream)
+        return self._execute(opts, [sendbuf], [recvbuf], from_device,
+                             to_device, run_async)
+
+    def _prepare_alltoallv(self, sendbuf, recvbuf, count, send_counts, *,
+                           compress_dtype=None, comm=None) -> CallOptions:
+        """The alltoallv descriptor: a dense-alltoall descriptor plus the
+        static per-peer capacity vector (validated here, the host seam,
+        so a bad vector fails before anything compiles or dispatches)."""
+        comm_size = (comm or self.communicators[0]).size
+        pc = tuple(int(c) for c in send_counts)
+        if len(pc) != comm_size:
+            raise ValueError(
+                f"alltoallv needs one send count per rank: got {len(pc)} "
+                f"for communicator of {comm_size}")
+        if any(c <= 0 for c in pc):
+            raise ZeroLengthBufferError(
+                f"alltoallv send counts {pc} include a non-positive "
+                "capacity; every peer needs a positive valid prefix")
+        if any(c > count for c in pc):
+            raise ValueError(
+                f"alltoallv send counts {pc} exceed the {count}-element "
+                "peer slot")
+        if all(c == count for c in pc):
+            # an all-full vector IS the dense alltoall: normalize at the
+            # descriptor seam too (not just in select_algorithm), so the
+            # signature — and with it the compiled program — is SHARED
+            # with the plain alltoall at the same shape
+            pc = ()
+        if pc and not getattr(self.cclo, "supports_alltoallv", False):
+            raise NotImplementedError(
+                f"{type(self.cclo).__name__} has no capacity-masked "
+                "alltoallv rotation; alltoallv is XLA-schedule-tier only")
+        opts = self._prepare(Operation.alltoall, sendbuf, None, recvbuf,
+                             count, compress_dtype=compress_dtype, comm=comm)
+        opts.peer_counts = pc
+        return opts
+
     # ------------------------------------------------------------------ #
     # call sequences: record a batch, dispatch ONE fused program
     # ------------------------------------------------------------------ #
@@ -767,6 +822,8 @@ class ACCL:
                   tuning.synth_reduce_scatter_max_count)
         dev.write(CCLOAddr.HIER_ALLREDUCE_MIN_COUNT,
                   tuning.hier_allreduce_min_count)
+        dev.write(CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT,
+                  tuning.alltoall_compress_min_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator",
@@ -993,6 +1050,14 @@ class SequenceRecorder:
         self._accl._stream_opts(opts, op0_stream, res_stream)
         return self._record(opts, [sendbuf], [recvbuf])
 
+    def alltoallv(self, sendbuf, recvbuf, count, send_counts, *,
+                  compress_dtype=None, op0_stream=None, res_stream=None):
+        opts = self._accl._prepare_alltoallv(
+            sendbuf, recvbuf, count, send_counts,
+            compress_dtype=compress_dtype, comm=self._comm)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
+        return self._record(opts, [sendbuf], [recvbuf])
+
     # -- execution ---------------------------------------------------------
 
     def _sync_sets(self):
@@ -1012,6 +1077,28 @@ class SequenceRecorder:
                 if all(b is not x for x in sync_out):
                     sync_out.append(b)
         return sync_in, sync_out
+
+    def compile(self) -> "SequenceProgram":
+        """Freeze the recorded batch into a re-dispatchable
+        SequenceProgram: the descriptor resolution, lint gate, dataflow
+        analysis and compile all happen ONCE here, and every
+        `program.run()` afterwards is stage-in + one dispatch +
+        completion — none of the per-call re-resolution a fresh
+        recorder pays. The recorder is consumed (same one-shot contract
+        as run()). This is the steady-state form of the device-resident
+        call sequence: one compiled program per recorded step shape,
+        dispatched per iteration (the MoE layer step rides it)."""
+        if self._ran:
+            raise SequenceReuseError(
+                "sequence already executed; record a new one")
+        if not self.calls:
+            raise ValueError("empty sequence: record at least one call")
+        if not hasattr(self._accl.cclo, "prepare_sequence"):
+            raise NotImplementedError(
+                f"{type(self._accl.cclo).__name__} does not support "
+                "prepared call sequences")
+        self._ran = True
+        return SequenceProgram(self._accl, self)
 
     def run(self, *, from_device=False, to_device=False, run_async=False):
         """Dispatch the recorded batch as ONE compiled device program.
@@ -1045,4 +1132,49 @@ class SequenceRecorder:
                 pred = getattr(req, "predicted_s", None)
                 if pred is not None:
                     sp.set(predicted_s=pred)
+            return ret
+
+
+class SequenceProgram:
+    """A recorded call sequence frozen into its steady-state form:
+    resolve + lint + compile happened once (at SequenceRecorder.compile),
+    and every `run()` is stage-in + ONE device dispatch + completion —
+    the per-iteration cost profile of a device-resident descriptor
+    batch (no re-recording, no re-planning, no signature hashing).
+
+    The program binds the buffers the recorder referenced: each run
+    reads their CURRENT device contents and places results back, so the
+    caller's loop is `write inputs -> program.run() -> read outputs`.
+    The plans were resolved under the tuning registers live at compile
+    time — retune, then re-record, to pick up new registers."""
+
+    def __init__(self, accl: ACCL, recorder: SequenceRecorder):
+        self._accl = accl
+        self._sync_in, self._sync_out = recorder._sync_sets()
+        self.n_steps = len(recorder.calls)
+        self._ops = "+".join(o.scenario.name for o in recorder.calls)
+        self._prepared = accl.cclo.prepare_sequence(recorder.calls,
+                                                    lint=recorder._lint)
+
+    @property
+    def plans(self):
+        """The per-step Plans the batch resolved to (frozen)."""
+        return self._prepared.plans
+
+    def run(self, *, from_device=False, to_device=False, run_async=False):
+        """Dispatch the compiled batch over the bound buffers' current
+        contents; same sync semantics as SequenceRecorder.run()."""
+        accl = self._accl
+        with get_tracer().span("sequence", cat="sequence",
+                               track="facade") as sp:
+            accl._stage_in(self._sync_in, from_device)
+            req = accl.cclo.dispatch_sequence(self._prepared)
+            ret = accl._complete(req, self._sync_out, to_device, run_async)
+            if get_tracer().enabled:
+                sp.set(n_steps=self.n_steps, ops=self._ops, prepared=True)
+                if run_async:
+                    sp.set(dispatch_only=True)
+                sig = getattr(req, "signature", None)
+                if sig is not None:
+                    sp.set(signature=sig)
             return ret

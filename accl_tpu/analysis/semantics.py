@@ -331,6 +331,37 @@ class _Lifter:
                 outs.append(self._sym(aval.shape, (Piece(n, nid),),
                                       aval.dtype))
             return [outs]
+        if name == "accl_sem_pack":
+            # ONE-message quantized hop (ops.compression.pack_wire):
+            # codes + bitcast scales concatenated into a single int8
+            # wire payload. Abstract convention: the packed value's
+            # pieces are (codes pieces, scales pieces) back to back in
+            # ELEMENT space (n + nb), while the wire aval is the byte
+            # form (n + 4*nb) — only the matching accl_sem_unpack ever
+            # slices a packed value, and it slices by the same element
+            # convention, so provenance flows exactly and the 3*nb
+            # bitcast-padding tail reads as empty.
+            q, s = invals[0], invals[1]
+            aval = self._out_aval(eqn)
+            outs = []
+            for r in range(self.world):
+                pieces = concat_values(self.pieces_of(q[r]),
+                                       self.pieces_of(s[r]))
+                outs.append(self._sym(aval.shape, pieces, np.int8))
+            return [outs]
+        if name == "accl_sem_unpack":
+            p = invals[0]
+            n = int(self._out_aval(eqn, 0).shape[-1])
+            nb = int(self._out_aval(eqn, 1).shape[-1])
+            codes, scales = [], []
+            for r in range(self.world):
+                pieces = self.pieces_of(p[r])
+                codes.append(self._sym((n,), slice_value(pieces, 0, n),
+                                       np.int8))
+                scales.append(self._sym((nb,),
+                                        slice_value(pieces, n, nb),
+                                        np.float32))
+            return [codes, scales]
         if name.startswith("accl_sem_dequant_combine_") \
                 or name.startswith("accl_sem_dequant_requant_"):
             func = name.rsplit("_", 1)[-1]
@@ -925,6 +956,23 @@ def collective_spec(options: Any, world: int) -> list[IMap | None] | None:
                                 for rr in range(world)))]
                 for r in range(world)]
     if op == Operation.alltoall:
+        pc = tuple(getattr(options, "peer_counts", ()) or ())
+        if pc and any(c != count for c in pc):
+            # alltoallv: rank r's slot for source c holds the first
+            # peer_counts[r] elements of c's slot r — the capacity
+            # prefix — and the overflow tail is DROPPED: the spec
+            # declares it empty (zero-fill), so a schedule leaking
+            # stale or misrouted data into the dropped region fails
+            # certification instead of hiding behind the drop.
+            def v_slot(r: int, c: int) -> IMap:
+                v = int(pc[r])
+                segs: IMap = [data(atom(c, r * count), v)]
+                if v < count:
+                    segs.append((count - v, None, {}))
+                return segs
+
+            return [[seg for c in range(world) for seg in v_slot(r, c)]
+                    for r in range(world)]
         return [[data(atom(c, r * count)) for c in range(world)]
                 for r in range(world)]
     return None

@@ -361,6 +361,7 @@ class TuningParams:
         synth_allgather_max_count: int = 0,
         synth_reduce_scatter_max_count: int = 0,
         hier_allreduce_min_count: int = 0,
+        alltoall_compress_min_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
@@ -399,6 +400,21 @@ class TuningParams:
         # + topology), the same measured-selection posture as the synth
         # registers.
         self.hier_allreduce_min_count = hier_allreduce_min_count
+        # Quantized-alltoall crossover (sequencer/schedules.py alltoall
+        # family + the EQuARX int8 wire lanes): on a device with the
+        # blockwise-quantized wire, uncompressed fp32 alltoall(v)
+        # payloads of AT LEAST this many bytes (the descriptor's
+        # count * elem_bytes, the same bytes_count every register
+        # compares) ship int8 codes + per-block scales on every hop —
+        # a MIN register, because the compressed wire wins the
+        # bandwidth regime (~3.94x fewer wire bytes) and buys nothing
+        # on the latency floor, where the exact fp32 wire is kept. 0 —
+        # the default — keeps selection bit-for-bit unchanged;
+        # ACCL.autotune sets it from the calibrated timing model's
+        # predicted crossover (timing.tuning_crossovers'
+        # alltoall_compress_min_bytes), the same measured-selection
+        # posture as the hier register.
+        self.alltoall_compress_min_count = alltoall_compress_min_count
 
     @classmethod
     def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
@@ -461,5 +477,13 @@ class TuningParams:
             hier_allreduce_min_count=(
                 int(cross.get("hier_allreduce_min_bytes", 0))
                 if int(cross.get("hier_allreduce_min_bytes", 0))
+                <= max_count_cap else 0),
+            # same MIN-register posture: 0 = never wins / no quantized
+            # lane on this link, and an over-cap window start clamps to
+            # OFF (min(v, cap) would widen the window into the regime
+            # the calibration said the exact wire wins)
+            alltoall_compress_min_count=(
+                int(cross.get("alltoall_compress_min_bytes", 0))
+                if int(cross.get("alltoall_compress_min_bytes", 0))
                 <= max_count_cap else 0),
         )

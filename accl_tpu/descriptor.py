@@ -54,6 +54,14 @@ class CallOptions:
     # operand resolution, reference accl.cpp:1236-1356).
     data_type: DataType = DataType.none
     compress_dtype: DataType = DataType.none
+    # alltoallv: static per-peer valid counts (one per rank, each in
+    # (0, count]) — peer p accepts only the first peer_counts[p]
+    # elements of each source's slot p, the rest is capacity-overflow
+    # drop expressed in the schedule. Empty = the dense alltoall. A
+    # TPU-path extra like the dtypes (the 15-word form cannot carry a
+    # variable-length vector), so it MUST ride signature(): two calls
+    # differing only in capacities compile different programs.
+    peer_counts: tuple[int, ...] = ()
 
     def to_words(self) -> list[int]:
         """Serialize into the 15-word call stream layout (accl_hls.h:134-198):
@@ -122,6 +130,7 @@ class CallOptions:
             int(self.host_flags),
             self.op0_stream_id,
             self.res_stream_id,
+            tuple(self.peer_counts),
         )
 
 

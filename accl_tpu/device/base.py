@@ -49,6 +49,14 @@ class CCLOAddr:
     # keeps the flat selection. Set by ACCL.autotune from the
     # calibrated per-tier crossover.
     HIER_ALLREDUCE_MIN_COUNT = 0x1FB4
+    # Quantized-alltoall crossover (sequencer/schedules.py + the int8
+    # wire lanes): uncompressed fp32 alltoall(v) payloads of AT LEAST
+    # this many bytes ride the blockwise-quantized wire on a device
+    # that ships it — a MIN threshold like the hier register (the
+    # compressed wire wins the bandwidth regime, never the latency
+    # floor). 0 (the default) keeps selection bit-for-bit unchanged.
+    # Set by ACCL.autotune from the calibrated crossover.
+    ALLTOALL_COMPRESS_MIN_COUNT = 0x1FB0
     EGR_RX_BUF_SIZE = 0x4
     NUM_EGR_RX_BUFS = 0x0
     # Start of the dynamically-laid-out region (communicators, arith
@@ -56,7 +64,7 @@ class CCLOAddr:
     DYNAMIC_BASE = 0x200
     # End of the dynamic region: the lowest-addressed register above
     # (keep in sync when adding registers).
-    DYNAMIC_END = 0x1FB4
+    DYNAMIC_END = 0x1FB0
 
 
 # The hardware id this framework reports, with capability bits analogous

@@ -208,6 +208,16 @@ class DCNDevice(TPUDevice):
     # compositions — communicator-driven flat-vs-hierarchical selection.
     supports_split = True
     buffer_class = DCNBuffer
+    # the two-tier alltoall composition (hierarchical_alltoall_schedule)
+    # has no capacity-masked variant yet: reject uneven alltoallv
+    # vectors up front rather than silently running the dense exchange
+    supports_alltoallv = False
+    # and keep the ALLTOALL_COMPRESS_MIN_COUNT auto-rewrite off: its
+    # crossover is calibrated for the FLAT exchange; on the two-tier
+    # composition each tier would re-encode (doubling the per-block
+    # error) on a link mix the flat model does not describe. Explicit
+    # compress_dtype= stays available, as before.
+    auto_alltoall_wire = False
 
     def __init__(
         self,

@@ -44,6 +44,16 @@ class TPUDevice(CCLODevice):
     # kernels leave this unset so the facade rejects the request up
     # front instead of letting a lane-less executor degrade it silently
     supports_quantized_wire = True
+    # the capacity-masked alltoallv rotation
+    # (schedules.alltoallv_schedule) is likewise XLA-schedule-tier only:
+    # the native emulator's alltoall knows nothing about per-peer valid
+    # counts, so the facade rejects uneven vectors on lane-less backends
+    supports_alltoallv = True
+    # the ALLTOALL_COMPRESS_MIN_COUNT register auto-applies the int8
+    # wire to eligible fp32 alltoall(v) calls on this device (backends
+    # whose alltoall is not the flat exchange the crossover was
+    # calibrated for — DCNDevice's two-tier composition — opt out)
+    auto_alltoall_wire = True
 
     def __init__(self, mesh, axis_name: str = "ccl",
                  hier_topology: tuple[int, int] | None = None):
@@ -154,6 +164,9 @@ class TPUDevice(CCLODevice):
             # and 0 = hierarchical composition off
             hier_allreduce_min_count=rd(
                 CCLOAddr.HIER_ALLREDUCE_MIN_COUNT),
+            # and 0 = quantized alltoall wire off
+            alltoall_compress_min_count=rd(
+                CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------
@@ -287,6 +300,48 @@ class TPUDevice(CCLODevice):
             return self._match_recv(options)
         return self._launch(options)
 
+    def _apply_alltoall_wire(self, options: CallOptions,
+                             tuning: TuningParams) -> CallOptions:
+        """The ALLTOALL_COMPRESS_MIN_COUNT register, applied where the
+        hier wires are: per-descriptor, in front of plan selection, for
+        BOTH the eager path and the call-sequence path. An uncompressed
+        unstreamed-or-streamed fp32 alltoall(v) whose payload clears the
+        register ships the blockwise int8 wire (compress_dtype=int8 +
+        ETH_COMPRESSED, exactly the descriptor the facade's explicit
+        `compress_dtype=` seam would have produced — same plan, same
+        compiled program, same cache key). Register 0 — the default —
+        returns the descriptor untouched, so selection stays bit-for-bit
+        the fp32 wire. Applied to fp32 calls only (the dtype the
+        crossover was calibrated for) on devices that ship the quantized
+        lanes."""
+        reg = tuning.alltoall_compress_min_count
+        if (reg <= 0
+                or options.scenario != Operation.alltoall
+                or options.data_type != DataType.float32
+                or options.compress_dtype != DataType.none
+                or int(options.compression_flags) != 0
+                or not getattr(self, "auto_alltoall_wire", False)
+                or not getattr(self, "supports_quantized_wire", False)):
+            return options
+        # what actually crosses each hop: the dense slot for alltoall,
+        # max(peer_counts) elements for the capacity-bounded alltoallv —
+        # the same payload the FLAT_ALLTOALLV cost shape charges, so a
+        # heavily-capped exchange is not quantized in the regime the
+        # calibration says the exact wire wins
+        hop_elems = (max(options.peer_counts) if options.peer_counts
+                     else options.count)
+        if hop_elems * dtype_nbytes(options.data_type) < reg:
+            return options
+        if (DataType.float32, DataType.int8) not in self.compiler.arith_table:
+            return options
+        import dataclasses
+
+        from ..constants import CompressionFlags
+
+        return dataclasses.replace(
+            options, compress_dtype=DataType.int8,
+            compression_flags=CompressionFlags.ETH_COMPRESSED)
+
     def _resolve_step(self, options: CallOptions, ctx: "_CommCtx",
                       tuning: TuningParams | None = None):
         """Per-descriptor plan selection + stream-endpoint resolution —
@@ -317,6 +372,9 @@ class TPUDevice(CCLODevice):
             tier_wires=(self.hier_wires
                         if options.data_type == DataType.float32
                         else (DataType.none, DataType.none)),
+            # alltoallv: the static per-peer capacity vector rides the
+            # descriptor into the Plan (frozen, cache-keyed)
+            peer_counts=options.peer_counts,
         )
         # stream ids ride dedicated descriptor bytes (word 8), so the tag
         # stays available for matching
@@ -334,7 +392,9 @@ class TPUDevice(CCLODevice):
         ctx = self._comm_ctx(options.comm_addr)
         # send/recv arrive here already PAIRED (start() routes the raw
         # halves through the parking maps; _pair merged their endpoint ids)
-        plan, producer, consumer = self._resolve_step(options, ctx)
+        tuning = self.tuning()
+        options = self._apply_alltoall_wire(options, tuning)
+        plan, producer, consumer = self._resolve_step(options, ctx, tuning)
         if options.stream_flags:
             fn = ctx.compiler.lower_streamed(options, plan, producer, consumer)
         else:
@@ -425,12 +485,35 @@ class TPUDevice(CCLODevice):
         under the same composite signature the compiled program is —
         keyed per tier, so a re-recorded batch re-lints nothing and
         the default tier never pays for the deep one."""
+        return self.dispatch_sequence(
+            self.prepare_sequence(options_list, lint))
+
+    def prepare_sequence(self, options_list,
+                         lint: str = "error") -> "_PreparedSequence":
+        """The resolve half of `start_sequence`: wire-register rewrite,
+        per-step plan selection, lint gate, dataflow resolution and
+        compile — everything whose result is a pure function of the
+        descriptor batch and the live registers — captured in a
+        re-dispatchable handle. `dispatch_sequence(prepared)` then runs
+        the compiled program over the bound buffers' CURRENT contents:
+        steady-state cost is one dispatch, none of the per-call
+        re-resolution (the facade's SequenceRecorder.compile() /
+        SequenceProgram ride this seam). The handle pins the registers
+        it was resolved under — re-prepare after retuning."""
         from ..descriptor import SequenceDescriptor
-        from ..request import SequenceRequest
         from ..sequencer.sequence import SequencePlan
 
         desc = SequenceDescriptor(tuple(options_list))
         ctx = self._comm_ctx(desc.comm_addr)
+        tuning = self.tuning()  # read the registers once for the batch
+        # the alltoall wire register rewrites descriptors BEFORE the
+        # batch signature / lint / compile pipeline sees them, so the
+        # fused program is keyed, traced and certified on what actually
+        # runs (register 0 leaves every descriptor untouched)
+        steps = tuple(self._apply_alltoall_wire(o, tuning)
+                      for o in desc.steps)
+        if steps != desc.steps:
+            desc = SequenceDescriptor(steps)
         tracer = get_tracer()
         # the composite signature tags every phase/step span, so one
         # batch's record -> lint -> compile -> dispatch pipeline can be
@@ -446,7 +529,6 @@ class TPUDevice(CCLODevice):
             sig = None
         with tracer.span("record", cat="phase", track="device") as sp:
             sp.set(signature=sig, n_steps=len(desc.steps))
-            tuning = self.tuning()  # read the registers once for the batch
             plans = []
             endpoints = []
             for opts in desc.steps:
@@ -471,7 +553,20 @@ class TPUDevice(CCLODevice):
                         f"sequence needs {need} elements in buffer "
                         f"{addr:#x}, which holds {have}")
             fn = ctx.compiler.compile_sequence(seq)
+        return _PreparedSequence(desc=desc, plans=tuple(plans), seq=seq,
+                                 fn=fn, bufs=bufs, ctx=ctx, sig=sig)
 
+    def dispatch_sequence(self, prepared: "_PreparedSequence") -> BaseRequest:
+        """The dispatch half of `start_sequence`: run a prepared batch's
+        compiled program over its bound buffers' current device contents
+        and place the results. Safe to call repeatedly on one handle —
+        each call is an independent request."""
+        from ..request import SequenceRequest
+
+        desc, seq, ctx = prepared.desc, prepared.seq, prepared.ctx
+        plans, fn, bufs = prepared.plans, prepared.fn, prepared.bufs
+        sig = prepared.sig
+        tracer = get_tracer()
         with tracer.span("dispatch", cat="phase", track="device") as sp:
             sp.set(signature=sig)
             args = []
@@ -498,15 +593,21 @@ class TPUDevice(CCLODevice):
                 else:
                     buf.device = self._scatter_rows(buf.device, ctx, out)
 
-        req = SequenceRequest(list(outs), plans, on_complete=place)
+        req = SequenceRequest(list(outs), list(plans), on_complete=place)
         if tracer.enabled:
             # per-step marker spans: the fused program executes the steps
             # inside ONE dispatch, so each step carries its timing.predict
             # estimate (and the batch signature) rather than a host-
-            # measured duration — instants, not intervals, honestly
+            # measured duration — instants, not intervals, honestly.
+            # Predictions are a pure function of the frozen (steps,
+            # plans), so they are computed once per handle, not per
+            # dispatch (the re-resolution cost prepare/dispatch splits
+            # out must not sneak back in through telemetry).
             req.signature = sig
-            preds = [self._predict_call(o, p, ctx.world)
-                     for o, p in zip(desc.steps, plans)]
+            if prepared.preds is None:
+                prepared.preds = [self._predict_call(o, p, ctx.world)
+                                  for o, p in zip(desc.steps, plans)]
+            preds = prepared.preds
             known = [p for p in preds if p is not None]
             req.predicted_s = sum(known) if known else None
             now = time.perf_counter_ns()
@@ -825,6 +926,31 @@ class TPUDevice(CCLODevice):
             self.max_rendezvous_size = options.count
         req.complete(0)
         return req
+
+
+class _PreparedSequence:
+    """A resolved + compiled descriptor batch, ready to dispatch any
+    number of times (TPUDevice.prepare_sequence / dispatch_sequence):
+    the descriptor batch post wire-register rewrite, its per-step
+    plans, the fused SequencePlan, the compiled program, and the bound
+    buffer objects (re-read per dispatch, so their current device
+    contents flow in)."""
+
+    __slots__ = ("desc", "plans", "seq", "fn", "bufs", "ctx", "sig",
+                 "preds")
+
+    def __init__(self, desc, plans, seq, fn, bufs, ctx, sig):
+        self.desc = desc
+        self.plans = plans
+        self.seq = seq
+        self.fn = fn
+        self.bufs = bufs
+        self.ctx = ctx
+        self.sig = sig
+        # per-step timing.predict estimates, computed lazily on the
+        # first traced dispatch and reused (pure function of the frozen
+        # steps + plans)
+        self.preds = None
 
 
 class _CommCtx:

@@ -82,19 +82,15 @@ def _capacity(cfg: MoEConfig, tokens: int) -> int:
     return max(1, int(np.ceil(tokens / cfg.n_experts * cfg.capacity_factor)))
 
 
-def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
-    """Per-rank MoE FFN body (runs inside shard_map): routes the local
-    (T, D) tokens to experts across the ep axis through the framework
-    alltoall, applies the rank's local experts, and alltoalls results
-    back. Returns (T, D) expert outputs weighted by router probability
-    (zeros for capacity-dropped tokens)."""
+def _route(x, params, cfg: MoEConfig, C: int):
+    """Top-k routing + capacity assignment for ONE rank's (T, D) tokens
+    — the shared half of the dispatch math (the shard_map body and the
+    facade-sequence path below both call it, so the two executions can
+    never diverge). Returns (dispatch (E, C, D), safe_e, safe_c, keep,
+    gate)."""
     T, D = x.shape
-    ep_world = lax.axis_size(ep_axis)
-    n_local = cfg.experts_per_rank
-    E = ep_world * n_local
-    assert E == cfg.n_experts, (E, cfg.n_experts)
+    E = cfg.n_experts
     k = cfg.top_k
-    C = _capacity(cfg, T * k)
 
     # top-k routing (router weights are replicated): each token becomes k
     # pseudo-tokens, token-major, so capacity positions fill in token order
@@ -119,6 +115,35 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
     dispatch = dispatch.at[safe_e, safe_c].add(
         jnp.where(keep[:, None], x_rep, 0.0)
     )
+    return dispatch, safe_e, safe_c, keep, gate
+
+
+def _combine_tokens(back, safe_e, safe_c, keep, gate, T: int, k: int,
+                    D: int, dtype):
+    """The gather-and-gate half of the combine: each pseudo-token reads
+    its expert output slot, weights it by its gate, and the k expert
+    contributions per token sum. Shared by both execution paths."""
+    token_out = back[safe_e, safe_c]                   # (T*k, D)
+    contrib = jnp.where(keep[:, None],
+                        token_out * gate[:, None].astype(dtype), 0.0)
+    return contrib.reshape(T, k, D).sum(axis=1)
+
+
+def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
+    """Per-rank MoE FFN body (runs inside shard_map): routes the local
+    (T, D) tokens to experts across the ep axis through the framework
+    alltoall, applies the rank's local experts, and alltoalls results
+    back. Returns (T, D) expert outputs weighted by router probability
+    (zeros for capacity-dropped tokens)."""
+    T, D = x.shape
+    ep_world = lax.axis_size(ep_axis)
+    n_local = cfg.experts_per_rank
+    E = ep_world * n_local
+    assert E == cfg.n_experts, (E, cfg.n_experts)
+    k = cfg.top_k
+    C = _capacity(cfg, T * k)
+
+    dispatch, safe_e, safe_c, keep, gate = _route(x, params, cfg, C)
 
     # dispatch alltoall: destination rank r gets experts [r*n_local, ...)
     flat = dispatch.reshape(-1)                        # (ep_world * n_local*C*D)
@@ -146,10 +171,8 @@ def moe_ffn_local(x, params, cfg: MoEConfig, *, ep_axis: str, wire):
 
     # combine: gather each pseudo-token's slot, weight by its gate, and
     # sum each token's k expert contributions
-    token_out = back[safe_e, safe_c]                   # (T*k, D)
-    contrib = jnp.where(keep[:, None],
-                        token_out * gate[:, None].astype(x.dtype), 0.0)
-    return contrib.reshape(T, k, D).sum(axis=1)
+    return _combine_tokens(back, safe_e, safe_c, keep, gate, T, k, D,
+                           x.dtype)
 
 
 def make_moe_forward(cfg: MoEConfig, mesh: Mesh):
@@ -249,6 +272,239 @@ def make_moe_train_step(cfg: MoEConfig, mesh: Mesh, lr: float = 1e-2):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-resident MoE layer step: the dispatch -> expert -> combine round
+# trip as ONE recorded descriptor batch (ROADMAP item 4's fused form)
+# ---------------------------------------------------------------------------
+
+# kernel-stream id the expert-FFN consumer registers under (any id in
+# 1..246 works; one well-known default keeps the bench, the dryrun and
+# the tests on the same endpoint)
+MOE_EXPERT_STREAM = 11
+
+
+def moe_expert_consumer(cfg: MoEConfig, capacity: int, w_up, w_down,
+                        axis_name: str = "ccl"):
+    """The expert-FFN stage as a RES_STREAM consumer: the dispatch
+    alltoall's routed arrival — (ep_world, n_local, C, D) source-major
+    blocks, flat — runs this rank's local experts BEFORE landing in the
+    result buffer, so compute fuses into the same compiled program as
+    the collective (the stream_put posture at MoE scale). The stacked
+    expert weights close over the endpoint as program constants and the
+    rank's block is selected by axis_index, so ONE traced callable
+    serves every rank; re-registering with new weights is a new
+    endpoint identity and compiles a new program (the stream cache keys
+    on it)."""
+    ep_world = cfg.n_experts // cfg.experts_per_rank
+    n_local, C, D = cfg.experts_per_rank, capacity, cfg.d_model
+    wu = jnp.asarray(w_up)
+    wd = jnp.asarray(w_down)
+
+    def consumer(flat):
+        # materialize the routed arrival before the expert matmuls: a
+        # fused producer (the quantized wire's dequantize chain feeding
+        # straight into dot_general) degrades XLA:CPU's gemm to a slow
+        # fused loop — the barrier keeps the einsums on the fast path
+        # without changing a bit of the math
+        flat = lax.optimization_barrier(flat)
+        recv = flat.reshape(ep_world, n_local, C, D)
+        me = lax.axis_index(axis_name)
+        wu_l = lax.dynamic_slice_in_dim(wu, me * n_local, n_local, axis=0)
+        wd_l = lax.dynamic_slice_in_dim(wd, me * n_local, n_local, axis=0)
+        h = jax.nn.gelu(jnp.einsum("slcd,ldf->slcf", recv, wu_l))
+        out = jnp.einsum("slcf,lfd->slcd", h, wd_l)
+        return out.reshape(-1).astype(flat.dtype)
+
+    return consumer
+
+
+def make_expert_program(accl, cfg: MoEConfig, capacity: int, w_up, w_down):
+    """The UNFUSED expert stage: the same per-rank expert-FFN body as
+    the stream consumer, compiled as its OWN jit(shard_map) program over
+    the routed buffer — the middle dispatch of the eager baseline (a
+    descriptor-per-stage caller pays this seam; the fused sequence is
+    exactly what removes it)."""
+    from jax.sharding import PartitionSpec
+
+    consumer = moe_expert_consumer(cfg, capacity, w_up, w_down,
+                                   accl.axis_name)
+
+    def body(xrow):
+        y = consumer(xrow.reshape(xrow.shape[-1]))
+        return y.reshape(1, y.shape[-1])
+
+    spec = PartitionSpec(accl.axis_name)
+    return jax.jit(jax.shard_map(body, mesh=accl.mesh, in_specs=(spec,),
+                                 out_specs=spec, check_vma=False))
+
+
+def _ensure_expert_consumer(accl, cfg: MoEConfig, capacity: int, w_up,
+                            w_down, stream_id: int) -> None:
+    """Register the expert-FFN consumer ONCE per (shape, weights): the
+    stream endpoint's IDENTITY keys the compiled-program caches
+    (SequencePlan.cache_key holds strong refs), so registering a fresh
+    closure per call would re-trace and re-compile the fused program —
+    and retain the stale one — every iteration. The memo (held on the
+    accl, weights kept alive so object ids cannot be reused) makes
+    repeat calls with the same weights reuse the SAME endpoint, hence
+    the same compiled program."""
+    memo = getattr(accl, "_moe_consumer_memo", None)
+    if memo is None:
+        memo = {}
+        accl._moe_consumer_memo = memo
+    # keyed by STREAM ID alone: the memo must mirror what the endpoint
+    # currently holds — keying by (stream, cfg, ...) would hit a stale
+    # entry after a DIFFERENT config overwrote the shared stream and
+    # silently run the wrong expert shapes/weights
+    binding = (cfg, capacity, accl.axis_name, w_up, w_down)
+    prev = memo.get(stream_id)
+    if (prev is not None and prev[0] == binding[0]
+            and prev[1] == binding[1] and prev[2] == binding[2]
+            and prev[3] is w_up and prev[4] is w_down):
+        return
+    memo[stream_id] = binding
+    accl.register_stream_consumer(
+        stream_id,
+        moe_expert_consumer(cfg, capacity, w_up, w_down, accl.axis_name))
+
+
+def run_moe_layer(accl, disp, mid, out, count: int, *,
+                  stream_id: int = MOE_EXPERT_STREAM, fused: bool = True,
+                  expert_fn=None, compress_dtype=None, peer_counts=(),
+                  from_device: bool = False, to_device: bool = False,
+                  lint: str = "error"):
+    """One MoE layer step over registered facade buffers: the dispatch
+    alltoall (expert FFN spliced as its RES_STREAM consumer) followed by
+    the combine alltoall returning expert outputs to their source ranks.
+
+    fused=True records BOTH steps through ``accl.sequence()`` — one
+    ``jit(shard_map)`` program per layer step, one dispatch,
+    signature-cached, the mid buffer threaded on-device between the
+    stages. fused=False issues the SAME two descriptors eagerly (two
+    dispatches; both paths compose the same schedule bodies, so their
+    results are bitwise-identical at fp32 — pinned by test_moe).
+    fused=False with `expert_fn` (make_expert_program) instead runs the
+    fully EAGER descriptor-per-stage form — dispatch alltoall, the
+    standalone expert program, combine alltoall: three dispatches, the
+    pre-fusion baseline the bench's moe_dispatch gate measures against
+    (intermediates stay on-device via from/to_device, so the baseline
+    pays the dispatch seams, not artificial host round trips).
+
+    `compress_dtype=DataType.int8` rides the blockwise-quantized wire on
+    both legs explicitly; leaving it None defers to the device's
+    ALLTOALL_COMPRESS_MIN_COUNT register (the autotuned crossover).
+    `peer_counts` routes both legs through the capacity-bounded
+    alltoallv (per-peer valid prefixes, overflow dropped on the wire)."""
+    def leg(tgt, a, b, **kw):
+        if peer_counts:
+            tgt.alltoallv(a, b, count, peer_counts,
+                          compress_dtype=compress_dtype, **kw)
+        else:
+            tgt.alltoall(a, b, count, compress_dtype=compress_dtype, **kw)
+
+    if fused:
+        seq = accl.sequence(lint=lint)
+        leg(seq, disp, mid, res_stream=stream_id)
+        leg(seq, mid, out)
+        return seq.run(from_device=from_device, to_device=to_device)
+    if expert_fn is not None:
+        # descriptor-per-stage: expert outputs land back in mid
+        # on-device, then the combine leg returns them to their sources
+        # (intermediates ride from/to_device — the baseline pays the
+        # dispatch-per-stage seams, not artificial host round trips)
+        leg(accl, disp, mid, from_device=from_device, to_device=True)
+        mid.device = expert_fn(mid.device)
+        leg(accl, mid, out, from_device=True, to_device=to_device)
+        return accl._last_request
+    leg(accl, disp, mid, res_stream=stream_id, from_device=from_device,
+        to_device=True)
+    leg(accl, mid, out, from_device=True, to_device=to_device)
+    return accl._last_request
+
+
+def make_moe_layer_program(accl, disp, mid, out, count: int, *,
+                           stream_id: int = MOE_EXPERT_STREAM,
+                           compress_dtype=None, peer_counts=(),
+                           lint: str = "error"):
+    """The steady-state form of the fused layer step: record the
+    dispatch -> expert -> combine batch ONCE and freeze it into a
+    re-dispatchable SequenceProgram (resolve + lint + compile happen
+    here; every `program.run()` afterwards is one dispatch). This is
+    what a training/serving loop holds per MoE layer — ONE compiled
+    program per layer step, dispatched per iteration."""
+    seq = accl.sequence(lint=lint)
+    if peer_counts:
+        seq.alltoallv(disp, mid, count, peer_counts,
+                      compress_dtype=compress_dtype, res_stream=stream_id)
+        seq.alltoallv(mid, out, count, peer_counts,
+                      compress_dtype=compress_dtype)
+    else:
+        seq.alltoall(disp, mid, count, compress_dtype=compress_dtype,
+                     res_stream=stream_id)
+        seq.alltoall(mid, out, count, compress_dtype=compress_dtype)
+    return seq.compile()
+
+
+def create_moe_layer_buffers(accl, cfg: MoEConfig, capacity: int):
+    """(disp, mid, out) stacked rank buffers for `run_moe_layer`, each
+    (world, E * C * D) fp32."""
+    n = cfg.n_experts * capacity * cfg.d_model
+    return tuple(accl.create_buffer(n, np.float32) for _ in range(3))
+
+
+def moe_ffn_via_sequence(accl, x, params, cfg: MoEConfig, *,
+                         buffers=None, capacity: int | None = None,
+                         fused: bool = True, compress_dtype=None,
+                         wire_capacity: int | None = None,
+                         stream_id: int = MOE_EXPERT_STREAM):
+    """The facade form of `moe_ffn_local`: per-rank routing host-side,
+    then the dispatch -> expert -> combine round trip as recorded
+    descriptors over `accl`'s axis (`x` is the stacked (world, T, D)
+    token activations; returns the stacked FFN contributions). The
+    routing and combine math is `_route`/`_combine_tokens` — the SAME
+    helpers the shard_map body uses — and the alltoall legs lower the
+    same schedule bodies, so at fp32 this path reproduces
+    `moe_ffn_local` exactly.
+
+    `wire_capacity` (experts_per_rank == 1 only) applies the capacity
+    bound ON THE WIRE via alltoallv: the dispatch buffer keeps its full
+    per-expert slots, but each peer accepts only the first
+    wire_capacity token rows — tokens beyond it are dropped by the
+    schedule itself (zero contribution after the gate), and every hop
+    ships wire_capacity/C of the dense bytes."""
+    world = accl.world
+    T, D = int(x.shape[-2]), int(x.shape[-1])
+    k = cfg.top_k
+    C = capacity if capacity is not None else _capacity(cfg, T * k)
+    E = cfg.n_experts
+    count = (E // world) * C * D  # per-peer chunk elements
+    peer_counts: tuple[int, ...] = ()
+    if wire_capacity is not None and wire_capacity < C:
+        if cfg.experts_per_rank != 1:
+            raise ValueError(
+                "wire_capacity needs experts_per_rank == 1 (a flat slot "
+                "prefix is a token prefix only for one expert per rank)")
+        peer_counts = (wire_capacity * D,) * world
+
+    _ensure_expert_consumer(accl, cfg, C, params["w_up"],
+                            params["w_down"], stream_id)
+    if buffers is None:
+        buffers = create_moe_layer_buffers(accl, cfg, C)
+    disp, mid, out = buffers
+
+    route = jax.jit(jax.vmap(lambda xi: _route(xi, params, cfg, C)))
+    dispatch, safe_e, safe_c, keep, gate = route(jnp.asarray(x))
+    disp.write(np.asarray(dispatch.reshape(world, -1), np.float32))
+    run_moe_layer(accl, disp, mid, out, count, stream_id=stream_id,
+                  fused=fused, compress_dtype=compress_dtype,
+                  peer_counts=peer_counts)
+    back = jnp.asarray(out.host).reshape(world, E, C, D)
+    comb = jax.jit(jax.vmap(
+        lambda b, se, sc, kp, g: _combine_tokens(b, se, sc, kp, g, T, k, D,
+                                                 b.dtype)))
+    return np.asarray(comb(back, safe_e, safe_c, keep, gate))
 
 
 def moe_reference_forward(params, tokens, cfg: MoEConfig):

@@ -220,6 +220,46 @@ def _dequantize_impl(q: jnp.ndarray, scales: jnp.ndarray, n: int,
     return x[:n].astype(out_dtype)
 
 
+def pack_wire(q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    """(codes, scales) -> ONE int8 wire payload: the per-block fp32
+    scales bitcast to 4 raw bytes each and appended after the codes.
+    A quantized hop then crosses the wire as a SINGLE message instead
+    of a payload + scale-side-channel ppermute pair — wire BYTES are
+    unchanged (n + 4*ceil(n/block), the documented format), but the
+    per-hop message count halves, which is where the pairwise exchange
+    families were losing their fusion win. Exact: a bitcast
+    round-trips bitwise."""
+    if _SEM_BOUNDARY:
+        return _sem_jit("accl_sem_pack", _pack_impl)(q, scales)
+    return _pack_impl(q, scales)
+
+
+def _pack_impl(q: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
+    import jax
+
+    raw = jax.lax.bitcast_convert_type(scales, jnp.int8).reshape(-1)
+    return jnp.concatenate([q, raw])
+
+
+def unpack_wire(packed: jnp.ndarray, n: int):
+    """Split a packed wire payload back into (codes, per-block fp32
+    scales) for `n` payload elements — the exact inverse of
+    `pack_wire`."""
+    if _SEM_BOUNDARY:
+        return _sem_jit("accl_sem_unpack",
+                        lambda p: _unpack_impl(p, n), n)(packed)
+    return _unpack_impl(packed, n)
+
+
+def _unpack_impl(packed: jnp.ndarray, n: int):
+    import jax
+
+    nb = quant_num_blocks(n)
+    raw = packed[n:n + 4 * nb].reshape(nb, 4)
+    scales = jax.lax.bitcast_convert_type(raw, jnp.float32)
+    return packed[:n], scales
+
+
 def dequant_combine(q, scales, local, func_op: str):
     """Fused dequantize -> reduce: decode an incoming quantized partial
     and combine it with the local fp32 operand, accumulating in fp32

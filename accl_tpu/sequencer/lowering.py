@@ -449,7 +449,13 @@ class ScheduleCompiler:
                     )
             n_in = 1
         elif op == Operation.alltoall:
-            body = functools.partial(schedules.alltoall_schedule, **common)
+            if plan.algorithm == Algorithm.FLAT_ALLTOALLV:
+                body = functools.partial(
+                    schedules.alltoallv_schedule,
+                    peer_counts=plan.peer_counts, **common)
+            else:
+                body = functools.partial(schedules.alltoall_schedule,
+                                         **common)
             n_in = 1
         elif op == Operation.barrier:
             body = functools.partial(schedules.barrier_schedule, **common)

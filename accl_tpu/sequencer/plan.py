@@ -55,6 +55,12 @@ class Algorithm(enum.IntEnum):
     # HIER_ALLREDUCE_MIN_COUNT register window on a device that
     # declares a two-tier topology.
     HIER_RS_AR_AG = 14
+    # Capacity-bounded pairwise exchange (schedules.alltoallv_schedule):
+    # the dense alltoall's rotation with per-peer valid counts
+    # (Plan.peer_counts) — every hop moves max(peer_counts) elements and
+    # the overflow tail is dropped to zeros at the source, the MoE
+    # dispatch's dropped-token semantics expressed in the schedule.
+    FLAT_ALLTOALLV = 15
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +109,11 @@ class Plan:
     stripes: int = 1
     inner_wire_dtype: DataType = DataType.none
     outer_wire_dtype: DataType = DataType.none
+    # FLAT_ALLTOALLV plans: the static per-peer valid counts the
+    # schedule truncates each slot to (the descriptor's peer_counts).
+    # Frozen, so two alltoallv calls with different capacity vectors
+    # can never share a compiled program or a timing estimate.
+    peer_counts: tuple[int, ...] = ()
 
 
 def is_rendezvous(
@@ -160,6 +171,7 @@ def select_algorithm(
     topology: tuple[int, int] | None = None,
     tier_wires: tuple[DataType, DataType] = (DataType.none, DataType.none),
     tier_links=None,
+    peer_counts: tuple[int, ...] = (),
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
@@ -383,6 +395,25 @@ def select_algorithm(
         return eager_plan(Algorithm.EAGER_RING_RS_AG, world_align=world_size)
 
     if scenario == Operation.alltoall:
+        # alltoallv: a per-peer capacity vector turns the dense rotation
+        # into the capacity-bounded exchange. An all-full vector IS the
+        # dense alltoall and normalizes to it (one compiled program, no
+        # vmax machinery), so `alltoallv(counts=(count,)*world)` is
+        # bit-for-bit `alltoall`.
+        if peer_counts and any(c != count for c in peer_counts):
+            if len(peer_counts) != world_size:
+                raise ValueError(
+                    f"alltoallv needs {world_size} peer counts, got "
+                    f"{len(peer_counts)}")
+            if any(c <= 0 or c > count for c in peer_counts):
+                raise ValueError(
+                    f"alltoallv peer counts {peer_counts} outside "
+                    f"(0, {count}]")
+            pc = tuple(int(c) for c in peer_counts)
+            if rndzv:
+                return rndzv_plan(Algorithm.FLAT_ALLTOALLV, peer_counts=pc)
+            return dataclasses.replace(
+                eager_plan(Algorithm.FLAT_ALLTOALLV), peer_counts=pc)
         return rndzv_plan(Algorithm.FLAT_ALLTOALL) if rndzv else eager_plan(
             Algorithm.FLAT_ALLTOALL
         )  # .c:2140-2211

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..constants import ReduceFunction
+from ..constants import QUANT_BLOCK_ELEMS, ReduceFunction
 from ..ops.compression import (
     compress,
     decompress,
@@ -41,7 +41,9 @@ from ..ops.compression import (
     dequant_combine_requant,
     dequantize_blockwise,
     is_quantized,
+    pack_wire,
     quantize_blockwise,
+    unpack_wire,
 )
 from ..ops.reduce_ops import combine_op, reduce_lane
 
@@ -107,14 +109,25 @@ class Wire:
 
     def ppermute(self, x, axis, perm):
         """One cross-rank hop: compress -> permute -> decompress. On the
-        quantized wire this is encode -> permute both side-channels ->
-        decode (ranks not addressed by perm receive zero codes AND zero
-        scales, which decode to exact zeros — the same masking contract
-        the cast lanes have)."""
+        quantized wire this is encode -> pack -> permute ONE message ->
+        unpack -> decode: the per-block scales travel bitcast to raw
+        bytes INSIDE the codes payload (ops.compression.pack_wire), so a
+        single-hop exchange pays one message latency like the fp32 wire
+        instead of a codes + scales ppermute pair — same wire bytes
+        (n + 4*ceil(n/256)), half the messages, which is what lets the
+        quantized pairwise families keep their fusion win. Ranks not
+        addressed by perm receive an all-zero packed payload, which
+        unpacks to zero codes AND zero scales and decodes to exact zeros
+        — the same masking contract the cast lanes have. (The ring
+        families' RELAYED hops keep the explicit encode/hop/decode pair:
+        their scales side-channel stays decoded-form-free across many
+        hops, and their fused dequant-reduce-requant kernels consume the
+        pair directly.)"""
         if self.quantized:
             n = x.shape[-1]
-            return self.decode(self.hop(self.encode(x), axis, perm), n,
-                               x.dtype)
+            q, s = self.encode(x)
+            arr = lax.ppermute(pack_wire(q, s), axis, perm)
+            return self.decode(unpack_wire(arr, n), n, x.dtype)
         y = lax.ppermute(self.send(x), axis, perm)
         return self.recv(y, x.dtype)
 
@@ -557,9 +570,21 @@ def _pad_to_multiple(x, m):
 def alltoall_schedule(x, *, axis, world, wire):
     """Pairwise rotation exchange (.c:2140-2211): at step k every rank
     sends chunk me+k to rank me+k and files the arrival from rank me-k
-    into slot me-k; P-1 steps cover all peers."""
+    into slot me-k; P-1 steps cover all peers.
+
+    On the blockwise-quantized wire every peer chunk crosses its one
+    hop as (int8 codes, per-block fp32 scales) — `Wire.ppermute`
+    encodes at the source and dequantizes only at the destination slot,
+    so each chunk pays exactly ONE quantization pass and the wire moves
+    ~1/3.94 of the fp32 bytes. The LOCAL chunk never crosses a wire and
+    stays exact: unlike the quantized allreduce ring there is no
+    rank-consistency constraint here (every output slot has exactly one
+    source), so round-tripping the local chunk would buy nothing but
+    error."""
     count = x.shape[-1] // world
     me = lax.axis_index(axis)
+    if wire.quantized and count % QUANT_BLOCK_ELEMS == 0:
+        return _alltoall_quant_aligned(x, axis=axis, world=world, wire=wire)
     own = lax.dynamic_slice_in_dim(x, me * count, count, axis=-1)
     out = jnp.zeros_like(x)
     out = lax.dynamic_update_slice_in_dim(out, own, me * count, axis=-1)
@@ -568,6 +593,95 @@ def alltoall_schedule(x, *, axis, world, wire):
             x, ((me + k) % world) * count, count, axis=-1
         )
         recv = wire.ppermute(peer_chunk, axis, _ring_perm(world, k))
+        out = lax.dynamic_update_slice_in_dim(
+            out, recv, ((me - k) % world) * count, axis=-1
+        )
+    return out
+
+
+def _alltoall_quant_aligned(x, *, axis, world, wire):
+    """The block-aligned quantized exchange: when the peer chunk is a
+    whole number of quantization blocks, the WHOLE send buffer encodes
+    ONCE (blocks never span chunk boundaries, so the per-chunk codes
+    and scales are exact slices of the one encode — bitwise what
+    per-chunk encoding would produce), every hop ships its packed
+    slice as ONE message, arrivals assemble into a codes + scales
+    staging pair, and the WHOLE received buffer dequantizes ONCE at
+    the end. P-1 encodes and P-1 decodes become 1 + 1; per hop only a
+    slice/pack/permute/unpack/file remains — the quantized exchange
+    keeps the fp32 schedule's message count and sheds the per-hop
+    transform chains that were costing it the fusion win. The local
+    slot never crosses a wire and is spliced in EXACT (fp32) after the
+    decode."""
+    count = x.shape[-1] // world
+    nb = count // QUANT_BLOCK_ELEMS
+    me = lax.axis_index(axis)
+    q_all, s_all = wire.encode(x)
+    q_recv = jnp.zeros_like(q_all)
+    s_recv = jnp.zeros_like(s_all)
+    for k in range(1, world):
+        dst = (me + k) % world
+        src = (me - k) % world
+        qc = lax.dynamic_slice_in_dim(q_all, dst * count, count, axis=-1)
+        sc = lax.dynamic_slice_in_dim(s_all, dst * nb, nb, axis=-1)
+        arr = lax.ppermute(pack_wire(qc, sc), axis, _ring_perm(world, k))
+        q2, s2 = unpack_wire(arr, count)
+        q_recv = lax.dynamic_update_slice_in_dim(
+            q_recv, q2, src * count, axis=-1)
+        s_recv = lax.dynamic_update_slice_in_dim(
+            s_recv, s2, src * nb, axis=-1)
+    out = wire.decode((q_recv, s_recv), world * count, x.dtype)
+    own = lax.dynamic_slice_in_dim(x, me * count, count, axis=-1)
+    return lax.dynamic_update_slice_in_dim(out, own, me * count, axis=-1)
+
+
+def alltoallv_schedule(x, *, peer_counts, axis, world, wire):
+    """Capacity-bounded pairwise exchange — the alltoallv of the MoE
+    dispatch path. The buffer keeps the dense alltoall's uniform
+    world-slot layout (slot = count elements, count = x.size // world),
+    but peer p accepts only the first peer_counts[p] elements of each
+    source's slot p — the per-peer CAPACITY, e.g. the expert capacity
+    of the experts hosted on rank p — and everything past the valid
+    prefix is DROPPED to zeros on the wire (standard dropped-token
+    semantics, expressed inside the schedule so hazards, protocol,
+    modelcheck and the semantic certifier can prove the routed
+    contribution map; a receiver can never observe stale tail data).
+
+    Every hop moves vmax = max(peer_counts) elements (one SPMD program
+    serves all ranks, so hop shapes must be uniform; sub-vmax validity
+    is masked at the SOURCE, which is what guarantees the dropped tail
+    arrives as exact zeros), cutting wire bytes by count/vmax against
+    the dense exchange. The quantized wire composes: the masked vmax
+    chunk is encoded once at the source and dequantized only at the
+    destination slot, exactly like the dense family. The local slot
+    (the capacity prefix a rank keeps for its own experts) crosses no
+    wire and stays exact."""
+    count = x.shape[-1] // world
+    counts = tuple(int(c) for c in peer_counts)
+    if len(counts) != world:
+        raise ValueError(
+            f"alltoallv needs one peer count per rank: got {len(counts)} "
+            f"for world {world}")
+    if any(c <= 0 or c > count for c in counts):
+        raise ValueError(
+            f"peer counts {counts} outside (0, {count}] slot capacity")
+    vmax = max(counts)
+    cvec = jnp.asarray(counts, jnp.int32)
+    valid = jnp.arange(vmax)
+    me = lax.axis_index(axis)
+    out = jnp.zeros_like(x)
+
+    def capacity_prefix(dst):
+        """Slot `dst` of the local buffer, truncated to dst's capacity:
+        vmax elements with the overflow tail zeroed at the source."""
+        chunk = lax.dynamic_slice_in_dim(x, dst * count, vmax, axis=-1)
+        return jnp.where(valid < cvec[dst], chunk, 0)
+
+    own = capacity_prefix(me)
+    out = lax.dynamic_update_slice_in_dim(out, own, me * count, axis=-1)
+    for k in range(1, world):
+        chunk = capacity_prefix((me + k) % world)
+        recv = wire.ppermute(chunk, axis, _ring_perm(world, k))
         out = lax.dynamic_update_slice_in_dim(
             out, recv, ((me - k) % world) * count, axis=-1
         )

@@ -257,10 +257,24 @@ def coefficients(
             tb += b
         return tm, tb
     if alg == Algorithm.FLAT_ALLTOALL:
-        # eager exchanges stream whole chunks (jumbo segments) since r5
+        # pairwise rotation (.c:2140-2211): P-1 steps, each shipping one
+        # `count`-element peer chunk per rank; eager exchanges stream
+        # whole chunks (jumbo segments) since r5. Bytes are WIRE bytes
+        # (n already charges wire_elem_bytes), so the int8 lane's
+        # ~3.94x reduction shows up here — this is the shape the
+        # ALLTOALL_COMPRESS_MIN_COUNT crossover scans.
         per = 2 if plan.protocol == Protocol.RENDEZVOUS else \
             _segs(n, _STREAM_SEG)
         return (P - 1) * per, (P - 1) * n
+    if alg == Algorithm.FLAT_ALLTOALLV:
+        # capacity-bounded rotation: same P-1 steps, but every hop moves
+        # vmax = max(peer_counts) elements (the SPMD-uniform hop shape
+        # schedules.alltoallv_schedule pads to), not the full slot
+        nv = max(plan.peer_counts) * wire_elem_bytes(elem_bytes,
+                                                     plan.wire_dtype)
+        per = 2 if plan.protocol == Protocol.RENDEZVOUS else \
+            _segs(int(nv), _STREAM_SEG)
+        return (P - 1) * per, (P - 1) * nv
     if alg == Algorithm.BARRIER_GATHER_SCATTER:
         return 2 * (P - 1), 0.0
     raise ValueError(f"no cost shape for {alg}")
@@ -360,6 +374,12 @@ def coefficients_aggregate(
         per = 2 if plan.protocol == Protocol.RENDEZVOUS else \
             _segs(n, _STREAM_SEG)
         return P * (P - 1) * per, P * (P - 1) * n
+    if alg == Algorithm.FLAT_ALLTOALLV:
+        nv = max(plan.peer_counts) * wire_elem_bytes(elem_bytes,
+                                                     plan.wire_dtype)
+        per = 2 if plan.protocol == Protocol.RENDEZVOUS else \
+            _segs(int(nv), _STREAM_SEG)
+        return P * (P - 1) * per, P * (P - 1) * nv
     raise ValueError(f"no aggregate cost shape for {alg}")
 
 
@@ -714,6 +734,46 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                 sbytes *= 2
         synth_regs[f"synth_{op_key}_max_bytes"] = best_bytes
 
+    # Quantized-alltoall crossover: the start of the CONTIGUOUS winning
+    # suffix — the smallest alltoall payload (descriptor bytes_count =
+    # count * elem_bytes, the register's comparison unit) such that the
+    # int8 blockwise wire predicts faster than the exact fp32 wire by
+    # more than `select_wire`'s min_gain bar at that size and every
+    # LARGER swept size. A MIN register like the hier one: the
+    # compressed wire's win is the bandwidth regime (~3.94x fewer wire
+    # bytes per hop), while on the latency floor the prediction barely
+    # moves and the exact wire is kept rather than paying quantization
+    # error for nothing. Scanned through the real selection rules so
+    # the costed plans are what would actually run; 0 = never clears
+    # the gain bar on this link, the register stays off and selection
+    # is bit-for-bit unchanged.
+    from ..constants import CompressionFlags
+
+    a2a_min = 0
+    a2a_min_gain = 0.05
+    a2a_tuning = TuningParams()
+    nb = 1 << 10
+    while nb <= (1 << 24):
+        cnt = max(nb // elem_bytes, 1)
+        akw: dict = dict(max_eager_size=rx_buf_bytes,
+                         eager_rx_buf_size=rx_buf_bytes,
+                         tuning=a2a_tuning)
+        p_fp32 = select_algorithm(Operation.alltoall, cnt, elem_bytes, P,
+                                  **akw)
+        p_int8 = select_algorithm(Operation.alltoall, cnt, elem_bytes, P,
+                                  CompressionFlags.ETH_COMPRESSED,
+                                  compress_dtype=DataType.int8, **akw)
+        t_fp32 = predict(params, Operation.alltoall, p_fp32, cnt,
+                         elem_bytes, P, rx_buf_bytes=rx_buf_bytes)
+        t_int8 = predict(params, Operation.alltoall, p_int8, cnt,
+                         elem_bytes, P, rx_buf_bytes=rx_buf_bytes)
+        if t_int8 < t_fp32 and (t_fp32 - t_int8) > a2a_min_gain * t_fp32:
+            if a2a_min == 0:
+                a2a_min = nb  # candidate start of the suffix
+        else:
+            a2a_min = 0  # loss above a win: suffix restarts
+        nb *= 2
+
     # Hierarchical-allreduce crossover: with per-tier links and a
     # declared (inner, outer) topology, the START of the CONTIGUOUS
     # winning SUFFIX — the smallest payload such that the striped
@@ -760,6 +820,7 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                 nb *= 2
 
     return {
+        "alltoall_compress_min_bytes": a2a_min,
         "hier_allreduce_min_bytes": hier_min,
         "bcast_flat_tree_max_ranks": bcast_max,
         "reduce_flat_tree_max_count_bytes": reduce_cross,

@@ -434,3 +434,25 @@ def test_dump_eager_rx_buffers_and_soft_reset(accl):
     accl.send(sb, 16, src=3, dst=4, tag=322)
     accl.recv(rb, 16, src=3, dst=4, tag=322)
     np.testing.assert_allclose(rb.host[4], x[3], rtol=1e-6)
+
+
+def test_alltoallv_full_vector_shares_program_with_alltoall(accl):
+    """alltoallv with an all-full capacity vector normalizes at the
+    DESCRIPTOR seam (peer_counts dropped before signature), so it
+    shares the plain alltoall's compiled program instead of caching a
+    bitwise-identical twin."""
+    import numpy as np
+
+    world = accl.world
+    count = 64
+    x = np.arange(world * world * count, dtype=np.float32).reshape(
+        world, world * count)
+    a = accl.create_buffer(world * count, np.float32, x)
+    b = accl.create_buffer(world * count, np.float32)
+    c = accl.create_buffer(world * count, np.float32)
+    accl.alltoall(a, b, count)
+    n_before = len(accl.cclo.compiler._cache)
+    req = accl.alltoallv(a, c, count, (count,) * world)
+    assert len(accl.cclo.compiler._cache) == n_before  # same program
+    assert req.plan.peer_counts == ()
+    np.testing.assert_array_equal(np.asarray(c.host), np.asarray(b.host))

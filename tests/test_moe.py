@@ -125,3 +125,184 @@ def test_moe_training_decreases_loss():
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# The fused layer step: dispatch -> expert -> combine as ONE recorded
+# descriptor batch (ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+
+def _facade_setup(world=8):
+    import jax as _jax
+    from accl_tpu.accl import ACCL
+    from accl_tpu.models.moe import _capacity, create_moe_layer_buffers
+
+    mesh = Mesh(np.array(_jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=world,
+                    experts_per_rank=1, vocab=32, seq=16)
+    params = init_moe_params(cfg, jax.random.key(7))
+    T = 24
+    x = RNG.standard_normal((world, T, cfg.d_model)).astype(np.float32)
+    bufs = create_moe_layer_buffers(accl, cfg, _capacity(cfg, T))
+    return accl, cfg, params, x, bufs, T
+
+
+def test_moe_fused_sequence_bitwise_equals_eager():
+    """The fused layer-step sequence (ONE compiled program) must equal
+    issuing the same descriptors eagerly BITWISE at fp32, and both must
+    reproduce the shard_map FFN body exactly (same routing helpers,
+    same schedule bodies, same einsums)."""
+    from jax.sharding import PartitionSpec as P
+
+    from accl_tpu.models.moe import moe_ffn_local, moe_ffn_via_sequence
+    from accl_tpu.sequencer import schedules
+
+    accl, cfg, params, x, bufs, T = _facade_setup()
+    fused = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    eager = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs,
+                                 fused=False)
+    np.testing.assert_array_equal(fused, eager)
+
+    wire = schedules.Wire(None)
+    pspecs = {"embed": P(), "router": P(), "w_up": P("ccl"),
+              "w_down": P("ccl"), "unembed": P()}
+    fn = jax.jit(jax.shard_map(
+        lambda p, xi: moe_ffn_local(
+            xi.reshape(T, cfg.d_model), p, cfg, ep_axis="ccl",
+            wire=wire).reshape(1, -1),
+        mesh=accl.mesh, in_specs=(pspecs, P("ccl")),
+        out_specs=P("ccl"), check_vma=False))
+    ref = np.asarray(fn(params, x.reshape(accl.world, -1))).reshape(x.shape)
+    np.testing.assert_array_equal(fused, ref)
+
+
+def test_moe_layer_program_redispatches_without_recompiling():
+    """make_moe_layer_program: record once, dispatch many — repeat runs
+    reuse the ONE compiled program (the compile cache does not grow)
+    and fresh dispatches see fresh buffer contents."""
+    from accl_tpu.models.moe import (MOE_EXPERT_STREAM,
+                                     make_moe_layer_program,
+                                     moe_expert_consumer)
+
+    accl, cfg, params, x, bufs, T = _facade_setup()
+    disp, mid, out = bufs
+    C = disp.shape[-1] // cfg.n_experts // cfg.d_model
+    accl.register_stream_consumer(
+        MOE_EXPERT_STREAM,
+        moe_expert_consumer(cfg, C, params["w_up"], params["w_down"],
+                            accl.axis_name))
+    count = C * cfg.d_model
+    program = make_moe_layer_program(accl, disp, mid, out, count)
+    disp.write(RNG.standard_normal(disp.shape).astype(np.float32))
+    program.run()
+    first = np.array(out.host, copy=True)
+    n_compiled = len(accl.cclo.compiler._cache)
+    program.run()
+    np.testing.assert_array_equal(out.host, first)
+    disp.write(np.zeros(disp.shape, np.float32))
+    program.run()
+    assert np.abs(out.host).max() == 0.0  # fresh contents flowed in
+    assert len(accl.cclo.compiler._cache) == n_compiled
+
+
+def test_moe_fused_int8_wire_within_bound_and_register_driven():
+    """The quantized layer step (explicit compress_dtype AND the
+    ALLTOALL_COMPRESS_MIN_COUNT register path) stays within the
+    documented per-block bound of fp32, and the two int8 forms are
+    BITWISE-identical (the register writes the same descriptor the
+    explicit seam does)."""
+    from accl_tpu.constants import DataType, TuningParams
+    from accl_tpu.models.moe import moe_ffn_via_sequence
+
+    accl, cfg, params, x, bufs, T = _facade_setup()
+    ref = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    explicit = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs,
+                                    compress_dtype=DataType.int8)
+    err = np.abs(explicit - ref).max()
+    assert 0 < err < np.abs(ref).max() * 0.05
+    accl.configure_tuning_parameters(
+        TuningParams(alltoall_compress_min_count=1))
+    via_register = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    np.testing.assert_array_equal(via_register, explicit)
+    accl.configure_tuning_parameters(TuningParams())
+    np.testing.assert_array_equal(
+        moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs), ref)
+
+
+def test_moe_wire_capacity_drops_on_the_wire():
+    """wire_capacity routes both legs through alltoallv: at full
+    capacity it is the dense exchange bit-for-bit; below it, overflow
+    tokens lose their expert contribution (dropped ON THE WIRE) while
+    in-capacity tokens keep exactly their dense-path values."""
+    from accl_tpu.models.moe import _capacity, moe_ffn_via_sequence
+
+    accl, cfg, params, x, bufs, T = _facade_setup()
+    C = _capacity(cfg, T * cfg.top_k)
+    dense = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    same = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs,
+                                wire_capacity=C)
+    np.testing.assert_array_equal(same, dense)
+    trimmed = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs,
+                                   wire_capacity=1)
+    assert not np.array_equal(trimmed, dense)
+    # every trimmed token's contribution is either its dense value (in
+    # capacity) or exactly zero (dropped)
+    changed = ~np.isclose(trimmed, dense).all(axis=-1)
+    assert np.abs(trimmed[changed]).max() == 0.0
+
+
+def test_moe_ffn_via_sequence_reuses_compiled_programs():
+    """Repeat calls with the SAME weights must not re-register the
+    expert consumer (endpoint identity keys the compiled-program
+    caches): the compile cache stays flat across iterations instead of
+    growing — and re-tracing — once per call."""
+    from accl_tpu.models.moe import moe_ffn_via_sequence
+
+    accl, cfg, params, x, bufs, T = _facade_setup()
+    first = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    n_compiled = len(accl.cclo.compiler._cache)
+    for _ in range(3):
+        again = moe_ffn_via_sequence(accl, x, params, cfg, buffers=bufs)
+    np.testing.assert_array_equal(again, first)
+    assert len(accl.cclo.compiler._cache) == n_compiled
+    # new weights = new endpoint identity = one new program, once
+    params2 = {**params, "w_up": np.array(params["w_up"]) * 2}
+    moe_ffn_via_sequence(accl, x, params2, cfg, buffers=bufs)
+    n2 = len(accl.cclo.compiler._cache)
+    assert n2 > n_compiled
+    moe_ffn_via_sequence(accl, x, params2, cfg, buffers=bufs)
+    assert len(accl.cclo.compiler._cache) == n2
+
+
+def test_moe_consumer_memo_tracks_the_stream_binding():
+    """Switching configs on the SHARED expert stream must re-register
+    the endpoint (the memo mirrors what the stream currently holds):
+    cfg1 -> cfg2 -> cfg1 returns cfg1's correct result, never a stale
+    consumer's shapes/weights."""
+    import jax as _jax
+    from jax.sharding import Mesh as _Mesh
+
+    from accl_tpu.accl import ACCL
+    from accl_tpu.models.moe import (_capacity, create_moe_layer_buffers,
+                                     moe_ffn_via_sequence)
+
+    world = 8
+    mesh = _Mesh(np.array(_jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    T = 24
+    cfg1 = MoEConfig(d_model=16, d_ff=32, n_experts=world,
+                     experts_per_rank=1, vocab=32, seq=16)
+    cfg2 = MoEConfig(d_model=32, d_ff=64, n_experts=world,
+                     experts_per_rank=1, vocab=32, seq=16)
+    p1 = init_moe_params(cfg1, jax.random.key(11))
+    p2 = init_moe_params(cfg2, jax.random.key(12))
+    x1 = RNG.standard_normal((world, T, 16)).astype(np.float32)
+    x2 = RNG.standard_normal((world, T, 32)).astype(np.float32)
+    b1 = create_moe_layer_buffers(accl, cfg1, _capacity(cfg1, T))
+    b2 = create_moe_layer_buffers(accl, cfg2, _capacity(cfg2, T))
+    first = moe_ffn_via_sequence(accl, x1, p1, cfg1, buffers=b1)
+    moe_ffn_via_sequence(accl, x2, p2, cfg2, buffers=b2)
+    again = moe_ffn_via_sequence(accl, x1, p1, cfg1, buffers=b1)
+    np.testing.assert_array_equal(again, first)

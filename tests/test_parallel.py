@@ -279,3 +279,36 @@ def test_transformer_forward_parallel_equals_single():
     f8 = make_forward(cfg, mesh8)
     out = np.asarray(f8(shard_params(params, cfg, mesh8), tokens))
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_quantized_wire_within_bound():
+    """Ulysses re-shardings over the blockwise int8 wire (one packed
+    codes+scales message per hop): attention output within the
+    quantization bound of the exact-wire result — the same lanes the
+    MoE dispatch rides, on the other alltoall rider."""
+    from accl_tpu.arithconfig import DEFAULT_ARITH_CONFIG
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer import schedules
+
+    world, B, T, H, D = 4, 2, 32, 4, 8
+    mesh = Mesh(np.array(jax.devices()[:world]), ("sp",))
+    q, k, v = (RNG.standard_normal((B, T, H, D)).astype(np.float32)
+               for _ in range(3))
+    qwire = schedules.Wire(
+        DEFAULT_ARITH_CONFIG[(DataType.float32, DataType.int8)])
+
+    def run(wire):
+        body = functools.partial(ulysses_attention, axis_name="sp",
+                                 causal=True, wire=wire)
+        f = jax.jit(jax.shard_map(
+            lambda a, b, c: body(a, b, c), mesh=mesh,
+            in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+            check_vma=False))
+        return np.asarray(f(q, k, v))
+
+    exact = run(None)
+    quant = run(qwire)
+    assert not np.array_equal(quant, exact)  # the wire really engaged
+    np.testing.assert_allclose(quant, exact, rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(
+        exact, reference_attention(q, k, v, True), rtol=2e-4, atol=2e-4)

@@ -263,3 +263,128 @@ def test_select_tier_wires_int8_on_slow_outer():
     iw2, ow2 = select_tier_wires(1 << 20, DataType.float32, (2, 4),
                                  links, quantized_ok=False)
     assert ow2 != DataType.int8
+
+
+# ---------------------------------------------------------------------------
+# alltoall(v) selection + the ALLTOALL_COMPRESS_MIN_COUNT register
+# ---------------------------------------------------------------------------
+
+
+def test_alltoallv_selection_rides_the_frozen_plan():
+    """A non-full capacity vector selects FLAT_ALLTOALLV with
+    peer_counts frozen on the Plan (cache-keyed); an all-full vector
+    normalizes to the dense FLAT_ALLTOALL bit-for-bit; distinct
+    capacity vectors hash to distinct plans."""
+    pc = (100, 50, 100, 100, 25, 100, 100, 1)
+    p = sel(Operation.alltoall, 100, peer_counts=pc)
+    assert p.algorithm == Algorithm.FLAT_ALLTOALLV
+    assert p.peer_counts == pc
+    assert hash(p) != hash(sel(Operation.alltoall, 100,
+                               peer_counts=(50,) * 8))
+    dense = sel(Operation.alltoall, 100)
+    assert sel(Operation.alltoall, 100, peer_counts=(100,) * 8) == dense
+    # compressed alltoallv keeps the v-algorithm with the wire dtype
+    from accl_tpu.constants import DataType
+
+    q = sel(Operation.alltoall, 100, comp=CompressionFlags.ETH_COMPRESSED,
+            compress_dtype=DataType.int8, peer_counts=pc)
+    assert q.algorithm == Algorithm.FLAT_ALLTOALLV
+    assert q.wire_dtype == DataType.int8 and q.peer_counts == pc
+
+
+def test_alltoall_compress_register_zero_is_bit_for_bit():
+    """Register 0 (the default) leaves every alltoall descriptor and
+    plan untouched on the device path — selection is bit-for-bit the
+    exact fp32 wire (the acceptance bar's registers-off clause)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from accl_tpu.constants import DataType
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    world = min(len(jax.devices()), 8)
+    dev = TPUDevice(Mesh(np.array(jax.devices()[:world]), ("ccl",)))
+    opts = CallOptions(scenario=Operation.alltoall, count=4096,
+                       data_type=DataType.float32)
+    assert dev._apply_alltoall_wire(opts, dev.tuning()) is opts
+
+
+def test_alltoall_compress_register_rewrites_eligible_calls_only():
+    """With the MIN register set, an uncompressed fp32 alltoall at or
+    above the threshold gains the int8 wire (compress_dtype +
+    ETH_COMPRESSED — exactly the facade's explicit-compression
+    descriptor); below the threshold, non-fp32, already-compressed and
+    non-alltoall descriptors pass untouched."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from accl_tpu.constants import DataType, TuningParams as TP
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    world = min(len(jax.devices()), 8)
+    dev = TPUDevice(Mesh(np.array(jax.devices()[:world]), ("ccl",)))
+    tuning = TP(alltoall_compress_min_count=4096)
+
+    def a2a(**kw):
+        return CallOptions(scenario=Operation.alltoall, count=1024,
+                           data_type=DataType.float32, **kw)
+
+    got = dev._apply_alltoall_wire(a2a(), tuning)  # 4096 B == min
+    assert got.compress_dtype == DataType.int8
+    assert got.compression_flags & CompressionFlags.ETH_COMPRESSED
+    # below the threshold: untouched
+    small = CallOptions(scenario=Operation.alltoall, count=1023,
+                        data_type=DataType.float32)
+    assert dev._apply_alltoall_wire(small, tuning) is small
+    # non-fp32: untouched (the crossover was calibrated for fp32)
+    f64 = CallOptions(scenario=Operation.alltoall, count=1024,
+                      data_type=DataType.float64)
+    assert dev._apply_alltoall_wire(f64, tuning) is f64
+    # explicitly-compressed: the caller's wire stands
+    expl = a2a(compress_dtype=DataType.float16,
+               compression_flags=CompressionFlags.ETH_COMPRESSED)
+    assert dev._apply_alltoall_wire(expl, tuning) is expl
+    # other scenarios: untouched
+    ar = CallOptions(scenario=Operation.allreduce, count=4096,
+                     data_type=DataType.float32)
+    assert dev._apply_alltoall_wire(ar, tuning) is ar
+    # alltoallv keeps its capacity vector through the rewrite (vector
+    # whose max clears the threshold: hop payload = 1024 * 4 B == min)
+    v = a2a(peer_counts=(512,) * (world - 1) + (1024,))
+    got_v = dev._apply_alltoall_wire(v, tuning)
+    assert got_v.peer_counts == v.peer_counts
+    assert got_v.compress_dtype == DataType.int8
+
+
+def test_alltoall_compress_register_gates_on_hop_payload_for_v():
+    """The register compares what actually crosses each hop: an
+    alltoallv whose dense slot clears the threshold but whose capacity
+    cap (max(peer_counts)) does not stays on the exact fp32 wire — the
+    regime the calibration says compression loses."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from accl_tpu.constants import DataType, TuningParams as TP
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    world = min(len(jax.devices()), 8)
+    dev = TPUDevice(Mesh(np.array(jax.devices()[:world]), ("ccl",)))
+    tuning = TP(alltoall_compress_min_count=4096)
+    capped = CallOptions(scenario=Operation.alltoall, count=4096,
+                         data_type=DataType.float32,
+                         peer_counts=(512,) * world)  # hop = 2 KiB < 4 KiB
+    assert dev._apply_alltoall_wire(capped, tuning) is capped
+    open_v = CallOptions(scenario=Operation.alltoall, count=4096,
+                         data_type=DataType.float32,
+                         peer_counts=(1024,) * (world - 1) + (4096,))
+    assert dev._apply_alltoall_wire(open_v, tuning).compress_dtype == \
+        DataType.int8

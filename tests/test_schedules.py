@@ -296,3 +296,141 @@ def test_composed_stage_selection_respects_tuning(mesh8):
                           max_eager_size=1024, eager_rx_buf_size=1024,
                           tuning=t2)
     assert p2.stages[0].algorithm == Algorithm.RNDZV_FLAT_TREE
+
+
+# ---------------------------------------------------------------------------
+# alltoall(v): the quantized pairwise exchange + the capacity-bounded
+# variant (the MoE dispatch family)
+# ---------------------------------------------------------------------------
+
+
+def _alltoall_oracle(x, count):
+    out = np.zeros_like(x)
+    for r in range(WORLD):
+        for src in range(WORLD):
+            out[r, src * count:(src + 1) * count] = \
+                x[src, r * count:(r + 1) * count]
+    return out
+
+
+def _alltoallv_oracle(x, count, pc):
+    out = np.zeros_like(x)
+    for r in range(WORLD):
+        for src in range(WORLD):
+            v = pc[r]
+            out[r, src * count:src * count + v] = \
+                x[src, r * count:r * count + v]
+    return out
+
+
+@pytest.mark.parametrize("count", [256, 300, 2048])
+def test_alltoall_quantized_wire(mesh8, count):
+    """The int8 exchange: every peer chunk crosses its ONE hop as packed
+    codes+scales and dequantizes only at the destination slot — within
+    the documented per-block bound of the fp32 oracle, with the LOCAL
+    slot exact (it never crosses a wire). Covers both the block-aligned
+    encode-once form (count % 256 == 0) and the per-hop form."""
+    opts = CallOptions(scenario=Operation.alltoall, count=count,
+                       data_type=DataType.float32,
+                       compress_dtype=DataType.int8,
+                       compression_flags=CompressionFlags.ETH_COMPRESSED)
+    plan = select_algorithm(
+        Operation.alltoall, count, 4, WORLD,
+        CompressionFlags.ETH_COMPRESSED, compress_dtype=DataType.int8,
+        max_eager_size=1024, eager_rx_buf_size=1024,
+        tuning=TuningParams.default())
+    assert plan.wire_dtype == DataType.int8
+    fn = ScheduleCompiler(mesh8).lower(opts, plan)
+    x = RNG.standard_normal((WORLD, WORLD * count)).astype(np.float32)
+    out = np.asarray(fn(x))
+    oracle = _alltoall_oracle(x, count)
+    for r in range(WORLD):
+        np.testing.assert_array_equal(
+            out[r, r * count:(r + 1) * count],
+            oracle[r, r * count:(r + 1) * count])
+    # per-element error bound: one quantization pass per chunk, so
+    # |err| <= block_amax / 254 <= global_amax / 254
+    bound = np.abs(x).max() / 254 * 1.01
+    assert np.abs(out - oracle).max() <= bound
+
+
+@pytest.mark.parametrize("pc_kind", ["uniform", "hetero", "full"])
+@pytest.mark.parametrize("wire", [DataType.none, DataType.int8])
+def test_alltoallv(mesh8, pc_kind, wire):
+    """The capacity-bounded exchange: peer p receives only the first
+    peer_counts[p] elements of each source's slot p; the dropped tail
+    arrives as EXACT zeros (masked at the source, so stale slot data
+    can never leak across the wire)."""
+    count = 600
+    pc = {"uniform": (256,) * WORLD,
+          "hetero": (600, 100, 300, 512, 1, 256, 37, 600),
+          "full": (600,) * WORLD}[pc_kind]
+    comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
+            else CompressionFlags.NO_COMPRESSION)
+    opts = CallOptions(scenario=Operation.alltoall, count=count,
+                       data_type=DataType.float32, compress_dtype=wire,
+                       compression_flags=comp, peer_counts=pc)
+    plan = select_algorithm(
+        Operation.alltoall, count, 4, WORLD, comp, compress_dtype=wire,
+        peer_counts=pc, max_eager_size=1024, eager_rx_buf_size=1024,
+        tuning=TuningParams.default())
+    if pc_kind == "full":
+        # an all-full vector IS the dense alltoall (normalized away)
+        assert plan.algorithm == Algorithm.FLAT_ALLTOALL
+        assert plan.peer_counts == ()
+    else:
+        assert plan.algorithm == Algorithm.FLAT_ALLTOALLV
+        assert plan.peer_counts == pc
+    fn = ScheduleCompiler(mesh8).lower(opts, plan)
+    x = RNG.standard_normal((WORLD, WORLD * count)).astype(np.float32)
+    out = np.asarray(fn(x))
+    oracle = (_alltoall_oracle(x, count) if pc_kind == "full"
+              else _alltoallv_oracle(x, count, pc))
+    if wire == DataType.none:
+        np.testing.assert_array_equal(out, oracle)
+    else:
+        # local slot exact; remote valid prefixes within the bound;
+        # dropped tails exactly zero
+        bound = np.abs(x).max() / 254 * 1.01
+        assert np.abs(out - oracle).max() <= bound
+        zero_mask = oracle == 0
+        for r in range(WORLD):
+            for src in range(WORLD):
+                if src == r:
+                    continue
+                v = count if pc_kind == "full" else pc[r]
+                tail = out[r, src * count + v:(src + 1) * count]
+                np.testing.assert_array_equal(tail, np.zeros_like(tail))
+        del zero_mask
+
+
+def test_alltoallv_rejects_bad_counts():
+    kw = dict(max_eager_size=1024, eager_rx_buf_size=1024,
+              tuning=TuningParams.default())
+    with pytest.raises(ValueError):
+        select_algorithm(Operation.alltoall, 100, 4, WORLD,
+                         peer_counts=(50, 50), **kw)  # wrong length
+    with pytest.raises(ValueError):
+        select_algorithm(Operation.alltoall, 100, 4, WORLD,
+                         peer_counts=(50,) * 7 + (101,), **kw)  # > count
+    with pytest.raises(ValueError):
+        select_algorithm(Operation.alltoall, 100, 4, WORLD,
+                         peer_counts=(0,) * WORLD, **kw)  # zero
+
+
+def test_pack_wire_round_trips_bitwise():
+    """pack_wire/unpack_wire (the one-message quantized hop): codes and
+    bitcast scales round-trip BITWISE, for block-aligned and ragged
+    payload lengths."""
+    from accl_tpu.ops.compression import (pack_wire, quantize_blockwise,
+                                          unpack_wire)
+
+    for n in (256, 300, 2048, 17):
+        x = RNG.standard_normal(n).astype(np.float32)
+        q, s = quantize_blockwise(x)
+        packed = np.asarray(pack_wire(q, s))
+        assert packed.dtype == np.int8
+        assert packed.shape[-1] == n + 4 * len(np.asarray(s))
+        q2, s2 = unpack_wire(packed, n)
+        np.testing.assert_array_equal(np.asarray(q2), np.asarray(q))
+        np.testing.assert_array_equal(np.asarray(s2), np.asarray(s))

@@ -65,19 +65,20 @@ _TREES = TuningParams(
 
 
 def _opts_plan(scen, count, world, *, root=0, func=ReduceFunction.SUM,
-               wire=DataType.none, tuning=None):
+               wire=DataType.none, tuning=None, peer_counts=()):
     comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
             else CompressionFlags.NO_COMPRESSION)
     rsd = root if scen not in (Operation.send, Operation.recv) else root
     opts = CallOptions(scenario=scen, count=count, root_src_dst=rsd,
                        function=int(func), data_type=DataType.float32,
-                       compress_dtype=wire, compression_flags=comp)
+                       compress_dtype=wire, compression_flags=comp,
+                       peer_counts=tuple(peer_counts))
     plan = select_algorithm(
         scen, count, 4, world, comp,
         max_eager_size=DEFAULT_MAX_EAGER_SIZE,
         eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
         tuning=tuning or TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE),
-        compress_dtype=wire)
+        compress_dtype=wire, peer_counts=tuple(peer_counts))
     return opts, plan
 
 
@@ -185,6 +186,15 @@ _FAMILY_GRID = [
     (Operation.allreduce, 16, 4, {"tuning": _TREES}),  # composed
     (Operation.reduce_scatter, 8, 4, {}),
     (Operation.alltoall, 6, 4, {}),
+    # the quantized exchange: packed codes+scales, one message per hop
+    # (per-hop encode at 6; the block-aligned encode-once form at 256)
+    (Operation.alltoall, 6, 4, {"wire": DataType.int8}),
+    (Operation.alltoall, 256, 4, {"wire": DataType.int8}),
+    # the capacity-bounded exchange: routed prefixes + PROVEN zero
+    # tails (the MoE overflow drop as descriptors), exact and quantized
+    (Operation.alltoall, 10, 4, {"peer_counts": (10, 3, 7, 1)}),
+    (Operation.alltoall, 300, 4, {"peer_counts": (128, 300, 9, 64),
+                                  "wire": DataType.int8}),
     (Operation.send, 16, 4, {"root": 1 | (3 << 16)}),
     (Operation.allreduce, 300, 4, {"wire": DataType.int8}),
     (Operation.reduce_scatter, 16, 4, {"wire": DataType.int8}),
@@ -577,3 +587,53 @@ def test_certifier_vs_execution_fuzz(family):
             assert numeric_broken, (family, seed, kind,
                                     "flagged but numerically invisible")
     assert not mismatches, mismatches
+
+
+# ---------------------------------------------------------------------------
+# alltoallv: the drop region is PROVEN, not assumed
+# ---------------------------------------------------------------------------
+
+
+class TestAlltoallvSemantics:
+    def test_dropped_tail_must_be_empty(self):
+        """A schedule that leaks data into the capacity-dropped tail
+        (here: the full dense exchange run against an alltoallv spec)
+        must fail certification — the drop is part of the declared
+        meaning, so 'extra' data is a wrong result, not a bonus."""
+        world, count = 4, 10
+        pc = (10, 3, 7, 1)
+        opts_v, _ = _opts_plan(Operation.alltoall, count, world,
+                               peer_counts=pc)
+        # lift the DENSE exchange but certify against the v-spec
+        _, _, dense_dag = _lift(Operation.alltoall, count, world)
+        diags = semantics.certify(
+            dense_dag, semantics.collective_spec(opts_v, world),
+            "alltoall")
+        codes = {d.code for d in diags}
+        assert codes == {"ACCL501"}, diags
+
+    def test_lifted_quantized_alltoallv_executes_faithfully(self):
+        """The lifted DAG of the quantized capacity-bounded exchange is
+        numerically faithful: hopdag.execute (the numpy reference
+        datapath) reproduces the oracle within the per-block bound,
+        with dropped tails exactly zero."""
+        world, count = 4, 300
+        pc = (128, 300, 9, 64)
+        opts, _, dag = _lift(Operation.alltoall, count, world,
+                             peer_counts=pc, wire=DataType.int8)
+        rng = np.random.default_rng(19)
+        xs = [rng.standard_normal(world * count).astype(np.float32)
+              for _ in range(world)]
+        outs = hopdag.execute(dag, [[x] for x in xs])
+        bound = max(np.abs(x).max() for x in xs) / 254 * 1.01
+        for r in range(world):
+            for src in range(world):
+                got = outs[r][src * count:(src + 1) * count]
+                want = np.zeros(count, np.float32)
+                want[:pc[r]] = xs[src][r * count:r * count + pc[r]]
+                if src == r:
+                    np.testing.assert_array_equal(got, want)
+                else:
+                    assert np.abs(got - want).max() <= bound
+                    np.testing.assert_array_equal(
+                        got[pc[r]:], np.zeros(count - pc[r], np.float32))

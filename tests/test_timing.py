@@ -661,3 +661,169 @@ def test_facade_autotune_sets_hier_register_and_tier_wires(mesh8):
                     data_type=DataType.int32), dev._comm_ctx(0))
     if p2.algorithm == Algorithm.HIER_RS_AR_AG:
         assert p2.outer_wire_dtype == DataType.none
+
+
+# ---------------------------------------------------------------------------
+# alltoall(v): cost shapes pinned to the traced programs + the
+# ALLTOALL_COMPRESS_MIN_COUNT crossover
+# ---------------------------------------------------------------------------
+
+
+def _traced_ppermute_bytes(opts, plan, world):
+    """Per-rank ppermute operand bytes of the REAL lowered program —
+    the executable truth the cost shape must match."""
+    import jax
+
+    from accl_tpu.analysis.protocol import (iter_ppermute_eqns,
+                                            trace_schedule_jaxpr)
+
+    try:
+        from jax.extend import core as jcore
+    except ImportError:  # pragma: no cover - old jax
+        import jax.core as jcore
+
+    del jax
+    closed, _, _ = trace_schedule_jaxpr(opts, plan, world)
+    return sum(v.aval.size * v.aval.dtype.itemsize
+               for eqn in iter_ppermute_eqns(closed)
+               for v in eqn.invars
+               if not isinstance(v, jcore.Literal))
+
+
+@pytest.mark.parametrize("wire_name", ["none", "int8"])
+@pytest.mark.parametrize("count", [2048, 300])
+def test_alltoall_cost_shape_pinned_to_traced_program(wire_name, count):
+    """The (P-1)-step pairwise-rotation shape must charge exactly the
+    bytes the LOWERED program's ppermutes move — fp32 at payload width,
+    the int8 wire at 1 B/elem + the packed per-block scales (the wire
+    format pack_wire ships)."""
+    from accl_tpu.constants import (CompressionFlags, DataType,
+                                    QUANT_BLOCK_ELEMS, QUANT_SCALE_BYTES)
+    from accl_tpu.descriptor import CallOptions
+
+    world = 8
+    wire = DataType.none if wire_name == "none" else DataType.int8
+    comp = (CompressionFlags.ETH_COMPRESSED if wire != DataType.none
+            else CompressionFlags.NO_COMPRESSION)
+    plan = select_algorithm(Operation.alltoall, count, 4, world, comp,
+                            compress_dtype=wire, max_eager_size=4096,
+                            eager_rx_buf_size=RX, tuning=TUNING)
+    opts = CallOptions(scenario=Operation.alltoall, count=count,
+                       data_type=DataType.float32, compress_dtype=wire,
+                       compression_flags=comp)
+    m, b = coefficients(Operation.alltoall, plan, count, 4, world,
+                        rx_buf_bytes=RX)
+    traced = _traced_ppermute_bytes(opts, plan, world)
+    # one streamed message per rotation step (a rendezvous-size plan
+    # pays the address handshake as a second message per step)
+    from accl_tpu.sequencer.plan import Protocol
+
+    per = 2 if plan.protocol == Protocol.RENDEZVOUS else 1
+    assert m == (world - 1) * per
+    if wire == DataType.none:
+        assert b == traced == (world - 1) * count * 4
+    else:
+        # exact traced bytes: codes + 4*ceil(count/256) scale bytes per
+        # chunk; the model amortizes the scale per element, so it may
+        # sit below the traced ceil by at most one block's scale per hop
+        nb = -(-count // QUANT_BLOCK_ELEMS)
+        assert traced == (world - 1) * (count + QUANT_SCALE_BYTES * nb)
+        assert b <= traced <= b + (world - 1) * QUANT_SCALE_BYTES
+        # and the compression is really ~3.94x on aligned payloads
+        _, b_fp32 = coefficients(
+            Operation.alltoall,
+            select_algorithm(Operation.alltoall, count, 4, world,
+                             max_eager_size=4096, eager_rx_buf_size=RX,
+                             tuning=TUNING),
+            count, 4, world, rx_buf_bytes=RX)
+        assert b_fp32 / b == pytest.approx(4 / 1.015625, rel=1e-3)
+
+
+def test_alltoallv_cost_shape_charges_vmax():
+    """FLAT_ALLTOALLV hops move max(peer_counts) elements (the padded
+    uniform hop shape), in the plan's wire width — pinned against the
+    traced program."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.descriptor import CallOptions
+
+    world, count = 8, 600
+    pc = (600, 100, 300, 512, 1, 256, 37, 599)
+    plan = select_algorithm(Operation.alltoall, count, 4, world,
+                            peer_counts=pc, max_eager_size=4096,
+                            eager_rx_buf_size=RX, tuning=TUNING)
+    assert plan.algorithm == Algorithm.FLAT_ALLTOALLV
+    m, b = coefficients(Operation.alltoall, plan, count, 4, world,
+                        rx_buf_bytes=RX)
+    assert b == (world - 1) * max(pc) * 4
+    opts = CallOptions(scenario=Operation.alltoall, count=count,
+                       data_type=DataType.float32, peer_counts=pc)
+    assert _traced_ppermute_bytes(opts, plan, world) == b
+    # select_wire arbitrates the alltoall family like every other op
+    from accl_tpu.sequencer.plan import select_wire
+
+    pick = select_wire(Operation.alltoall, 1 << 20, DataType.float32, 8,
+                       LinkParams(5e-6, 2e9), max_eager_size=4096,
+                       eager_rx_buf_size=RX, rx_buf_bytes=RX,
+                       tuning=TUNING)
+    assert pick == DataType.int8  # bandwidth-bound: the quantized wire
+
+
+def test_alltoall_compress_crossover_contiguous_suffix():
+    """The register value is the START of the contiguous winning suffix
+    of the predicted int8-vs-fp32 sweep (MIN semantics): predictions at
+    and above it must clear the gain bar, the probe just below must
+    not."""
+    link = LinkParams(alpha=100e-6, beta=2e9)
+    cross = tuning_crossovers(link, world=8)
+    start = cross["alltoall_compress_min_bytes"]
+    assert start > 0
+
+    def gain(nb):
+        from accl_tpu.constants import CompressionFlags, DataType
+
+        cnt = max(nb // 4, 1)
+        kw = dict(max_eager_size=RX, eager_rx_buf_size=RX,
+                  tuning=TuningParams())
+        t_f = predict(link, Operation.alltoall,
+                      select_algorithm(Operation.alltoall, cnt, 4, 8,
+                                       **kw),
+                      cnt, 4, 8, rx_buf_bytes=RX)
+        t_q = predict(link, Operation.alltoall,
+                      select_algorithm(
+                          Operation.alltoall, cnt, 4, 8,
+                          CompressionFlags.ETH_COMPRESSED,
+                          compress_dtype=DataType.int8, **kw),
+                      cnt, 4, 8, rx_buf_bytes=RX)
+        return (t_f - t_q) / t_f
+
+    nb = start
+    while nb <= (1 << 24):
+        assert gain(nb) > 0.05, nb
+        nb *= 2
+    if start > 1 << 10:
+        assert gain(start // 2) <= 0.05
+
+
+def test_alltoall_compress_register_round_trip(mesh8):
+    """TuningParams.from_crossovers maps the crossover to the MIN
+    register (over-cap clamps to OFF, never widened), and the register
+    round-trips through configure_tuning_parameters / CCLOAddr /
+    TPUDevice.tuning()."""
+    from accl_tpu.accl import ACCL
+    from accl_tpu.device.base import CCLOAddr
+
+    base = tuning_crossovers(LinkParams(100e-6, 2e9), world=8)
+    tp = TuningParams.from_crossovers(base)
+    assert tp.alltoall_compress_min_count == \
+        base["alltoall_compress_min_bytes"]
+    # over the register cap: a MIN register clamps OFF (0), because
+    # min(v, cap) would widen the window into fp32-wins territory
+    over = dict(base, alltoall_compress_min_bytes=1 << 30)
+    assert TuningParams.from_crossovers(over).alltoall_compress_min_count \
+        == 0
+    accl = ACCL(mesh8)
+    accl.configure_tuning_parameters(tp)
+    assert accl.cclo.read(CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT) == \
+        tp.alltoall_compress_min_count
+    assert accl.cclo.tuning().alltoall_compress_min_count == \
+        tp.alltoall_compress_min_count

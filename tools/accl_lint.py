@@ -338,14 +338,31 @@ def run_schedules(deep: bool = False, sample: int = 0,
                         configs.append((world, scen, root, count,
                                         tname, tuning, DataType.none))
         # the quantized-wire cells: the families with int8 ring variants
-        # (codes relayed, accumulation only at combine points) — both
-        # the protocol interpretation and the semantic certifier must
-        # hold through the encoded datapath
+        # (codes relayed, accumulation only at combine points) plus the
+        # pairwise exchange (packed codes+scales, one message per hop —
+        # both the block-aligned encode-once form at 8192 and the
+        # per-hop form at 16) — both the protocol interpretation and
+        # the semantic certifier must hold through the encoded datapath
         for scen in (Operation.allreduce, Operation.reduce_scatter,
-                     Operation.allgather):
+                     Operation.allgather, Operation.alltoall):
             for count in (16, 8192):
                 configs.append((world, scen, 0, count, "default",
                                 tunings["default"], DataType.int8))
+        # alltoallv cells: the capacity-bounded exchange
+        # (schedules.alltoallv_schedule) with uniform-trim and
+        # heterogeneous per-peer capacity vectors, exact and quantized
+        # wire — the certifier must prove the routed prefix AND the
+        # dropped (zero) tail of every slot (the MoE overflow-drop
+        # semantics as descriptors)
+        for count, pattern in ((300, "uniform"), (1024, "hetero")):
+            if pattern == "uniform":
+                pc = (max(count // 2, 1),) * world
+            else:
+                pc = tuple(max(count // (i + 1), 1) for i in range(world))
+            for wire in (DataType.none, DataType.int8):
+                configs.append((world, Operation.alltoall, 0, count,
+                                "default", tunings["default"], wire,
+                                ("a2av", pc)))
         # synthesized-schedule cells (sequencer/synthesis.py): payloads
         # inside the committed library entries' winning windows,
         # selected via maxed synth crossover registers — the lowered
@@ -382,7 +399,7 @@ def run_schedules(deep: bool = False, sample: int = 0,
                            (DataType.float16, DataType.none)):
                     configs.append((world, Operation.allreduce, 0, count,
                                     "hier", hier_tuning, DataType.none,
-                                    ((L, P), tw, stripes)))
+                                    ("hier", (L, P), tw, stripes)))
     if sample and sample < len(configs):
         # deterministic slice: every ceil(total/sample)-th config, so
         # the CI subset is stable across runs and spans all families
@@ -392,7 +409,11 @@ def run_schedules(deep: bool = False, sample: int = 0,
     budget = Budget()
     for cfg in configs:
         world, scen, root, count, tname, tuning, wire = cfg[:7]
-        hier = cfg[7] if len(cfg) > 7 else None
+        extra = cfg[7] if len(cfg) > 7 else None
+        hier = extra[1:] if extra is not None and extra[0] == "hier" \
+            else None
+        a2av = extra[1] if extra is not None and extra[0] == "a2av" \
+            else None
         from accl_tpu.constants import CompressionFlags
 
         rsd = root if scen != Operation.send \
@@ -404,7 +425,8 @@ def run_schedules(deep: bool = False, sample: int = 0,
             scenario=scen, count=count, root_src_dst=rsd,
             function=int(ReduceFunction.SUM),
             data_type=DataType.float32,
-            compress_dtype=wire, compression_flags=comp_flags)
+            compress_dtype=wire, compression_flags=comp_flags,
+            peer_counts=a2av or ())
         hier_kw: dict = {}
         if hier is not None:
             topo, tier_wires, stripes = hier
@@ -421,7 +443,11 @@ def run_schedules(deep: bool = False, sample: int = 0,
             scen, count, 4, world, comp_flags,
             max_eager_size=DEFAULT_MAX_EAGER_SIZE,
             eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
-            tuning=tuning, compress_dtype=wire, **hier_kw)
+            tuning=tuning, compress_dtype=wire,
+            peer_counts=a2av or (), **hier_kw)
+        if a2av is not None:
+            assert plan.algorithm.name == "FLAT_ALLTOALLV", \
+                f"alltoallv config did not select the v-schedule: {plan}"
         if hier is not None:
             import dataclasses as _dc
 
