@@ -402,21 +402,25 @@ class ACCL:
                              run_async)
 
     def copy_to_stream(self, srcbuf, count, *, res_stream, dstbuf=None,
-                       from_device=False, run_async=False):
+                       from_device=False, to_device=False,
+                       run_async=False):
         """srcbuf routes through a registered consumer stream (reference
         copy_to_stream, accl.hpp:334). The consumer's return value
         materializes into dstbuf when given (the observable form; the
         reference's PL-kernel sink has no host-visible landing spot),
-        else into an internal placeholder."""
+        else into an internal placeholder. `to_device=True` skips the
+        device->host result sync even with a dstbuf — the chained
+        on-device form (the eager train-step twin keeps its gradient
+        intermediate resident between stages)."""
         fresh = dstbuf is None and run_async
         dst = dstbuf if dstbuf is not None else self._scratch(
             count, srcbuf.np_dtype, fresh=run_async)
         opts = self._prepare(Operation.copy, srcbuf, None, dst, count)
         self._stream_opts(opts, None, res_stream)
-        # to_device=True (skip the device->host result sync) only for the
-        # unobserved internal placeholder
+        # to_device=True (skip the device->host result sync) for the
+        # unobserved internal placeholder, or on caller request
         req = self._execute(opts, [srcbuf], [dst], from_device,
-                            dstbuf is None, run_async)
+                            to_device or dstbuf is None, run_async)
         if fresh:
             req._accl_scratch = dst
         return req
@@ -824,11 +828,12 @@ class ACCL:
                   tuning.hier_allreduce_min_count)
         dev.write(CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT,
                   tuning.alltoall_compress_min_count)
+        dev.write(CCLOAddr.OVERLAP_MIN_COUNT, tuning.overlap_min_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator",
                  wire_dtype: DataType = DataType.none,
-                 tier_links=None) -> TuningParams:
+                 tier_links=None, compute_fit=None) -> TuningParams:
         """Derive the switch-point tuning registers — the reference's
         four, the synth windows, and (on a device that declares a
         two-tier topology) HIER_ALLREDUCE_MIN_COUNT — from the
@@ -890,10 +895,18 @@ class ACCL:
             from .telemetry.feedback import default_tier_links
 
             tier_links = default_tier_links(timing_model_path)
+        # the overlap register needs a measured compute term next to
+        # the link fit (timing.ComputeFit); absent one the crossover
+        # stays 0 and streamed-allreduce selection is untouched
+        if compute_fit is None:
+            from .telemetry.feedback import default_compute_fit
+
+            compute_fit = default_compute_fit(timing_model_path)
         cross = tuning_crossovers(link, world=self.world,
                                   wire_dtype=wire_dtype,
                                   tier_links=tier_links,
-                                  topology=topology)
+                                  topology=topology,
+                                  compute_fit=compute_fit)
         tuning = TuningParams.from_crossovers(cross)
         self.configure_tuning_parameters(tuning)
         # per-tier wire arbitration rides the same tune: with the
@@ -981,8 +994,14 @@ class SequenceRecorder:
 
     # -- recorded forms of the facade's data-plane calls -------------------
 
-    def copy(self, srcbuf, dstbuf, count):
+    def copy(self, srcbuf, dstbuf, count, *, op0_stream=None,
+             res_stream=None):
+        """Recorded copy; `res_stream` routes the result through a
+        registered consumer before it lands in dstbuf (the recorded
+        form of copy_to_stream — the seam the fused train step splices
+        its forward+backward compute through)."""
         opts = self._prep(Operation.copy, srcbuf, None, dstbuf, count)
+        self._accl._stream_opts(opts, op0_stream, res_stream)
         return self._record(opts, [srcbuf], [dstbuf])
 
     def combine(self, count, function, op0, op1, res):

@@ -362,6 +362,7 @@ class TuningParams:
         synth_reduce_scatter_max_count: int = 0,
         hier_allreduce_min_count: int = 0,
         alltoall_compress_min_count: int = 0,
+        overlap_min_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
@@ -415,6 +416,21 @@ class TuningParams:
         # alltoall_compress_min_bytes), the same measured-selection
         # posture as the hier register.
         self.alltoall_compress_min_count = alltoall_compress_min_count
+        # Compute-communication overlap crossover (sequencer/plan.py +
+        # timing.predict_overlapped): STREAMED eager fp32 allreduce
+        # payloads of AT LEAST this many bytes — the consumer-spliced
+        # gradient-sync seam, where adjacent compute exists to overlap
+        # with — run as Plan.stripes independent stripe chains whose
+        # depth is the cost model's argmin (timing.best_overlap_stripes
+        # under the calibrated shaped link and the measured ComputeFit
+        # compute term). A MIN register like the hier one: overlap wins
+        # the regime where wire time is visible next to compute, and
+        # buys nothing on the latency floor. 0 — the default — keeps
+        # selection bit-for-bit the serial dispatch->compute form;
+        # ACCL.autotune sets it from timing.tuning_crossovers'
+        # overlap_min_bytes, the same measured-selection posture as
+        # every other register.
+        self.overlap_min_count = overlap_min_count
 
     @classmethod
     def default(cls, max_rndzv_msg_size: int = DEFAULT_MAX_RENDEZVOUS_SIZE):
@@ -485,5 +501,14 @@ class TuningParams:
             alltoall_compress_min_count=(
                 int(cross.get("alltoall_compress_min_bytes", 0))
                 if int(cross.get("alltoall_compress_min_bytes", 0))
+                <= max_count_cap else 0),
+            # same MIN-register posture again: 0 = no compute
+            # calibration / overlap never predicts a win, and an
+            # over-cap window start clamps to OFF (min(v, cap) would
+            # widen the window into the regime the calibration said
+            # the serial form wins)
+            overlap_min_count=(
+                int(cross.get("overlap_min_bytes", 0))
+                if int(cross.get("overlap_min_bytes", 0))
                 <= max_count_cap else 0),
         )

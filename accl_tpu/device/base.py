@@ -57,6 +57,16 @@ class CCLOAddr:
     # floor). 0 (the default) keeps selection bit-for-bit unchanged.
     # Set by ACCL.autotune from the calibrated crossover.
     ALLTOALL_COMPRESS_MIN_COUNT = 0x1FB0
+    # Compute-communication overlap crossover (sequencer/plan.py +
+    # timing.predict_overlapped): streamed eager fp32 allreduce
+    # payloads of AT LEAST this many bytes run as cost-model-chosen
+    # independent stripe chains (Plan.stripes) so the wire overlaps
+    # the compute spliced next to it — a MIN threshold like the hier
+    # and alltoall-compress registers (overlap wins where wire time is
+    # visible next to compute, never the latency floor). 0 (the
+    # default) keeps selection bit-for-bit the serial form. Set by
+    # ACCL.autotune from the calibrated crossover.
+    OVERLAP_MIN_COUNT = 0x1FAC
     EGR_RX_BUF_SIZE = 0x4
     NUM_EGR_RX_BUFS = 0x0
     # Start of the dynamically-laid-out region (communicators, arith
@@ -64,7 +74,7 @@ class CCLOAddr:
     DYNAMIC_BASE = 0x200
     # End of the dynamic region: the lowest-addressed register above
     # (keep in sync when adding registers).
-    DYNAMIC_END = 0x1FB0
+    DYNAMIC_END = 0x1FAC
 
 
 # The hardware id this framework reports, with capability bits analogous

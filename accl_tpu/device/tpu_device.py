@@ -167,6 +167,8 @@ class TPUDevice(CCLODevice):
             # and 0 = quantized alltoall wire off
             alltoall_compress_min_count=rd(
                 CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT),
+            # and 0 = stripe-overlapped allreduce off (serial form)
+            overlap_min_count=rd(CCLOAddr.OVERLAP_MIN_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------
@@ -230,6 +232,7 @@ class TPUDevice(CCLODevice):
             arith_table=self.compiler.arith_table,
             use_pallas_ring=self.compiler.use_pallas_ring,
             pallas_ring_overlap=self.compiler.pallas_ring_overlap,
+            overlap_serialize=self.compiler.overlap_serialize,
         )
         return _CommCtx(len(rows), sub_mesh, compiler, rows)
 

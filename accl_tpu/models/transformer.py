@@ -192,16 +192,20 @@ def _qkv(h, lyr, cfg: TransformerConfig, pos):
     return q, k, v
 
 
-def _tp_allreduce(x, wire):
+def _tp_allreduce(x, wire, axis: str | None = "tp"):
     """Tensor-parallel partial-sum reduction through the framework's ring
-    reduce-scatter + allgather schedule (the ACCL eager allreduce)."""
+    reduce-scatter + allgather schedule (the ACCL eager allreduce).
+    axis=None is the single-shard degenerate (no tp axis in the mesh —
+    the facade train step's data-parallel body): identity."""
+    if axis is None:
+        return x
     shape = x.shape
     flat = x.reshape(-1)
     out = schedules.allreduce_ring_schedule(
         flat,
         func=ReduceFunction.SUM,
-        axis="tp",
-        world=lax.axis_size("tp"),
+        axis=axis,
+        world=lax.axis_size(axis),
         wire=wire,
         seg_count=flat.shape[0],
     )
@@ -224,28 +228,144 @@ def _grad_allreduce(g, axis, wire):
     return out.reshape(shape) / world  # mean over replicas
 
 
-def _mlp_half(x, lyr, wire):
+def _local_attention(q, k, v):
+    """Plain causal attention over a fully-local sequence — the
+    sp-axis-free degenerate of ring attention, grouped-query aware
+    (the facade train step's body runs it: its mesh has only the
+    collective axis, so the sequence is never sharded)."""
+    B, T, H, Dh = q.shape
+    kv_heads = k.shape[2]
+    groups = H // kv_heads
+    qg = q.reshape(B, T, kv_heads, groups, Dh)
+    s = jnp.einsum("bthgk,bshk->bhgts", qg, k).astype(jnp.float32)
+    s = s / np.sqrt(Dh)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhgts,bshk->bthgk", p.astype(v.dtype), v)
+    return ctx.reshape(B, T, H, Dh)
+
+
+# per-layer leaf order of the flat gradient/parameter vector, REVERSE
+# backward-materialization order within a block: the backward produces
+# the MLP's grads before the attention's, so the flat layout (unembed,
+# layers N-1..0 each in this order, embed) puts the earliest-available
+# gradients first — stripe 0 of an overlapped sync is ready while the
+# rest of the backward still computes
+_LAYER_BWD_ORDER = ("w_down", "w_up", "ln2", "wo", "wkv", "wq", "ln1")
+
+
+def _backward_ordered_leaves(tree: dict) -> list:
+    """The parameter/gradient leaves of the (pp=1) transformer pytree in
+    backward-materialization order (see _LAYER_BWD_ORDER)."""
+    leaves = [tree["unembed"]]
+    for lyr in reversed(tree["layers"]):
+        leaves.extend(lyr[k] for k in _LAYER_BWD_ORDER)
+    leaves.append(tree["embed"])
+    return leaves
+
+
+def _striped_grad_sync(grads: dict, pspecs: dict, wire,
+                       stripes: int, serial: bool):
+    """Bucketed gradient sync, the stripe-overlapped form: per-leaf tp
+    treatment first (the rescale-vs-allreduce logic is per spec), then
+    ONE flat dp+sp mean-allreduce over the concatenated gradient
+    vector split into `stripes` independent stripe chains. Leaves
+    concatenate in backward-materialization order, and each stripe's
+    ring chains depend only on its own leaves (XLA's slice-of-concat
+    simplification restores the fine-grained dependence), so stripe
+    i's allreduce runs while stripe i+1's gradients materialize in the
+    backward. serial=True is the dispatch->compute twin: stripe 0 is
+    order-barriered on the WHOLE gradient vector and each later stripe
+    on its predecessor's output — bitwise-identical (barriers change
+    scheduling, never values), measured as the A/B baseline."""
+    tp_world = lax.axis_size("tp")
+
+    def tp_fix(g, spec):
+        if tp_world > 1:
+            if _spec_has_axis(spec, "tp"):
+                return g / tp_world
+            return _grad_allreduce(g, "tp", wire)
+        return g
+
+    grads = jax.tree.map(tp_fix, grads, pspecs)
+    leaves = _backward_ordered_leaves(grads)
+    shapes = [g.shape for g in leaves]
+    flat = jnp.concatenate([g.reshape(-1) for g in leaves])
+    n = flat.shape[-1]
+    per = -(-n // max(stripes, 1))
+    outs = []
+    prev = None
+    for s in range(max(stripes, 1)):
+        lo = s * per
+        if lo >= n:
+            break
+        seg = flat[lo:min(lo + per, n)]
+        if serial:
+            seg = schedules._ordered_after(
+                seg, flat if prev is None else prev)
+        for ax in ("dp", "sp"):
+            world = lax.axis_size(ax)
+            if world == 1:
+                continue
+            seg = schedules.allreduce_ring_schedule(
+                seg, func=ReduceFunction.SUM, axis=ax, world=world,
+                wire=wire, seg_count=seg.shape[-1],
+            ) / world
+        outs.append(seg)
+        prev = outs[-1]
+    flat = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    parts = []
+    off = 0
+    for sh in shapes:
+        size = int(np.prod(sh)) if sh else 1
+        parts.append(flat[off:off + size].reshape(sh))
+        off += size
+    out = {"unembed": parts[0], "embed": parts[-1], "layers": []}
+    idx = 1
+    rev_layers = []
+    for _ in grads["layers"]:
+        lyr = {}
+        for k in _LAYER_BWD_ORDER:
+            lyr[k] = parts[idx]
+            idx += 1
+        rev_layers.append(lyr)
+    out["layers"] = list(reversed(rev_layers))
+    return out
+
+
+def _mlp_half(x, lyr, wire, tp_axis: str | None = "tp"):
     """ln2 + gelu MLP + tp partial-sum residual — shared by the training
     block and the decode block so the two cannot silently diverge."""
     h = _rmsnorm(x, lyr["ln2"])
     up = jax.nn.gelu(jnp.einsum("btd,df->btf", h, lyr["w_up"]))
     down_partial = jnp.einsum("btf,fd->btd", up, lyr["w_down"])
-    return x + _tp_allreduce(down_partial, wire)
+    return x + _tp_allreduce(down_partial, wire, tp_axis)
 
 
-def _block(x, lyr, cfg: TransformerConfig, wire):
+def _block(x, lyr, cfg: TransformerConfig, wire,
+           tp_axis: str | None = "tp", sp_axis: str | None = "sp"):
     """One transformer block (ring attention over sp, tp partial-sum
     reductions through the framework ring). RoPE positions are global:
-    each sp shard offsets by its rank."""
+    each sp shard offsets by its rank. tp_axis/sp_axis None run the
+    axis-free degenerates (local causal attention, identity partial
+    sum) — the SAME block serving the facade train step's
+    data-parallel body, so the two model forms cannot diverge."""
     h = _rmsnorm(x, lyr["ln1"])
     T = h.shape[1]
-    pos = lax.axis_index("sp") * T + jnp.arange(T)
+    if sp_axis is None:
+        pos = jnp.arange(T)
+    else:
+        pos = lax.axis_index(sp_axis) * T + jnp.arange(T)
     q, k, v = _qkv(h, lyr, cfg, pos)
-    attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    if sp_axis is None:
+        attn = _local_attention(q, k, v)
+    else:
+        attn = ring_attention(q, k, v, axis_name=sp_axis, causal=True)
     o_partial = jnp.einsum("bthk,hkd->btd", attn, lyr["wo"])
     # heads are sharded over tp: partial sums reduce on-device-ring
-    x = x + _tp_allreduce(o_partial, wire)
-    return _mlp_half(x, lyr, wire)
+    x = x + _tp_allreduce(o_partial, wire, tp_axis)
+    return _mlp_half(x, lyr, wire, tp_axis)
 
 
 def _block_fn(cfg: TransformerConfig, wire, remat: bool):
@@ -422,18 +542,52 @@ def make_decode_step(cfg: TransformerConfig, mesh: Mesh):
 
 
 def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3,
-                    n_microbatches: int | None = None, remat: bool = False):
+                    n_microbatches: int | None = None, remat: bool = False,
+                    grad_sync: str = "leaf",
+                    grad_stripes: int | None = None):
     """One compiled SGD step: forward + backward + grad sync + update, all
     inside a single shard_map program (host-only-dispatches). With a `pp`
     mesh axis the layers pipeline over it (GPipe microbatches) and params
     take the stacked form from stack_layer_params/pp_param_specs.
     remat=True rematerializes each block in the backward pass
     (jax.checkpoint), cutting peak activation memory from O(layers) to
-    O(1) blocks at ~1/3 extra FLOPs — the standard long-context tradeoff."""
+    O(1) blocks at ~1/3 extra FLOPs — the standard long-context tradeoff.
+
+    grad_sync picks the dp/sp gradient-sync shape: "leaf" (default, the
+    original per-leaf allreduces), "striped" (bucketed: one flat
+    backward-ordered gradient vector allreduced as `grad_stripes`
+    independent stripe chains the backward can overlap — see
+    _striped_grad_sync), or "striped_serial" (the same stripes
+    barrier-serialized after the full backward, the bitwise-identical
+    dispatch->compute twin). grad_stripes=None derives the stripe
+    count from the cost model's argmin under the shipped calibration
+    (timing.best_overlap_stripes with the shaped link and the measured
+    compute term — no calibration falls back to 1, never a made-up
+    depth)."""
+    if grad_sync not in ("leaf", "striped", "striped_serial"):
+        raise ValueError(f"unknown grad_sync {grad_sync!r}")
     wire = schedules.Wire(None)
     pp = _pp_world(mesh)
     M = (n_microbatches or pp) if pp > 1 else 1
     pspecs = pp_param_specs(cfg) if pp > 1 else param_specs(cfg)
+    if grad_sync != "leaf" and pp > 1:
+        raise NotImplementedError(
+            "striped grad sync covers the pp=1 layer-list form")
+    if grad_sync != "leaf" and grad_stripes is None:
+        from ..sequencer.timing import best_overlap_stripes
+        from ..telemetry import feedback as _fb
+
+        tl = _fb.default_tier_links()
+        link = tl.outer if tl is not None else _fb.default_link()
+        fit = _fb.default_compute_fit()
+        grad_stripes = 1
+        if link is not None and fit is not None:
+            nbytes = train_param_count(cfg) * 4
+            sync_world = max(dict(mesh.shape).get("dp", 1),
+                             dict(mesh.shape).get("sp", 1))
+            grad_stripes = best_overlap_stripes(
+                link, nbytes // 4, 4, max(sync_world, 2),
+                compute_s=fit.seconds(nbytes), rx_buf_bytes=1024)
 
     def loss_fn(params, tokens, targets):
         if pp > 1:
@@ -469,7 +623,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3,
                     g = _grad_allreduce(g, "tp", wire)
             return g
 
-        grads = jax.tree.map(sync, grads, pspecs)
+        if grad_sync == "leaf":
+            grads = jax.tree.map(sync, grads, pspecs)
+        else:
+            grads = _striped_grad_sync(
+                grads, pspecs, wire, stripes=int(grad_stripes or 1),
+                serial=(grad_sync == "striped_serial"))
         if pp > 1:
             # the pipeline injects microbatches only on pp rank 0, so the
             # embed cotangent lands entirely on rank 0 (zeros elsewhere):
@@ -521,6 +680,211 @@ def shard_params(params, cfg, mesh):
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-resident train step: forward + backward + stripe-overlapped
+# gradient allreduce + SGD update as ONE recorded descriptor batch
+# (ROADMAP item 4's training-scale form of the stream-consumer seam)
+# ---------------------------------------------------------------------------
+
+# kernel-stream id the train step's fwd+bwd consumer registers under
+# (one well-known default keeps bench, fuzz and tests on the endpoint)
+TRAIN_GRAD_STREAM = 21
+
+
+def _train_leaf_shapes(cfg: TransformerConfig) -> list:
+    """Leaf shapes of the flat train-step parameter vector, in the
+    backward-materialization order _backward_ordered_leaves uses
+    (unembed, layers N-1..0 each per _LAYER_BWD_ORDER, embed)."""
+    d, ff = cfg.d_model, cfg.d_ff
+    layer = {
+        "w_down": (ff, d), "w_up": (d, ff), "ln2": (d,),
+        "wo": (cfg.n_heads, cfg.head_dim, d),
+        "wkv": (d, 2, cfg.kv_heads, cfg.head_dim),
+        "wq": (d, cfg.n_heads, cfg.head_dim), "ln1": (d,),
+    }
+    shapes: list = [(d, cfg.vocab)]  # unembed
+    for _ in range(cfg.n_layers):
+        shapes.extend(layer[k] for k in _LAYER_BWD_ORDER)
+    shapes.append((cfg.vocab, d))  # embed
+    return shapes
+
+
+def train_param_count(cfg: TransformerConfig) -> int:
+    """Element count of the flat train-step parameter vector — the
+    `count` of every descriptor in the fused train-step batch (and the
+    gradient bytes the overlap register compares, x4)."""
+    return sum(int(np.prod(s)) for s in _train_leaf_shapes(cfg))
+
+
+def flatten_train_params(params: dict):
+    """Parameter/gradient pytree -> flat vector in backward order (the
+    layout every train-step buffer uses; see _backward_ordered_leaves
+    for why the order matters to the overlap)."""
+    return jnp.concatenate(
+        [g.reshape(-1) for g in _backward_ordered_leaves(params)])
+
+
+def unflatten_train_params(flat, cfg: TransformerConfig) -> dict:
+    """Inverse of flatten_train_params (traced-value friendly)."""
+    shapes = _train_leaf_shapes(cfg)
+    parts = []
+    off = 0
+    for sh in shapes:
+        size = int(np.prod(sh))
+        parts.append(flat[off:off + size].reshape(sh))
+        off += size
+    rev_layers = []
+    idx = 1
+    for _ in range(cfg.n_layers):
+        lyr = {}
+        for k in _LAYER_BWD_ORDER:
+            lyr[k] = parts[idx]
+            idx += 1
+        rev_layers.append(lyr)
+    return {"unembed": parts[0], "embed": parts[-1],
+            "layers": list(reversed(rev_layers))}
+
+
+def local_train_loss(params: dict, tokens, targets,
+                     cfg: TransformerConfig):
+    """Mean next-token NLL of the axis-free transformer forward — the
+    SAME blocks as the sharded model (_block with tp_axis=sp_axis=None:
+    local causal attention, identity partial sums), so the facade train
+    step runs the real model, not a stand-in."""
+    x = params["embed"][tokens]
+    for lyr in params["layers"]:
+        x = _block(x, lyr, cfg, schedules.Wire(None),
+                   tp_axis=None, sp_axis=None)
+    x = _rmsnorm(x, jnp.ones((cfg.d_model,), x.dtype))
+    logits = jnp.einsum("btd,dv->btv", x, params["unembed"])
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return nll.mean()
+
+
+def make_grad_consumer(cfg: TransformerConfig, tokens, targets,
+                       axis_name: str = "ccl", scale: float = 1.0):
+    """The forward+backward as a RES_STREAM consumer: the copy step's
+    result (this rank's flat parameter vector) runs the full local
+    fwd+bwd over the rank's (batch-shard) tokens — selected by
+    axis_index, so ONE traced callable serves every rank — and lands
+    the flat gradient (backward order) in the result buffer. The
+    tokens/targets close over the endpoint as program constants, like
+    the MoE expert consumer's weights.
+
+    `scale` folds into the differentiated loss (the backward's seed
+    cotangent), so the consumer emits scale * grad directly. The train
+    step passes -lr/world here: the dp mean and the SGD learning rate
+    ride the backward, the allreduce SUMs per-rank update
+    contributions, and the final combine is a pure add of two
+    materialized values — no multiply ever feeds that add, so XLA
+    cannot FMA-contract it differently in the fused program than in
+    the eager twin (which is what keeps fused bitwise-identical to
+    eager; a post-allreduce scale consumer provably broke it by an
+    ULP)."""
+    tok = jnp.asarray(tokens)
+    tgt = jnp.asarray(targets)
+    s = np.float32(scale)
+
+    def consumer(params_flat):
+        params = unflatten_train_params(
+            params_flat.astype(jnp.float32), cfg)
+        me = lax.axis_index(axis_name)
+        t = lax.dynamic_index_in_dim(tok, me, axis=0, keepdims=False)
+        g = lax.dynamic_index_in_dim(tgt, me, axis=0, keepdims=False)
+        grads = jax.grad(
+            lambda p: s * local_train_loss(p, t, g, cfg))(params)
+        return flatten_train_params(grads).astype(params_flat.dtype)
+
+    return consumer
+
+
+def create_train_step_buffers(accl, cfg: TransformerConfig):
+    """(params, grads, update, new_params) flat rank buffers for the
+    fused train step, each (world, train_param_count) fp32."""
+    n = train_param_count(cfg)
+    return tuple(accl.create_buffer(n, np.float32) for _ in range(4))
+
+
+def _register_train_consumers(accl, cfg: TransformerConfig, tokens,
+                              targets, lr: float):
+    # dp mean + SGD learning rate fold into the backward's seed
+    # cotangent (see make_grad_consumer's scale note): each rank emits
+    # its UPDATE contribution u_r = grad(-lr/world * loss_r), the
+    # allreduce sums them, and the combine is a pure add
+    accl.register_stream_consumer(
+        TRAIN_GRAD_STREAM,
+        make_grad_consumer(cfg, tokens, targets, accl.axis_name,
+                           scale=-lr / accl.world))
+
+
+def record_train_step(accl, cfg: TransformerConfig, tokens, targets, *,
+                      lr: float = 1e-3, lint: str = "error",
+                      buffers=None):
+    """Record the data-parallel transformer train step as ONE
+    descriptor batch over `accl`'s axis:
+
+      1. copy(params -> grads) with the fwd+bwd spliced as its
+         RES_STREAM consumer (the model compute IS in the program; the
+         -lr/world update scale rides the backward seed);
+      2. allreduce(grads -> update, SUM) — inside the
+         OVERLAP_MIN_COUNT window this step's plan stripes into
+         independent chains, and because the flat gradient is a
+         backward-ordered concat whose slices simplify to the
+         individual leaves, stripe i's ring chains depend only on
+         stripe i's gradients: the wire runs while the rest of the
+         backward materializes, in ONE jit(shard_map) program;
+      3. combine(SUM, params, update -> new_params): the SGD step.
+
+    Returns (recorder, buffers); `recorder.compile()` freezes it into
+    the steady-state SequenceProgram (`make_train_step_program`), and
+    the same three descriptors issued eagerly are the serial
+    dispatch->compute twin (`run_train_step_eager`) — bitwise-identical
+    at fp32, the measured A/B of bench --overlap-gate."""
+    if buffers is None:
+        buffers = create_train_step_buffers(accl, cfg)
+    pbuf, gbuf, ubuf, obuf = buffers
+    n = train_param_count(cfg)
+    _register_train_consumers(accl, cfg, tokens, targets, lr)
+    seq = accl.sequence(lint=lint)
+    seq.copy(pbuf, gbuf, n, res_stream=TRAIN_GRAD_STREAM)
+    seq.allreduce(gbuf, ubuf, n, ReduceFunction.SUM)
+    seq.combine(n, ReduceFunction.SUM, pbuf, ubuf, obuf)
+    return seq, buffers
+
+
+def make_train_step_program(accl, cfg: TransformerConfig, tokens,
+                            targets, *, lr: float = 1e-3,
+                            lint: str = "error", buffers=None):
+    """The steady-state fused train step: record once, compile once,
+    dispatch ONE program per iteration (the SequenceProgram seam the
+    MoE layer step rides). Returns (program, buffers); the caller's
+    loop is `write pbuf -> program.run() -> read obuf`."""
+    seq, buffers = record_train_step(accl, cfg, tokens, targets, lr=lr,
+                                    lint=lint, buffers=buffers)
+    return seq.compile(), buffers
+
+
+def run_train_step_eager(accl, cfg: TransformerConfig, buffers):
+    """The serial dispatch->compute twin: the SAME three descriptors
+    the fused batch records, issued eagerly — the compute program
+    completes before the allreduce program dispatches, and the stripe
+    chains (same register-selected plan) run serialized when the
+    compiler's overlap_serialize twin flag is set. Three dispatches,
+    intermediates kept on-device (the baseline pays the dispatch
+    seams, not artificial host round trips). Bitwise-identical to the
+    fused overlapped program at fp32 (fuzz-pinned)."""
+    pbuf, gbuf, ubuf, obuf = buffers
+    n = train_param_count(cfg)
+    accl.copy_to_stream(pbuf, n, res_stream=TRAIN_GRAD_STREAM,
+                        dstbuf=gbuf, from_device=True, to_device=True)
+    accl.allreduce(gbuf, ubuf, n, ReduceFunction.SUM, from_device=True,
+                   to_device=True)
+    accl.combine(n, ReduceFunction.SUM, pbuf, ubuf, obuf,
+                 from_device=True, to_device=True)
+    return accl._last_request
 
 
 def demo_batch(cfg, mesh, batch=4, seq=64, seed=0):

@@ -55,25 +55,12 @@ def _heads_to_seq(x, axis_name: str, world: int, wire: schedules.Wire):
     return out.reshape(B, T, world * Hl, D)
 
 
-def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
-                      sm_scale: float | None = None,
-                      wire: schedules.Wire | None = None):
-    """Per-device body (call inside shard_map): sequence-sharded q/k/v of
-    shape (B, T_local, H, D) with H divisible by the axis size.
-
-    `wire` configures the re-shardings' datapath: a blockwise-quantized
-    Wire (the (fp32, int8) arith row) ships every alltoall hop as ONE
-    packed codes+scales message (~3.94x fewer wire bytes, one
-    quantization pass per chunk — the same lanes the MoE dispatch
-    rides); None keeps the exact fp32 wire."""
-    world = lax.axis_size(axis_name)
-    B, T, H, D = q.shape
-    if H % world != 0:
-        raise ValueError(f"heads {H} must divide by axis size {world}")
-    if sm_scale is None:
-        sm_scale = 1.0 / (D ** 0.5)
-    if wire is None:
-        wire = schedules.Wire(None)
+def _attend_group(q, k, v, *, axis_name: str, world: int, causal: bool,
+                  sm_scale: float, wire: schedules.Wire):
+    """One head group's full Ulysses round trip: re-shard to
+    head-sharded, attend with full sequence visibility, re-shard back.
+    Heads are independent in attention, so running the groups
+    separately is bitwise what one monolithic round trip computes."""
     qg, kg, vg = (_seq_to_heads(t, axis_name, world, wire)
                   for t in (q, k, v))
     s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * sm_scale
@@ -86,3 +73,63 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
     p = p / jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vg.dtype), vg)
     return _heads_to_seq(out, axis_name, world, wire)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      sm_scale: float | None = None,
+                      wire: schedules.Wire | None = None,
+                      stripes: int = 1, serial: bool = False):
+    """Per-device body (call inside shard_map): sequence-sharded q/k/v of
+    shape (B, T_local, H, D) with H divisible by the axis size.
+
+    `wire` configures the re-shardings' datapath: a blockwise-quantized
+    Wire (the (fp32, int8) arith row) ships every alltoall hop as ONE
+    packed codes+scales message (~3.94x fewer wire bytes, one
+    quantization pass per chunk — the same lanes the MoE dispatch
+    rides); None keeps the exact fp32 wire.
+
+    `stripes` double-buffers the two re-sharding all-to-alls against
+    the attention matmuls: the heads split into `stripes` groups (each
+    still divisible by the axis size) and every group runs its own
+    in-alltoall -> attention -> out-alltoall chain. The groups are
+    data-independent, so XLA overlaps group i's wire with group i+1's
+    matmuls — and because attention is per-head, the striped result is
+    BITWISE-identical to stripes=1 (pinned). stripes=2 is the classic
+    double buffer; pick the depth with timing.best_overlap_stripes
+    when a calibration exists. serial=True order-barriers group i+1's
+    inputs on group i's output — the serial dispatch->compute twin,
+    same values, measurable A/B."""
+    world = lax.axis_size(axis_name)
+    B, T, H, D = q.shape
+    if H % world != 0:
+        raise ValueError(f"heads {H} must divide by axis size {world}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    if wire is None:
+        wire = schedules.Wire(None)
+    stripes = max(int(stripes), 1)
+    if stripes == 1:
+        return _attend_group(q, k, v, axis_name=axis_name, world=world,
+                             causal=causal, sm_scale=sm_scale, wire=wire)
+    if H % (world * stripes) != 0:
+        raise ValueError(
+            f"heads {H} must divide by axis size x stripes "
+            f"({world} x {stripes})")
+    hs = H // stripes
+    outs = []
+    prev = None
+    for g in range(stripes):
+        qs, ks, vs = (t[:, :, g * hs:(g + 1) * hs, :] for t in (q, k, v))
+        if serial and prev is not None:
+            # ALL three inputs barrier on the previous group, or the
+            # twin's k/v all-to-alls would still overlap the previous
+            # group's matmuls and the serial baseline would be
+            # partially overlapped
+            qs = schedules._ordered_after(qs, prev)
+            ks = schedules._ordered_after(ks, prev)
+            vs = schedules._ordered_after(vs, prev)
+        out = _attend_group(qs, ks, vs, axis_name=axis_name, world=world,
+                            causal=causal, sm_scale=sm_scale, wire=wire)
+        outs.append(out)
+        prev = out
+    return jnp.concatenate(outs, axis=2)

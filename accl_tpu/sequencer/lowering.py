@@ -55,6 +55,7 @@ class ScheduleCompiler:
         arith_table: dict | None = None,
         use_pallas_ring: bool | None = None,
         pallas_ring_overlap: bool | None = None,
+        overlap_serialize: bool | None = None,
     ):
         self.mesh = mesh
         self.axis_name = axis_name
@@ -75,6 +76,18 @@ class ScheduleCompiler:
             pallas_ring_overlap = (
                 os.environ.get("ACCL_PALLAS_RING_SERIALIZE") != "1")
         self.pallas_ring_overlap = pallas_ring_overlap
+        if overlap_serialize is None:
+            # the serial dispatch->compute twin of a stripe-overlapped
+            # allreduce plan (Plan.stripes > 1): order-only barriers
+            # serialize the stripe chains, bitwise-identical to the
+            # overlapped form — the A/B baseline bench --overlap-gate
+            # measures against (same knob pattern as the pallas ring's
+            # serialized baseline above)
+            import os
+
+            overlap_serialize = (
+                os.environ.get("ACCL_OVERLAP_SERIALIZE") == "1")
+        self.overlap_serialize = overlap_serialize
         self._cache: dict = {}
 
     # Per-device payload ceiling for the VMEM-resident fused ring kernel;
@@ -121,7 +134,8 @@ class ScheduleCompiler:
         arithcfg: ArithConfig | None = None,
     ) -> Callable:
         key = (options.signature(), plan, self.axis_name,
-               self.use_pallas_ring, self.pallas_ring_overlap)
+               self.use_pallas_ring, self.pallas_ring_overlap,
+               self.overlap_serialize)
         fn = self._cache.get(key)
         if fn is None:
             from ..utils.logging import Log
@@ -178,7 +192,7 @@ class ScheduleCompiler:
         # stale compiled program when an endpoint is re-registered
         key = (options.signature(), plan, self.axis_name,
                self.use_pallas_ring, self.pallas_ring_overlap,
-               "streamed", producer, consumer)
+               self.overlap_serialize, "streamed", producer, consumer)
         fn = self._cache.get(key)
         if fn is None:
             body, n_in = self._body(options, plan, arithcfg)
@@ -445,6 +459,12 @@ class ScheduleCompiler:
                         schedules.allreduce_ring_schedule,
                         func=func,
                         seg_count=plan.seg_count,
+                        # the serial dispatch->compute twin: stripe
+                        # chains of an OVERLAP plan barrier-ordered
+                        # (plain rx-geometry segmentation is untouched
+                        # — only cost-model-striped plans have a twin)
+                        serialize=(self.overlap_serialize
+                                   and plan.stripes > 1),
                         **common,
                     )
             n_in = 1
@@ -498,7 +518,8 @@ class ScheduleCompiler:
         signature alongside the per-call entries, so re-recording the same
         shapes+dataflow compiles nothing."""
         key = seq.cache_key(self.axis_name, self.use_pallas_ring,
-                            self.pallas_ring_overlap)
+                            self.pallas_ring_overlap,
+                            self.overlap_serialize)
         fn = self._cache.get(key)
         if fn is None:
             from ..utils.logging import Log

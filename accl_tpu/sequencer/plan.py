@@ -99,7 +99,11 @@ class Plan:
     synth_key: str = ""
     # HIER_RS_AR_AG plans: the two-tier shape and the per-tier wire
     # decision. inner/outer_world pin the topology the schedule was
-    # selected for; stripes is the cost-model-chosen pipeline depth;
+    # selected for; stripes is the cost-model-chosen pipeline depth —
+    # shared with stripe-overlapped EAGER_RING_RS_AG plans (the
+    # OVERLAP_MIN_COUNT register), where it counts the independent
+    # stripe chains a fused program overlaps against adjacent compute
+    # (timing.best_overlap_stripes' argmin; 1 = the serial form);
     # inner/outer_wire_dtype are the per-tier compression lanes
     # (select_tier_wires arbitrates each link separately — int8 on DCN
     # while fp32 stays on ICI). All frozen, so every one of these
@@ -172,6 +176,8 @@ def select_algorithm(
     tier_wires: tuple[DataType, DataType] = (DataType.none, DataType.none),
     tier_links=None,
     peer_counts: tuple[int, ...] = (),
+    overlap_link=None,
+    overlap_compute=None,
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
@@ -193,6 +199,18 @@ def select_algorithm(
     timing.TierLinks used to pick the stripe count (default: the
     shipped per-tier calibration, telemetry.feedback.default_tier_links
     — no calibration means 1 stripe, never a made-up pipeline depth).
+
+    `overlap_link` (timing.LinkParams) and `overlap_compute`
+    (timing.ComputeFit) parameterize the OVERLAP_MIN_COUNT register's
+    stripe choice for exact eager allreduces (the consumer-spliced
+    gradient-sync seam): inside the window the call runs as
+    Plan.stripes independent stripe chains whose depth is
+    timing.best_overlap_stripes' argmin under the calibrated shaped
+    link and the measured compute term. Defaults load the shipped
+    calibration (the tier-outer link and compute_fit) from
+    telemetry.feedback; with no calibration the plan stays the serial
+    form — never a made-up pipeline depth. Register 0 (the default)
+    keeps selection bit-for-bit unchanged.
     """
     bytes_count = count * dtype_nbytes
     rndzv = is_rendezvous(bytes_count, compression, stream, max_eager_size)
@@ -392,7 +410,54 @@ def select_algorithm(
                     sub(Operation.bcast, count),
                 ),
             )
-        return eager_plan(Algorithm.EAGER_RING_RS_AG, world_align=world_size)
+        plan = eager_plan(Algorithm.EAGER_RING_RS_AG,
+                          world_align=world_size)
+        # Stripe-overlapped gradient allreduce (the OVERLAP_MIN_COUNT
+        # register): an exact eager allreduce inside the window runs as
+        # Plan.stripes independent stripe chains, so a fused program
+        # can overlap stripe i's wire with stripe i+1's compute (the
+        # consumer-spliced gradient-sync seam). XLA-schedule-tier in
+        # effect: only autotuned XLA/DCN devices ever move the
+        # register off 0, and the native runtime's selection never
+        # reads it — the same scoping as the hier register. The stripe
+        # count is timing.best_overlap_stripes' argmin under the
+        # calibrated shaped link and the measured compute term — no
+        # calibration means the serial plan, never a made-up depth.
+        if (tuning.overlap_min_count > 0
+                and compression == CompressionFlags.NO_COMPRESSION
+                and bytes_count >= tuning.overlap_min_count):
+            link, fit = overlap_link, overlap_compute
+            if link is None or fit is None:
+                from ..telemetry import feedback as _fb
+
+                if fit is None:
+                    fit = _fb.default_compute_fit()
+                if link is None:
+                    tl = _fb.default_tier_links()
+                    link = tl.outer if tl is not None \
+                        else _fb.default_link()
+            if link is not None and fit is not None:
+                from .timing import best_overlap_stripes
+
+                stripes = best_overlap_stripes(
+                    link, count, dtype_nbytes, world_size,
+                    compute_s=fit.seconds(bytes_count),
+                    rx_buf_bytes=eager_rx_buf_size)
+                if stripes > 1:
+                    seg = -(-count // stripes)
+                    seg += (-seg) % world_size
+                    # world-aligning the stripe segment can merge the
+                    # tail stripes (count=100, world=8, S=8 -> seg=16
+                    # -> 7 chains): the frozen stripe count must be
+                    # the chain count the lowering actually runs, or
+                    # the cost model and the serialized twin's barrier
+                    # accounting drift off the real program
+                    n_seg = _segments(count, seg)
+                    if n_seg > 1:
+                        return dataclasses.replace(
+                            plan, seg_count=seg, num_segments=n_seg,
+                            stripes=n_seg)
+        return plan
 
     if scenario == Operation.alltoall:
         # alltoallv: a per-peer capacity vector turns the dense rotation

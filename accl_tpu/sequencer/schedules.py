@@ -455,10 +455,18 @@ def _reduce_scatter_ring_quant(x, *, func, axis, world, wire, ring=None):
 
 
 def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int,
-                            ring=None):
+                            ring=None, serialize: bool = False):
     """Segmented ring allreduce (.c:1888-2071): per segment, a ring
     reduce-scatter over world-size chunks followed by a ring allgather.
-    Segments bound scratch footprint and pipeline across the loop."""
+    Segments bound scratch footprint and pipeline across the loop.
+
+    serialize=True threads an order-only dependency between the
+    segment chains (segment i+1's chain starts only after segment i's
+    output exists) — the serial dispatch->compute twin of a
+    stripe-overlapped plan, bitwise-identical to the unserialized form
+    (barriers change scheduling freedom, never values), kept reachable
+    for A/B measurement exactly like the pallas ring's
+    ACCL_PALLAS_RING_SERIALIZE baseline."""
     count = x.shape[-1]
 
     def one_segment(seg):
@@ -471,7 +479,7 @@ def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int,
                                            wire=wire, ring=ring)
         return gathered[: seg.shape[-1]]
 
-    return segmented_apply(one_segment, x, seg_count)
+    return segmented_apply(one_segment, x, seg_count, serialize=serialize)
 
 
 def segmented_apply(one_segment, x, seg_count, unroll_limit: int = 8,

@@ -188,7 +188,8 @@ class SequencePlan:
         return need
 
     def cache_key(self, axis_name: str, use_pallas_ring: bool,
-                  pallas_ring_overlap: bool) -> tuple:
+                  pallas_ring_overlap: bool,
+                  overlap_serialize: bool = False) -> tuple:
         # endpoint callables ride the key by identity, with strong refs
         # held (same id-reuse hazard as lower_streamed)
         eps = tuple((st.producer, st.consumer) for st in self.steps)
@@ -199,6 +200,7 @@ class SequencePlan:
             axis_name,
             use_pallas_ring,
             pallas_ring_overlap,
+            overlap_serialize,
         )
 
     # -- construction ------------------------------------------------------

@@ -74,6 +74,54 @@ class LinkParams:
 
 
 @dataclasses.dataclass(frozen=True)
+class ComputeFit:
+    """The measured busy-core term of the compute-communication overlap
+    pipeline: seconds the compute stage spliced next to a collective
+    needs to materialize `nbytes` of operand (the gradient bytes of a
+    train step's backward). `alpha` is the fixed per-step cost
+    (dispatch + bookkeeping of the compute stage), `rate` the sustained
+    operand bytes produced per second. Calibrated from telemetry spans
+    (telemetry.feedback.calibrate_compute_from_trace) the same way
+    LinkParams is calibrated from hop spans — the compute term is a
+    measured quantity, never an assumption. The fit is per workload
+    family (bytes-of-gradient is a proxy for the model's backward cost
+    at a fixed batch shape); re-calibrate when the workload changes."""
+
+    alpha: float
+    rate: float
+
+    def seconds(self, nbytes: float) -> float:
+        return self.alpha + nbytes / self.rate
+
+
+def _nonneg_lstsq2(rows: list, y_vals: list) -> tuple[float, float]:
+    """The shared two-parameter fit of the link and compute
+    calibrations: column-scaled least squares (well-conditioned across
+    the 1 KB-1 GB dynamic range) clamped non-negative (a degenerate
+    sweep clamps at zero rather than producing a negative cost)."""
+    import numpy as np
+
+    A = np.array(rows, float)
+    y = np.array(y_vals, float)
+    scale = A.max(axis=0)
+    scale[scale == 0] = 1.0
+    x, *_ = np.linalg.lstsq(A / scale, y, rcond=None)
+    x = np.maximum(x / scale, 0.0)
+    return float(x[0]), float(x[1])
+
+
+def calibrate_compute(samples: list[tuple[float, float]]) -> ComputeFit:
+    """Least-squares fit of (alpha, 1/rate) from samples of
+    (operand_bytes, measured_seconds) of the compute stage — the same
+    non-negative clamped solve `calibrate` uses for the link."""
+    alpha, inv_rate = _nonneg_lstsq2([[1.0, b] for b, _ in samples],
+                                     [t for _, t in samples])
+    if inv_rate <= 0:
+        inv_rate = 1e-12  # latency-flat samples: effectively infinite rate
+    return ComputeFit(alpha=alpha, rate=1.0 / inv_rate)
+
+
+@dataclasses.dataclass(frozen=True)
 class TierLinks:
     """Per-tier link parameters of a two-tier world: `inner` is the
     fast intra-slice link (ICI / local POE), `outer` the slow
@@ -211,6 +259,20 @@ def coefficients(
             return math.log2(P), (P - 1) * n
         return (P - 1) * _segs(n, _STREAM_SEG), (P - 1) * n
     if alg == Algorithm.EAGER_RING_RS_AG:
+        S = max(plan.stripes, 1)
+        if S > 1:
+            # stripe-overlapped plan, SERIAL shape: the S independent
+            # RS+AG chains run back to back (the dispatch->compute
+            # form), so messages multiply by S while total wire bytes
+            # stay 2n(P-1)/P. The pipelined (overlapped) form is
+            # predict_overlapped — this is deliberately the cost of
+            # NOT overlapping, so serial callers (the eager twin, the
+            # crossover scan's baseline) are charged honestly. Striped
+            # plans never take the logp shape: the stripes exist to
+            # pipeline the ring.
+            chunk = (n / S) / P
+            return S * 2 * (P - 1) * _segs(int(chunk), _STREAM_SEG), \
+                2 * (P - 1) * (n / P)
         chunk = n / P
         if _logp_forced(P, _logp_allreduce(P, n), logp_shape):
             # native recursive halving-doubling: 2*log2(P) exchange
@@ -337,6 +399,13 @@ def coefficients_aggregate(
         # gather daisy chain to root: rank at distance k relays k messages
         return P * (P - 1) / 2 * _segs(n, _STREAM_SEG), P * (P - 1) / 2 * n
     if alg == Algorithm.EAGER_RING_RS_AG:
+        S = max(plan.stripes, 1)
+        if S > 1:
+            # striped serial shape summed over all ranks (see the
+            # critical-path branch): S x the message count, same bytes
+            chunk = (n / S) / P
+            return S * 2 * P * (P - 1) * _segs(int(chunk), _STREAM_SEG), \
+                2 * (P - 1) * n
         chunk = n / P
         if _logp_forced(P, _logp_allreduce(P, n), logp_shape):
             return 2 * P * r, 2 * (P - 1) * n
@@ -501,6 +570,94 @@ def best_stripes(
     return best_s
 
 
+def predict_overlapped(
+    params: LinkParams,
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    world: int,
+    *,
+    compute_s: float,
+    rx_buf_bytes: int,
+    serial: bool = False,
+) -> float:
+    """Busy-link vs busy-core pipelined prediction for a
+    stripe-overlapped eager ring allreduce (Plan.stripes = S on
+    EAGER_RING_RS_AG) running next to the compute stage that produces
+    its operand — the PR 8 fill + drain + (S-1)*max(...) pipeline shape
+    generalized with a measured per-stripe compute term:
+
+        T_overlap = c + lam + (S - 1) * max(c, o)
+        T_serial  = compute_s + S * lam        (serial=True)
+
+    where c = compute_s / S is the per-stripe busy-CORE term (the
+    measured ComputeFit evaluation, split across stripes the way the
+    backward materializes gradient stripes), lam the full critical-path
+    latency of ONE stripe's RS+AG chain (every per-message fixed cost
+    included — this is the pipeline's fill and drain), and o the
+    per-stripe steady-state busy-LINK term: the stripe's wire bytes
+    plus ONE per-message fixed cost. In steady state the sequencer
+    injects one stripe at a time (one fixed cost each) while the
+    remaining 2(P-1)-1 hop latencies of that stripe pipeline behind
+    neighbouring stripes' compute and wire — alpha is dispatch +
+    header + matching work (see LinkParams), not link occupancy, so
+    independent chains amortize it; only the drain (the last stripe,
+    with nothing left to hide behind) pays the whole chain latency.
+
+    serial=True is the dispatch->compute form: all compute, then the S
+    stripe chains back to back — the cost of the bitwise-identical
+    serial twin (the same shape `coefficients` charges striped plans).
+    """
+    S = max(plan.stripes, 1)
+    stripe = -(-count // S)
+    sp = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, stripe, 1,
+              wire_dtype=plan.wire_dtype)
+    # logp_shape=False: a striped plan always lowers the ring chains
+    # (the stripes exist to pipeline them), so the per-stripe cost
+    # must never flip to the recursive halving-doubling shape the
+    # unstriped auto rule would pick at small stripe payloads —
+    # matching the striped branch of `coefficients` exactly
+    m, b = coefficients(Operation.allreduce, sp, stripe, elem_bytes,
+                        world, rx_buf_bytes=rx_buf_bytes,
+                        logp_shape=False)
+    lam = params.seconds(m, b)
+    if serial or S == 1:
+        return compute_s + S * lam
+    occ = params.seconds(min(m, 1.0), b)
+    c = compute_s / S
+    return c + lam + (S - 1) * max(c, occ)
+
+
+def best_overlap_stripes(
+    params: LinkParams,
+    count: int,
+    elem_bytes: int,
+    world: int,
+    *,
+    compute_s: float,
+    rx_buf_bytes: int,
+    candidates: tuple[int, ...] = (1, 2, 4, 8),
+) -> int:
+    """The cost model's stripe count for an overlapped gradient
+    allreduce: the S minimizing the pipelined prediction (ties break
+    toward fewer stripes — less padding, smaller program). Like
+    best_stripes for the hierarchical composition, this is the ONLY
+    source of an overlap plan's Plan.stripes, so S is a measured-model
+    decision, never a hardcoded constant."""
+    best_s, best_t = 1, float("inf")
+    for s in candidates:
+        if s > 1 and s * world > max(count, 1):
+            continue  # every stripe must hold at least one world chunk
+        plan = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, count, 1,
+                    stripes=s)
+        t = predict_overlapped(params, plan, count, elem_bytes, world,
+                               compute_s=compute_s,
+                               rx_buf_bytes=rx_buf_bytes)
+        if t < best_t - 1e-15:
+            best_s, best_t = s, t
+    return best_s
+
+
 def predict(
     params: LinkParams,
     op: Operation,
@@ -551,6 +708,7 @@ def predict_sequence(
     aggregate: bool = False,
     dispatch_alpha: float = 0.0,
     fused: bool = True,
+    compute_s: float = 0.0,
 ) -> float:
     """Expected seconds for a recorded sequence of calls.
 
@@ -564,11 +722,38 @@ def predict_sequence(
 
         gain = predict_sequence(..., fused=False) - predict_sequence(...)
              = (len(calls) - 1) * dispatch_alpha
-    """
-    m, b = sequence_coefficients(calls, world, rx_buf_bytes=rx_buf_bytes,
-                                 aggregate=aggregate)
+
+    `compute_s` is the measured busy-core term of a compute stage
+    recorded next to the collectives (a ComputeFit evaluation — the
+    train step's backward spliced as a stream endpoint). A FUSED
+    sequence containing a stripe-overlapped allreduce (Plan.stripes >
+    1 on EAGER_RING_RS_AG) overlaps that compute with the wire through
+    the busy-link vs busy-core pipeline (predict_overlapped); every
+    other form — serial dispatch->compute, or no striped plan — pays
+    compute + wire back to back (`coefficients` already charges a
+    striped plan's serial chains S x their messages)."""
+    olap = 0.0
+    overlapped = False
+    rest = []
+    for call in calls:
+        op, plan, count, elem_bytes = call
+        if (fused and not aggregate and not overlapped and compute_s > 0
+                and op == Operation.allreduce
+                and plan.algorithm == Algorithm.EAGER_RING_RS_AG
+                and plan.stripes > 1):
+            olap = predict_overlapped(
+                params, plan, count, elem_bytes, world,
+                compute_s=compute_s, rx_buf_bytes=rx_buf_bytes)
+            overlapped = True
+            continue
+        rest.append(call)
+    tm, tb = sequence_coefficients(rest, world, rx_buf_bytes=rx_buf_bytes,
+                                   aggregate=aggregate)
     n_dispatch = 1 if fused else max(len(calls), 1)
-    return params.seconds(m, b) + dispatch_alpha * n_dispatch
+    t = params.seconds(tm, tb) + dispatch_alpha * n_dispatch + olap
+    if not overlapped:
+        t += compute_s
+    return t
 
 
 def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
@@ -576,17 +761,8 @@ def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
     (messages, bytes, measured_seconds): t ~= alpha*m + bytes*inv_beta.
     Non-negative solution (a degenerate sweep clamps at zero rather than
     producing a negative latency)."""
-    import numpy as np
-
-    A = np.array([[m, b] for m, b, _ in samples], float)
-    y = np.array([t for _, _, t in samples], float)
-    # scale columns so the solve is well-conditioned across the 1 KB-1 GB
-    # dynamic range
-    scale = A.max(axis=0)
-    scale[scale == 0] = 1.0
-    x, *_ = np.linalg.lstsq(A / scale, y, rcond=None)
-    x = np.maximum(x / scale, 0.0)
-    alpha, inv_beta = float(x[0]), float(x[1])
+    alpha, inv_beta = _nonneg_lstsq2([[m, b] for m, b, _ in samples],
+                                     [t for _, _, t in samples])
     if inv_beta <= 0:
         inv_beta = 1e-12  # pure-latency sweep: effectively infinite beta
     if alpha <= 0:
@@ -599,7 +775,8 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                       rx_buf_bytes: int = 4096,
                       wire_dtype: DataType = DataType.none,
                       tier_links: "TierLinks | None" = None,
-                      topology: tuple[int, int] | None = None) -> dict:
+                      topology: tuple[int, int] | None = None,
+                      compute_fit: "ComputeFit | None" = None) -> dict:
     """The model's own switch-over points for the five tuning registers
     (reference defaults accl.cpp:1198-1208: gather fan-in capped above
     32 KB, bcast flat <= 3 ranks, reduce flat <= 4 ranks or <= 32 KB).
@@ -819,9 +996,52 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                     hier_min = 0  # loss above a win: suffix restarts
                 nb *= 2
 
+    # Compute-communication overlap crossover: with a measured compute
+    # term (ComputeFit, calibrated from telemetry spans of the workload's
+    # compute stage), the START of the CONTIGUOUS winning SUFFIX — the
+    # smallest streamed-allreduce payload such that the stripe-overlapped
+    # schedule (best S per size, the argmin) predicts faster than the
+    # serial dispatch->compute form at the SAME stripe count — the
+    # bitwise-identical twin, compute then S chains back to back — by
+    # more than `overlap_min_gain` of the serial time, at that size and
+    # every LARGER swept size. Scanned under the SHAPED link when a
+    # per-tier calibration exists (tier_links.outer — the slow-wire
+    # regime the overlap claim lives in, the same link stripe selection
+    # uses) else this link. A MIN register like the hier one; 0 = no
+    # compute calibration or overlap never clears the bar, the register
+    # stays off and selection is bit-for-bit the serial form.
+    overlap_min = 0
+    overlap_min_gain = 0.05
+    if compute_fit is not None:
+        olink = tier_links.outer if tier_links is not None else params
+        nb = 1 << 10
+        while nb <= (1 << 24):
+            cnt = max(nb // elem_bytes, 1)
+            comp_s = compute_fit.seconds(nb)
+            s_best = best_overlap_stripes(
+                olink, cnt, elem_bytes, P, compute_s=comp_s,
+                rx_buf_bytes=rx_buf_bytes)
+            oplan = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG,
+                         cnt, 1, stripes=s_best)
+            t_on = predict_overlapped(olink, oplan, cnt, elem_bytes, P,
+                                      compute_s=comp_s,
+                                      rx_buf_bytes=rx_buf_bytes)
+            t_serial = predict_overlapped(olink, oplan, cnt, elem_bytes,
+                                          P, compute_s=comp_s,
+                                          rx_buf_bytes=rx_buf_bytes,
+                                          serial=True)
+            if (s_best > 1 and t_on < t_serial
+                    and (t_serial - t_on) > overlap_min_gain * t_serial):
+                if overlap_min == 0:
+                    overlap_min = nb  # candidate start of the suffix
+            else:
+                overlap_min = 0  # loss above a win: suffix restarts
+            nb *= 2
+
     return {
         "alltoall_compress_min_bytes": a2a_min,
         "hier_allreduce_min_bytes": hier_min,
+        "overlap_min_bytes": overlap_min,
         "bcast_flat_tree_max_ranks": bcast_max,
         "reduce_flat_tree_max_count_bytes": reduce_cross,
         "gather_flat_tree_max_count_bytes": gather_cross,

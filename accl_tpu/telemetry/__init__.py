@@ -45,8 +45,10 @@ from .export import (  # noqa: F401
 )
 from .feedback import (  # noqa: F401
     autotune_from_trace,
+    calibrate_compute_from_trace,
     calibrate_from_trace,
     calibrate_tiers_from_trace,
+    default_compute_fit,
     default_link,
     default_tier_links,
     residual_improvement,

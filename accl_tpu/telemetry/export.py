@@ -47,8 +47,13 @@ EVENT_SCHEMA: dict = {
                     "name": {"type": "string"},
                     "cat": {
                         "type": "string",
+                        # "compute": a timed compute stage next to the
+                        # collectives (args.compute_bytes carries the
+                        # operand bytes it materializes) — the
+                        # ComputeFit calibration samples of the
+                        # overlap pipeline (feedback.compute_samples)
                         "enum": ["call", "step", "phase", "sequence",
-                                 "native"],
+                                 "native", "compute"],
                     },
                     "track": {"type": "string"},
                     "ts_ns": {"type": "integer", "minimum": 0},
@@ -75,6 +80,7 @@ EVENT_SCHEMA: dict = {
                             "d_parks": {"type": "integer"},
                             "d_seek_hit": {"type": "integer"},
                             "d_seek_miss": {"type": "integer"},
+                            "compute_bytes": {"type": "integer"},
                         },
                         "additionalProperties": True,
                     },

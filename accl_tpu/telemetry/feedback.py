@@ -27,7 +27,13 @@ from __future__ import annotations
 import json
 import pathlib
 
-from ..sequencer.timing import LinkParams, TierLinks, calibrate
+from ..sequencer.timing import (
+    ComputeFit,
+    LinkParams,
+    TierLinks,
+    calibrate,
+    calibrate_compute,
+)
 from .export import measured_seconds, median, residual_rows, residual_summary
 
 _MODEL_PATH = (pathlib.Path(__file__).resolve().parents[2]
@@ -155,6 +161,64 @@ def default_tier_links(path=None) -> TierLinks | None:
         links = None
     _default_link_cache[key] = links
     return links
+
+
+def compute_samples(trace: dict) -> list[tuple[float, float]]:
+    """(operand_bytes, measured_seconds) samples from every span that
+    carries a `compute_bytes` arg and a positive measurement — the
+    busy-core term of the overlap pipeline (timing.ComputeFit), fitted
+    from spans exactly like the link is fitted from hop spans. The
+    overlap gate emits these by timing the train step's compute stage
+    at two model sizes and tagging each span with the gradient bytes
+    that stage materializes."""
+    samples = []
+    for sp in trace.get("spans", []):
+        args = sp.get("args", {})
+        if "compute_bytes" not in args:
+            continue
+        b = float(args["compute_bytes"])
+        t = measured_seconds(sp)
+        if b <= 0 or t <= 0:
+            continue
+        samples.append((b, t))
+    return samples
+
+
+def calibrate_compute_from_trace(trace: dict) -> ComputeFit:
+    """Refit the overlap pipeline's compute term from a trace's
+    compute-tagged spans. Raises ValueError below two samples (a
+    one-point fit cannot separate the fixed cost from the rate)."""
+    samples = compute_samples(trace)
+    if len(samples) < 2:
+        raise ValueError(
+            f"trace has {len(samples)} compute span(s); need >= 2 "
+            "(spans with args.compute_bytes at distinct sizes — the "
+            "overlap gate's compute-calibration sweep emits them)")
+    return calibrate_compute(samples)
+
+
+def default_compute_fit(path=None) -> ComputeFit | None:
+    """The shipped compute-term calibration: the timing model
+    document's `compute_fit` section ({alpha_us, grad_gbps}, written
+    by bench.py --overlap-gate's refit). None when no fit is committed
+    — callers (autotune, overlap stripe selection) must then leave the
+    overlap register off rather than invent a compute model. Positive
+    results are cached per path (this sits on the per-call plan
+    selection path); misses are NOT, so a fit written later in the
+    same process is picked up."""
+    p = pathlib.Path(path) if path else _MODEL_PATH
+    key = (p, "compute")
+    if key in _default_link_cache:
+        return _default_link_cache[key]
+    try:
+        model = json.loads(p.read_text())
+        cf = model["compute_fit"]
+        fit: ComputeFit | None = ComputeFit(
+            alpha=cf["alpha_us"] * 1e-6, rate=cf["grad_gbps"] * 1e9)
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        return None
+    _default_link_cache[key] = fit
+    return fit
 
 
 def _rel_errs(trace: dict, link: LinkParams) -> list[float]:

@@ -812,6 +812,323 @@ def bench_moe_dispatch(jax, world, payload_bytes=8 * 1024, rounds=40):
                 sec=sec)
 
 
+def _overlap_cfg(jax, scale: float = 1.0):
+    """The overlap-gate transformer: parameters dominated by the
+    embed/unembed pair (a ~1.5 MB gradient) while the token count
+    stays tiny (so the per-rank fwd+bwd is single-digit ms on the CPU
+    mesh). Sized for the regime where the overlap claim is ROBUST
+    across host speeds: per-stripe wire bytes well under the shaped
+    link's 2(P-1) hop alphas, so the serial form is paced by S chains
+    of serialized hop LATENCY — exactly what the overlapped pipeline
+    amortizes — rather than by bytes (which compute-vs-rate host
+    variance would squeeze toward the 2x cap). `scale` shrinks the
+    vocab for the compute-calibration sweep's second size (a
+    ComputeFit needs two distinct gradient sizes)."""
+    from accl_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(vocab=int(2560 * scale), d_model=64,
+                             n_heads=4, n_layers=2, d_ff=128)
+
+
+def _overlap_harness(jax, world, cfg, tokens, targets, *, serial,
+                     overlap_reg, lr=1e-3):
+    """One side of the overlap A/B: an ACCL over `world` CPU-mesh
+    devices with the train-step consumer registered and the
+    OVERLAP_MIN_COUNT register set to `overlap_reg`. serial=True
+    builds the serial dispatch->compute twin — the compiler's
+    overlap_serialize flag orders the stripe chains, and `step()`
+    issues the SAME three descriptors eagerly (compute program, then
+    allreduce, then update: three dispatches). serial=False compiles
+    the ONE-dispatch fused program whose striped allreduce overlaps
+    the backward. Both sides run the identical register-selected plan,
+    so their results are bitwise-identical at fp32."""
+    from jax.sharding import Mesh
+
+    from accl_tpu.accl import ACCL
+    from accl_tpu.constants import TuningParams
+    from accl_tpu.models import transformer as trf
+
+    saved = os.environ.get("ACCL_OVERLAP_SERIALIZE")
+    os.environ["ACCL_OVERLAP_SERIALIZE"] = "1" if serial else "0"
+    try:
+        mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+        accl = ACCL(mesh)
+    finally:
+        if saved is None:
+            os.environ.pop("ACCL_OVERLAP_SERIALIZE", None)
+        else:
+            os.environ["ACCL_OVERLAP_SERIALIZE"] = saved
+    # the defaults PLUS the one register (a bare TuningParams(...)
+    # would zero every other selection register on this device)
+    tp = TuningParams.default()
+    tp.overlap_min_count = int(overlap_reg)
+    accl.configure_tuning_parameters(tp)
+    bufs = trf.create_train_step_buffers(accl, cfg)
+    n = trf.train_param_count(cfg)
+    init = np.tile(
+        np.asarray(trf.flatten_train_params(
+            trf.init_params(cfg, jax.random.key(3)))), (world, 1))
+    bufs[0].write(init)
+    bufs[0].sync_to_device()
+    if serial:
+        trf._register_train_consumers(accl, cfg, tokens, targets, lr)
+
+        def step():
+            trf.run_train_step_eager(accl, cfg, bufs)
+            return bufs[3].device
+
+        prog = None
+    else:
+        prog, _ = trf.make_train_step_program(accl, cfg, tokens,
+                                              targets, lr=lr,
+                                              buffers=bufs)
+
+        def step():
+            prog.run(from_device=True, to_device=True)
+            return bufs[3].device
+
+    return dict(accl=accl, bufs=bufs, step=step, prog=prog, n=n)
+
+
+def _overlap_compute_calibration(jax, world, sizes=(0.5, 1.0), iters=3):
+    """The compute-term sweep: time the train step's fwd+bwd program
+    (the eager compute stage alone — copy with the grad consumer
+    spliced) at two model sizes, emit one compute-tagged span per
+    measurement, and refit timing.ComputeFit from the trace
+    (telemetry.feedback.calibrate_compute_from_trace) — the busy-core
+    term of the overlap pipeline, measured, never assumed. Returns
+    (fit, trace)."""
+    from accl_tpu.models import transformer as trf
+    from accl_tpu.telemetry import (calibrate_compute_from_trace,
+                                    get_tracer, validate_trace)
+
+    tr = get_tracer()
+    tr.enable()
+    rng = np.random.default_rng(23)
+    for scale in sizes:
+        cfg = _overlap_cfg(jax, scale)
+        tokens = rng.integers(0, cfg.vocab, (world, 1, 8)) \
+            .astype(np.int32)
+        targets = np.roll(tokens, -1, axis=2)
+        h = _overlap_harness(jax, world, cfg, tokens, targets,
+                             serial=True, overlap_reg=0)
+        nbytes = h["n"] * 4
+        pbuf, gbuf = h["bufs"][0], h["bufs"][1]
+
+        # time ONLY the compute stage: the copy+consumer dispatch
+        def compute_stage():
+            h["accl"].copy_to_stream(
+                pbuf, h["n"], res_stream=trf.TRAIN_GRAD_STREAM,
+                dstbuf=gbuf, from_device=True, to_device=True)
+
+        compute_stage()  # compile + warm
+        for _ in range(iters):
+            with tr.span("train_bwd", cat="compute",
+                         track="bench") as sp:
+                compute_stage()
+                sp.set(compute_bytes=nbytes)
+    trace = tr.to_trace({"world": world, "cost_shape": "aggregate"})
+    validate_trace(trace)
+    fit = calibrate_compute_from_trace(trace)
+    # tracing stays OFF for the measured A/B that follows: the serial
+    # side dispatches three traced programs per step vs the fused
+    # side's one, so leaving the tracer armed would pad the serial
+    # medians asymmetrically
+    tr.disable()
+    return fit, trace
+
+
+def _overlap_gate_main():
+    """bench.py --overlap-gate: compute-communication overlap as a
+    MEASURED plan dimension, on the first full-model train-step
+    workload in the repo (transformer fwd+bwd+grad-allreduce+SGD as
+    ONE recorded descriptor batch). Four legs, the hier/moe gate
+    discipline:
+
+      1. CALIBRATE: time the fwd+bwd program at two model sizes, refit
+         the ComputeFit compute term from the emitted telemetry spans,
+         and persist it into accl_log/timing_model.json
+         ("compute_fit") — the calibration ACCL.autotune and
+         bench --check's train cells read back.
+      2. REGISTER: derive OVERLAP_MIN_COUNT from
+         timing.tuning_crossovers under the SHIPPED calibrated shaped
+         link (link_tiers.outer — the hier gate's WAN-class wire) and
+         this run's compute fit; FAIL unless the window opens and
+         covers the workload's gradient. The stripe count is the cost
+         model's argmin (asserted), never hardcoded.
+      3. MEASURED (8-dev mesh, interleaved medians): the ONE-dispatch
+         fused-overlapped train step vs the serial dispatch->compute
+         form a register-0 caller actually runs — the eager
+         three-dispatch chain whose allreduce is the rx-geometry
+         segmented ring (the same flat-segmented posture the hier
+         gate's twin measures; the register replaces that
+         segmentation with cost-model stripes). Gate >= 2x. The
+         EQUAL-PLAN eager twin (same striped plan, three dispatches)
+         is asserted BITWISE-identical to the fused program and its
+         measured parity is reported unvarnished, not gated: the
+         memcpy-wire mesh has no wire time for overlap to hide, so at
+         equal plan the one-program form only re-arranges host-side
+         thunk scheduling (the moe gate's parity posture).
+      4. PREDICTED (shaped link): fused-overlapped vs serial
+         dispatch->compute AT THE SAME STRIPES through
+         timing.predict_sequence's busy-link/busy-core pipeline,
+         >= 2x — the wire the memcpy mesh doesn't have, claimed
+         through the same link model every selection register rides
+         (the quant/hier/moe posture).
+
+    stdout: ONE JSON line."""
+    import jax
+
+    from accl_tpu.constants import DEFAULT_EAGER_RX_BUF_SIZE, Operation
+    from accl_tpu.models import transformer as trf
+    from accl_tpu.sequencer.timing import (
+        best_overlap_stripes,
+        predict_sequence,
+        tuning_crossovers,
+    )
+    from accl_tpu.telemetry.feedback import default_tier_links
+
+    world = min(len(jax.devices()), 8)
+    cfg = _overlap_cfg(jax)
+    rng = np.random.default_rng(17)
+    tokens = rng.integers(0, cfg.vocab, (world, 1, 8)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=2)
+
+    tiers = default_tier_links()
+    if tiers is None:
+        raise SystemExit(
+            "FAIL: timing model carries no link_tiers — run "
+            "bench.py --hier-gate first (the overlap claim is made "
+            "under the calibrated shaped link)")
+    link = _shipped_link()
+
+    # 1. calibrate the compute term from telemetry spans and persist it
+    fit, _trace = _overlap_compute_calibration(jax, world)
+    print(f"  compute fit: alpha {fit.alpha * 1e3:.1f} ms + "
+          f"{fit.rate / 1e6:.1f} MB/s of gradient", file=sys.stderr)
+    outdir = pathlib.Path(__file__).parent / "accl_log"
+    outdir.mkdir(exist_ok=True)
+    model_path = outdir / "timing_model.json"
+    model = json.loads(model_path.read_text()) if model_path.exists() \
+        else {}
+    model["compute_fit"] = {
+        "source": f"bench.py --overlap-gate (w{world} CPU mesh, "
+                  "transformer fwd+bwd at two model sizes)",
+        "alpha_us": fit.alpha * 1e6,
+        "grad_gbps": fit.rate / 1e9,
+    }
+    model_path.write_text(json.dumps(model, indent=1, sort_keys=True)
+                          + "\n")
+
+    # 2. the register from the measured crossover, under the shaped link
+    cross = tuning_crossovers(link, world=world, tier_links=tiers,
+                              compute_fit=fit)
+    reg = int(cross["overlap_min_bytes"])
+    n = trf.train_param_count(cfg)
+    grad_bytes = n * 4
+    print(f"  overlap crossover window: >= {reg} B "
+          f"(gradient {grad_bytes} B)", file=sys.stderr)
+    if not 0 < reg <= grad_bytes:
+        raise SystemExit(
+            f"FAIL: the calibrated overlap window ({reg} B) does not "
+            f"cover the {grad_bytes} B train-step gradient; re-run "
+            "bench.py --hier-gate / tools/timing_model.py if the link "
+            "legitimately moved")
+
+    overlap = _overlap_harness(jax, world, cfg, tokens, targets,
+                               serial=False, overlap_reg=reg)
+    twin = _overlap_harness(jax, world, cfg, tokens, targets,
+                            serial=True, overlap_reg=reg)
+    serial0 = _overlap_harness(jax, world, cfg, tokens, targets,
+                               serial=True, overlap_reg=0)
+    plans = overlap["prog"].plans
+    ar_plan = plans[1]
+    S = ar_plan.stripes
+    olink = tiers.outer
+    want_s = best_overlap_stripes(
+        olink, n, 4, world, compute_s=fit.seconds(grad_bytes),
+        rx_buf_bytes=DEFAULT_EAGER_RX_BUF_SIZE)
+    assert S == want_s and S > 1, \
+        f"stripe count {S} is not the cost model's argmin {want_s}"
+    print(f"  register-selected plan: {ar_plan.algorithm.name} "
+          f"S={S} (cost-model argmin)", file=sys.stderr)
+
+    # 3. measured, bitwise first (equal-plan twin), then interleave one
+    # step per side per round and take medians
+    out_o = np.asarray(overlap["step"]())
+    out_t = np.asarray(twin["step"]())
+    np.testing.assert_array_equal(
+        out_o, out_t,
+        err_msg="overlapped fused != serial eager at fp32")
+    np.asarray(serial0["step"]())  # warm the register-0 serial form
+    rounds = 4
+    t_o, t_t, t_s0 = [], [], []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        jax.block_until_ready(overlap["step"]())
+        t_o.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(serial0["step"]())
+        t_s0.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(twin["step"]())
+        t_t.append(time.perf_counter() - t0)
+    sec_o = float(np.median(t_o))
+    sec_s0 = float(np.median(t_s0))
+    sec_t = float(np.median(t_t))
+    measured_x = sec_s0 / sec_o
+    parity_x = sec_t / sec_o
+
+    # 4. predicted under the shaped link: the same three descriptors,
+    # fused+pipelined vs serial dispatch->compute (striped chains back
+    # to back + a dispatch per stage)
+    compute_s = fit.seconds(grad_bytes)
+    calls = [(Operation.copy, plans[0], n, 4),
+             (Operation.allreduce, ar_plan, n, 4),
+             (Operation.combine, plans[2], n, 4)]
+    pkw = dict(rx_buf_bytes=DEFAULT_EAGER_RX_BUF_SIZE,
+               dispatch_alpha=olink.alpha, compute_s=compute_s)
+    pred_olap = predict_sequence(olink, calls, world, fused=True, **pkw)
+    pred_serial = predict_sequence(olink, calls, world, fused=False,
+                                   **pkw)
+    pred_x = pred_serial / max(pred_olap, 1e-12)
+    print(f"  overlap train step w{world}: fused {sec_o * 1e3:.1f} ms "
+          f"vs register-0 serial {sec_s0 * 1e3:.0f} ms "
+          f"({measured_x:.1f}x measured) vs equal-plan eager "
+          f"{sec_t * 1e3:.1f} ms ({parity_x:.2f}x, memcpy-wire mesh); "
+          f"shaped-link predicted {pred_serial * 1e3:.0f} -> "
+          f"{pred_olap * 1e3:.0f} ms ({pred_x:.2f}x)", file=sys.stderr)
+    print(json.dumps({
+        "metric": "train_step overlap: fused stripe-overlapped vs "
+                  f"serial dispatch->compute (w{world} CPU mesh)",
+        "value": round(measured_x, 2),
+        "unit": "x",
+        "platform": "cpu-fallback",
+        "stripes": S,
+        "overlap_min_bytes": reg,
+        "grad_bytes": grad_bytes,
+        "predicted_x_shaped_link": round(pred_x, 2),
+        "measured_equal_plan_x": round(parity_x, 3),
+        "compute_fit": model["compute_fit"],
+        "fused_s": sec_o,
+        "serial_register0_s": sec_s0,
+        "serial_equal_plan_s": sec_t,
+    }))
+    fails = []
+    if measured_x < 2.0:
+        fails.append(
+            f"fused-overlapped measured {measured_x:.2f}x < 2x the "
+            "serial dispatch->compute form (register 0)")
+    if pred_x < 2.0:
+        fails.append(
+            f"shaped-link prediction {pred_x:.2f}x < 2x serial at "
+            "equal stripes")
+    for f in fails:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if fails:
+        sys.exit(1)
+
+
 def _moe_gate_main():
     """bench.py --moe-gate: the fused expert-parallel dispatch gate
     (ROADMAP item 4). FAILs unless (a) the fused+quantized
@@ -1735,6 +2052,62 @@ def _check_sections(jax):
         prepared.append((f"{name}/w{world}/{moe_nb}", mfn, None, label,
                          0.0, 0.0, 40, False))
 
+    # the train-step overlap cells (ROADMAP item 4): the fused
+    # stripe-overlapped transformer train step (ONE dispatch, stripe
+    # count from the COMMITTED compute_fit + shaped-link crossover —
+    # the same calibration ACCL.autotune reads) vs the serial
+    # dispatch->compute form a register-0 caller actually runs (three
+    # eager dispatches whose allreduce is the rx-geometry segmented
+    # ring — the hier twin's flat-segmented posture). Steady-state
+    # convention (inputs resident, results left on device);
+    # refit=False: model compute + sequence dispatch sit outside the
+    # alpha-beta wire model's domain. The serial cell costs seconds
+    # per dispatch BY DESIGN (that pathology is the overlap cell's
+    # whole point), so its rounds are bounded like the hier twin's.
+    from accl_tpu.models.transformer import train_param_count
+    from accl_tpu.telemetry.feedback import default_compute_fit
+
+    cfit = default_compute_fit()
+    if cfit is None:
+        raise SystemExit(
+            "FAIL: timing model carries no compute_fit — run "
+            "bench.py --overlap-gate to calibrate the train-step "
+            "compute term")
+    ocfg = _overlap_cfg(jax)
+    ograd = train_param_count(ocfg) * 4
+    olap_reg = int(tuning_crossovers(
+        link, world=world, tier_links=tiers,
+        compute_fit=cfit)["overlap_min_bytes"])
+    if not 0 < olap_reg <= ograd:
+        raise SystemExit(
+            f"FAIL: train_step_overlap cell unavailable: the "
+            f"calibrated overlap window ({olap_reg} B) does not cover "
+            f"the {ograd} B gradient; re-run bench.py --overlap-gate "
+            "(and --write-baseline if the window legitimately moved)")
+    orng = np.random.default_rng(17)
+    otok = orng.integers(0, ocfg.vocab, (world, 1, 8)).astype(np.int32)
+    otgt = np.roll(otok, -1, axis=2)
+    o_fused = _overlap_harness(jax, world, ocfg, otok, otgt,
+                               serial=False, overlap_reg=olap_reg)
+    o_serial = _overlap_harness(jax, world, ocfg, otok, otgt,
+                                serial=True, overlap_reg=0)
+    o_stripes = o_fused["prog"].plans[1].stripes
+    if o_stripes <= 1:
+        raise SystemExit(
+            "FAIL: train_step_overlap cell selected a serial plan "
+            f"(stripes={o_stripes}) inside the register window")
+    train_cells = [
+        ("train_step_overlap", f"TRAIN_OVERLAP_RS_AG_S{o_stripes}",
+         o_fused["step"], 6, 2),
+        ("train_step_serial", "TRAIN_SERIAL_SEGMENTED",
+         o_serial["step"], 3, 1),
+    ]
+    for name, label, tfn, rounds_, warm_ in train_cells:
+        for _ in range(warm_):
+            jax.block_until_ready(tfn())
+        prepared.append((f"{name}/w{world}/{ograd}", tfn, None, label,
+                         0.0, 0.0, rounds_, False))
+
     samples = {sid: [] for sid, *_ in prepared}
     for r in range(max(p[6] for p in prepared)):
         for sid, fn, x, _label, _m, _b, rounds, _refit in prepared:
@@ -1765,6 +2138,11 @@ def _check_sections(jax):
         "fast": f"moe_dispatch_fused_int8/w{world}/{moe_nb}",
         "slow": f"moe_dispatch_eager_int8/w{world}/{moe_nb}",
         "min_ratio": 1.0})
+    gates.append({
+        "name": f"train_step_overlap_beats_serial_w{world}_{ograd}B",
+        "fast": f"train_step_overlap/w{world}/{ograd}",
+        "slow": f"train_step_serial/w{world}/{ograd}",
+        "min_ratio": 10.0})
     return rows, world, synth_cells, gates
 
 
@@ -2233,6 +2611,8 @@ if __name__ == "__main__":
         _quant_gate_main()
     elif "--moe-gate" in sys.argv:
         _moe_gate_main()
+    elif "--overlap-gate" in sys.argv:
+        _overlap_gate_main()
     elif "--trace" in sys.argv:
         _trace_main()
     elif "--hier-gate" in sys.argv:

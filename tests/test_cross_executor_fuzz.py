@@ -772,3 +772,153 @@ def test_hier_fused_vs_eager_bitwise(seed):
     np.testing.assert_array_equal(
         eager_out.host, np.tile(init.sum(0), (world, 1)),
         err_msg=f"hier seed {seed} ({inner}x{outer}): vs oracle")
+
+
+# ---------------------------------------------------------------------------
+# Stripe-overlapped train-step fuzz (ROADMAP item 4): the fused
+# overlapped descriptor batch through the FULL facade path —
+# register-gated striping, consumer splicing, one dispatch — must stay
+# bitwise-identical to the serial dispatch->compute form (the SAME
+# descriptors issued eagerly, stripe chains serialized) at fp32.
+# ---------------------------------------------------------------------------
+
+OVERLAP_SEQ_SEEDS = 30
+
+
+def _overlap_cal_patch(monkeypatch):
+    """Pin the overlap calibration the facade's selection loads, so the
+    fuzz is deterministic on checkouts regardless of the committed
+    timing model's values."""
+    from accl_tpu.sequencer.timing import ComputeFit, LinkParams, TierLinks
+    from accl_tpu.telemetry import feedback
+
+    tiers = TierLinks(inner=LinkParams(2e-6, 2e9),
+                      outer=LinkParams(600e-6, 0.3e9))
+    monkeypatch.setattr(feedback, "default_tier_links",
+                        lambda path=None: tiers)
+    monkeypatch.setattr(feedback, "default_compute_fit",
+                        lambda path=None: ComputeFit(2e-3, 0.3e9))
+
+
+@pytest.mark.parametrize("seed", range(OVERLAP_SEQ_SEEDS))
+def test_overlap_fused_vs_serial_eager_bitwise(seed, monkeypatch):
+    """Per seed: a compute->striped-allreduce->update batch (the train
+    step's shape, with a seed-varied elementwise stage as the spliced
+    compute) recorded and dispatched FUSED with the overlap register
+    open, against the serial dispatch->compute twin: the same three
+    descriptors eagerly on a serialized-lowering device. Bitwise at
+    fp32, and the stripe count must have come from the register path
+    (the cost model's argmin), never a hand-built plan."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from accl_tpu.accl import ACCL
+    from accl_tpu.sequencer.plan import Algorithm
+
+    _overlap_cal_patch(monkeypatch)
+    rng = np.random.default_rng(91000 + seed)
+    world = 8
+    n = int(rng.integers(world * 8, 40_000))
+    a = np.float32(rng.uniform(0.5, 2.0))
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+
+    def consumer(x):
+        # seed-varied compute stage ending in a select (not a bare
+        # multiply: a mul feeding the downstream ring adds would
+        # invite context-dependent FMA contraction, which is a
+        # numerics property of the compute, not of the seam under
+        # test)
+        t = x * a + jnp.float32(0.25)
+        return jnp.where(t > 0, t, x)
+
+    init = rng.integers(-50, 50, (world, n)).astype(np.float32)
+
+    def build(serialize):
+        monkeypatch.setenv("ACCL_OVERLAP_SERIALIZE",
+                           "1" if serialize else "0")
+        accl = ACCL(mesh)
+        tp = TuningParams.default()
+        tp.overlap_min_count = 1
+        accl.configure_tuning_parameters(tp)
+        accl.register_stream_consumer(31, consumer)
+        bufs = tuple(accl.create_buffer(n, np.float32)
+                     for _ in range(4))
+        bufs[0].write(init)
+        bufs[0].sync_to_device()
+        return accl, bufs
+
+    accl_f, bf = build(False)
+    seq = accl_f.sequence()
+    seq.copy(bf[0], bf[1], n, res_stream=31)
+    seq.allreduce(bf[1], bf[2], n, ReduceFunction.SUM)
+    seq.combine(n, ReduceFunction.SUM, bf[0], bf[2], bf[3])
+    prog = seq.compile()
+    ar_plan = prog.plans[1]
+    assert ar_plan.algorithm == Algorithm.EAGER_RING_RS_AG
+    assert ar_plan.stripes > 1, \
+        f"seed {seed}: register window did not stripe ({ar_plan})"
+    prog.run(from_device=True, to_device=True)
+
+    accl_e, be = build(True)
+    accl_e.copy_to_stream(be[0], n, res_stream=31, dstbuf=be[1],
+                          from_device=True, to_device=True)
+    accl_e.allreduce(be[1], be[2], n, ReduceFunction.SUM,
+                     from_device=True, to_device=True)
+    accl_e.combine(n, ReduceFunction.SUM, be[0], be[2], be[3],
+                   from_device=True, to_device=True)
+    np.testing.assert_array_equal(
+        np.asarray(bf[3].device), np.asarray(be[3].device),
+        err_msg=f"overlap seed {seed}: fused != serial eager")
+    # and against the numpy oracle through the same consumer math
+    g = np.asarray(jax.jit(consumer)(init))
+    want = init + np.tile(g.sum(0), (world, 1))
+    np.testing.assert_allclose(np.asarray(bf[3].device), want,
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_overlap_train_step_fused_vs_serial_eager_bitwise(monkeypatch):
+    """The REAL train-step workload once (the 30-seed sweep above
+    covers shapes; the transformer compile is too heavy to repeat):
+    models.transformer's fused stripe-overlapped program vs its serial
+    dispatch->compute twin, bitwise at fp32, with the stripe count
+    register-selected."""
+    from accl_tpu.accl import ACCL
+    from accl_tpu.models import transformer as trf
+
+    _overlap_cal_patch(monkeypatch)
+    world = 8
+    cfg = trf.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, cfg.vocab, (world, 1, 8)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=2)
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    init = np.tile(np.asarray(trf.flatten_train_params(
+        trf.init_params(cfg, jax.random.key(1)))), (world, 1))
+
+    def build(serialize):
+        monkeypatch.setenv("ACCL_OVERLAP_SERIALIZE",
+                           "1" if serialize else "0")
+        accl = ACCL(mesh)
+        tp = TuningParams.default()
+        tp.overlap_min_count = 1
+        accl.configure_tuning_parameters(tp)
+        bufs = trf.create_train_step_buffers(accl, cfg)
+        bufs[0].write(init)
+        bufs[0].sync_to_device()
+        return accl, bufs
+
+    accl_f, bf = build(False)
+    prog, _ = trf.make_train_step_program(accl_f, cfg, tokens, targets,
+                                          lr=1e-2, buffers=bf)
+    assert prog.plans[1].stripes > 1
+    prog.run(from_device=True, to_device=True)
+
+    accl_e, be = build(True)
+    trf._register_train_consumers(accl_e, cfg, tokens, targets, 1e-2)
+    trf.run_train_step_eager(accl_e, cfg, be)
+    np.testing.assert_array_equal(
+        np.asarray(bf[3].device), np.asarray(be[3].device),
+        err_msg="train step: fused-overlapped != serial-eager")
+    # the step actually moved the parameters
+    assert not np.array_equal(np.asarray(bf[3].device), init)

@@ -388,3 +388,137 @@ def test_alltoall_compress_register_gates_on_hop_payload_for_v():
                          peer_counts=(1024,) * (world - 1) + (4096,))
     assert dev._apply_alltoall_wire(open_v, tuning).compress_dtype == \
         DataType.int8
+
+
+# ---------------------------------------------------------------------------
+# Stripe-overlapped allreduce selection (OVERLAP_MIN_COUNT register)
+# ---------------------------------------------------------------------------
+
+OLAP_CAL = None
+
+
+def _olap_cal():
+    """A deterministic shaped-link + compute calibration under which
+    the overlap argmin picks a multi-stripe plan for every count the
+    tests sweep."""
+    global OLAP_CAL
+    if OLAP_CAL is None:
+        from accl_tpu.sequencer.timing import ComputeFit, LinkParams
+
+        OLAP_CAL = dict(overlap_link=LinkParams(600e-6, 0.3e9),
+                        overlap_compute=ComputeFit(2e-3, 0.3e9))
+    return OLAP_CAL
+
+
+def test_overlap_register_zero_is_bit_for_bit_unchanged():
+    """Default registers + a present calibration must change NOTHING:
+    the striped plan is unreachable until autotune moves the MIN
+    register off 0 (the acceptance bar's register-0 clause) — checked
+    across counts and stream shapes."""
+    for count in (64, 4096, 1 << 20):
+        for stream in (StreamFlags.NO_STREAM, StreamFlags.RES_STREAM):
+            base = sel(Operation.allreduce, count, stream=stream)
+            with_cal = sel(Operation.allreduce, count, stream=stream,
+                           **_olap_cal())
+            assert with_cal == base
+            assert base.stripes == 1
+
+
+def test_overlap_register_window_stripes_the_ring():
+    """Inside the MIN window the eager ring plan carries the cost
+    model's stripe count (and the matching world-aligned stripe
+    segmentation); below the window, or compressed, selection is
+    unchanged."""
+    from accl_tpu.constants import DataType
+    from accl_tpu.sequencer.timing import best_overlap_stripes
+
+    t = TuningParams(overlap_min_count=4096)
+    cal = _olap_cal()
+    count = 1 << 18
+    p = sel(Operation.allreduce, count, tuning=t, **cal)
+    assert p.algorithm == Algorithm.EAGER_RING_RS_AG
+    want = best_overlap_stripes(
+        cal["overlap_link"], count, 4, 8,
+        compute_s=cal["overlap_compute"].seconds(count * 4),
+        rx_buf_bytes=1024)
+    assert p.stripes == want and p.stripes > 1
+    assert p.seg_count % 8 == 0
+    assert p.seg_count * p.stripes >= count
+    # below the min-bytes threshold: the serial plan, bit-for-bit
+    assert sel(Operation.allreduce, 512, tuning=t, **cal) == \
+        sel(Operation.allreduce, 512)
+    # compressed calls keep their exact selection (the quantized ring
+    # has its own register family)
+    pc = sel(Operation.allreduce, count, tuning=t,
+             comp=CompressionFlags.ETH_COMPRESSED,
+             compress_dtype=DataType.int8, **cal)
+    assert pc.stripes == 1
+
+
+def test_overlap_without_calibration_stays_serial(monkeypatch):
+    """An open window with NO calibration (no compute fit anywhere)
+    must keep the serial plan — never a made-up pipeline depth."""
+    from accl_tpu.telemetry import feedback
+
+    monkeypatch.setattr(feedback, "default_compute_fit",
+                        lambda path=None: None)
+    t = TuningParams(overlap_min_count=1)
+    base = sel(Operation.allreduce, 1 << 18)
+    p = sel(Operation.allreduce, 1 << 18, tuning=t,
+            overlap_link=_olap_cal()["overlap_link"])
+    assert p == base and p.stripes == 1
+
+
+def test_overlap_stripes_ride_the_frozen_plan():
+    """The stripe decision is Plan identity: plans differing only in
+    stripes hash and compare apart, so they key different XLA cache
+    entries."""
+    from accl_tpu.sequencer.plan import Plan
+
+    a = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, 1024, 4,
+             stripes=4)
+    b = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, 1024, 4,
+             stripes=2)
+    assert a != b and hash(a) != hash(b)
+
+
+def test_overlap_register_round_trip_and_clamp():
+    """The register rides exchange memory like every other tuning
+    word (CCLOAddr.OVERLAP_MIN_COUNT round-trips through
+    configure_tuning_parameters/tuning), and from_crossovers clamps an
+    over-cap MIN to OFF — min(v, cap) would widen the window into the
+    regime the calibration said the serial form wins."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from accl_tpu.accl import ACCL
+    from accl_tpu.device.base import CCLOAddr
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("ccl",))
+    accl = ACCL(mesh)
+    tp = TuningParams.default()
+    tp.overlap_min_count = 123456
+    accl.configure_tuning_parameters(tp)
+    assert accl.cclo.read(CCLOAddr.OVERLAP_MIN_COUNT) == 123456
+    assert accl.cclo.tuning().overlap_min_count == 123456
+    # register 0 = off, the default
+    assert TuningParams().overlap_min_count == 0
+    got = TuningParams.from_crossovers({
+        "gather_flat_tree_max_count_bytes": 1024,
+        "bcast_flat_tree_max_ranks": 3,
+        "reduce_flat_tree_max_ranks": 4,
+        "reduce_flat_tree_max_count_bytes": 1024,
+        "allreduce_composition_max_bytes": 0,
+        "overlap_min_bytes": 65536,
+    })
+    assert got.overlap_min_count == 65536
+    over = TuningParams.from_crossovers({
+        "gather_flat_tree_max_count_bytes": 1024,
+        "bcast_flat_tree_max_ranks": 3,
+        "reduce_flat_tree_max_ranks": 4,
+        "reduce_flat_tree_max_count_bytes": 1024,
+        "allreduce_composition_max_bytes": 0,
+        "overlap_min_bytes": 1 << 40,
+    })
+    assert over.overlap_min_count == 0

@@ -349,3 +349,75 @@ def test_splice_producer_preserves_placeholder_ordering():
     assert placeholder in used, (
         "splice_producer drops its placeholder operand — ordering edges "
         f"injected by the fused sequence path would vanish\n{jaxpr}")
+
+
+def test_overlap_striped_sequence_jaxpr_structure():
+    """Structural pin of the stripe-overlapped train-step batch: the
+    fused program's allreduce step lowers to EXACTLY S independent
+    RS+AG ring chains (S * 2*(world-1) ppermutes), and the serialized
+    twin (overlap_serialize) threads S-1 order-only barriers between
+    them while keeping the identical wire structure — the lowering
+    seam bench --overlap-gate A/Bs."""
+    import jax
+
+    from accl_tpu.analysis.protocol import iter_ppermute_eqns
+    from accl_tpu.constants import (DataType, Operation, ReduceFunction,
+                                    StreamFlags)
+    from accl_tpu.descriptor import CallOptions, SequenceDescriptor
+    from accl_tpu.sequencer.lowering import AxisOnlyMesh, ScheduleCompiler
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.plan import select_algorithm
+    from accl_tpu.sequencer.sequence import SequencePlan
+    from accl_tpu.constants import (DEFAULT_EAGER_RX_BUF_SIZE,
+                                    DEFAULT_MAX_EAGER_SIZE, TuningParams)
+
+    world, n, S = 4, 4096, 4
+
+    def consumer(x):
+        return x * np.float32(0.5) + np.float32(1.0)
+
+    def opts(scen, a0, a1, a2, streamed=False):
+        return CallOptions(
+            scenario=scen, count=n, function=int(ReduceFunction.SUM),
+            data_type=DataType.float32,
+            stream_flags=(StreamFlags.RES_STREAM if streamed
+                          else StreamFlags.NO_STREAM),
+            res_stream_id=31 if streamed else 0,
+            addr_0=a0, addr_1=a1, addr_2=a2)
+
+    desc = SequenceDescriptor((
+        opts(Operation.copy, 1, 0, 2, streamed=True),
+        opts(Operation.allreduce, 2, 0, 3),
+        opts(Operation.combine, 1, 3, 4),
+    ))
+    kw = dict(max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+              eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
+              tuning=TuningParams.default())
+    seg = -(-n // S)
+    seg += (-seg) % world
+    plans = [
+        select_algorithm(Operation.copy, n, 4, world, **kw),
+        Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, seg,
+             -(-n // seg), stripes=S),
+        select_algorithm(Operation.combine, n, 4, world, **kw),
+    ]
+    counts = {}
+    for serialize in (False, True):
+        seq = SequencePlan(desc, plans, world,
+                           endpoints=[(None, consumer), (None, None),
+                                      (None, None)])
+        comp = ScheduleCompiler(AxisOnlyMesh("ccl", world), "ccl",
+                                use_pallas_ring=False,
+                                overlap_serialize=serialize)
+        body, n_in = seq.build(comp)
+        avals = [jax.ShapeDtypeStruct((n,), np.float32)] * n_in
+        closed = jax.make_jaxpr(body, axis_env=[("ccl", world)])(*avals)
+        npp = len(list(iter_ppermute_eqns(closed)))
+        nbar = sum(1 for e in closed.jaxpr.eqns
+                   if e.primitive.name == "optimization_barrier")
+        counts[serialize] = (npp, nbar)
+    assert counts[False][0] == S * 2 * (world - 1)
+    assert counts[True][0] == counts[False][0]
+    # the serialized twin threads one order-only barrier per stripe
+    # boundary on top of whatever the overlapped form carries
+    assert counts[True][1] >= counts[False][1] + (S - 1)

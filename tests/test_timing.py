@@ -827,3 +827,189 @@ def test_alltoall_compress_register_round_trip(mesh8):
         tp.alltoall_compress_min_count
     assert accl.cclo.tuning().alltoall_compress_min_count == \
         tp.alltoall_compress_min_count
+
+
+# ---------------------------------------------------------------------------
+# Compute-communication overlap cost model (ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+
+def test_striped_coefficients_multiply_messages_not_bytes():
+    """A stripe-overlapped EAGER_RING_RS_AG plan's serial cost shape:
+    S x the ring's message count (the chains run back to back in the
+    serial form), identical total wire bytes."""
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.timing import coefficients, coefficients_aggregate
+
+    n, world = 1 << 18, 8
+    base = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, n, 1)
+    striped = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG,
+                   n // 4, 4, stripes=4)
+    m0, b0 = coefficients(Operation.allreduce, base, n, 4, world,
+                          rx_buf_bytes=1024)
+    m1, b1 = coefficients(Operation.allreduce, striped, n, 4, world,
+                          rx_buf_bytes=1024)
+    assert m1 == 4 * m0
+    assert b1 == pytest.approx(b0)
+    am0, ab0 = coefficients_aggregate(Operation.allreduce, base, n, 4,
+                                      world, rx_buf_bytes=1024)
+    am1, ab1 = coefficients_aggregate(Operation.allreduce, striped, n,
+                                      4, world, rx_buf_bytes=1024)
+    assert am1 == 4 * am0 and ab1 == pytest.approx(ab0)
+
+
+def test_predict_overlapped_pipeline_shape():
+    """The busy-link vs busy-core pipeline formula, pinned:
+    T_serial = compute + S*lam and T_overlap = c + lam + (S-1)*max(c, o)
+    with lam the per-stripe chain latency and o = one alpha + the
+    stripe's wire bytes."""
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.timing import (LinkParams, coefficients,
+                                           predict_overlapped)
+
+    link = LinkParams(500e-6, 0.25e9)
+    n, world, S = 1 << 18, 8, 4
+    compute_s = 20e-3
+    plan = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, n // S, S,
+                stripes=S)
+    stripe = -(-n // S)
+    sp = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, stripe, 1)
+    # logp_shape=False: striped plans always run the ring chains
+    m, b = coefficients(Operation.allreduce, sp, stripe, 4, world,
+                        rx_buf_bytes=1024, logp_shape=False)
+    lam = link.seconds(m, b)
+    occ = link.seconds(1.0, b)
+    c = compute_s / S
+    want = c + lam + (S - 1) * max(c, occ)
+    got = predict_overlapped(link, plan, n, 4, world,
+                             compute_s=compute_s, rx_buf_bytes=1024)
+    assert got == pytest.approx(want)
+    want_serial = compute_s + S * lam
+    got_serial = predict_overlapped(link, plan, n, 4, world,
+                                    compute_s=compute_s,
+                                    rx_buf_bytes=1024, serial=True)
+    assert got_serial == pytest.approx(want_serial)
+    # the overlapped form must beat serial in this regime (latency-
+    # dominated chains + compute to hide behind)
+    assert got < got_serial
+
+
+def test_best_overlap_stripes_is_the_argmin():
+    """best_overlap_stripes returns exactly the candidate minimizing
+    predict_overlapped (ties toward fewer stripes), and degenerates to
+    1 when a stripe could not hold one world chunk."""
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.timing import (ComputeFit, LinkParams,
+                                           best_overlap_stripes,
+                                           predict_overlapped)
+
+    link = LinkParams(600e-6, 0.3e9)
+    fit = ComputeFit(2e-3, 0.3e9)
+    n, world = 1 << 18, 8
+    compute_s = fit.seconds(n * 4)
+    best = best_overlap_stripes(link, n, 4, world, compute_s=compute_s,
+                                rx_buf_bytes=1024)
+    costs = {}
+    for s in (1, 2, 4, 8):
+        plan = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, n, 1,
+                    stripes=s)
+        costs[s] = predict_overlapped(link, plan, n, 4, world,
+                                      compute_s=compute_s,
+                                      rx_buf_bytes=1024)
+    assert best == min(sorted(costs), key=lambda s: (costs[s], s))
+    assert best > 1
+    assert best_overlap_stripes(link, 8, 4, world, compute_s=1e-3,
+                                rx_buf_bytes=1024) == 1
+
+
+def test_predict_sequence_overlap_and_serial_forms():
+    """predict_sequence with a compute term: the fused form pipelines a
+    striped allreduce against the compute (predict_overlapped), the
+    eager form pays compute + the striped serial chains + one dispatch
+    per call."""
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.timing import (LinkParams, predict_overlapped,
+                                           predict_sequence)
+
+    link = LinkParams(600e-6, 0.3e9)
+    n, world, S = 1 << 18, 8, 4
+    nop = Plan(Protocol.EAGER, Algorithm.NONE, n, 1)
+    ar = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, n // S, S,
+              stripes=S)
+    calls = [(Operation.copy, nop, n, 4),
+             (Operation.allreduce, ar, n, 4),
+             (Operation.combine, nop, n, 4)]
+    compute_s = 15e-3
+    alpha_d = 1e-3
+    fused = predict_sequence(link, calls, world, rx_buf_bytes=1024,
+                             dispatch_alpha=alpha_d, fused=True,
+                             compute_s=compute_s)
+    want_f = predict_overlapped(link, ar, n, 4, world,
+                                compute_s=compute_s,
+                                rx_buf_bytes=1024) + alpha_d
+    assert fused == pytest.approx(want_f)
+    serial = predict_sequence(link, calls, world, rx_buf_bytes=1024,
+                              dispatch_alpha=alpha_d, fused=False,
+                              compute_s=compute_s)
+    want_s = predict_overlapped(link, ar, n, 4, world,
+                                compute_s=compute_s, rx_buf_bytes=1024,
+                                serial=True) + 3 * alpha_d
+    assert serial == pytest.approx(want_s)
+    assert serial / fused >= 2.0  # the regime the gate claims
+
+
+def test_calibrate_compute_recovers_fit():
+    """calibrate_compute recovers (alpha, rate) from exact samples —
+    the ComputeFit counterpart of the LinkParams fit."""
+    from accl_tpu.sequencer.timing import ComputeFit, calibrate_compute
+
+    true = ComputeFit(alpha=3e-3, rate=0.5e9)
+    samples = [(b, true.seconds(b))
+               for b in (1 << 18, 1 << 20, 1 << 22)]
+    fit = calibrate_compute(samples)
+    assert fit.alpha == pytest.approx(true.alpha, rel=1e-6)
+    assert fit.rate == pytest.approx(true.rate, rel=1e-6)
+    assert fit.seconds(1 << 21) == pytest.approx(true.seconds(1 << 21),
+                                                 rel=1e-6)
+
+
+def test_overlap_crossover_contiguous_suffix_and_gating():
+    """tuning_crossovers' overlap_min_bytes: absent a compute fit the
+    register stays 0; with one it is the start of the contiguous
+    winning suffix (every larger swept size must also clear the
+    min-gain bar against the serial dispatch->compute twin), scanned
+    under the shaped (tier outer) link when one is given."""
+    from accl_tpu.sequencer.plan import Algorithm, Plan, Protocol
+    from accl_tpu.sequencer.timing import (ComputeFit, LinkParams,
+                                           TierLinks,
+                                           best_overlap_stripes,
+                                           predict_overlapped,
+                                           tuning_crossovers)
+
+    link = LinkParams(2e-6, 2e9)
+    tiers = TierLinks(inner=LinkParams(2e-6, 2e9),
+                      outer=LinkParams(600e-6, 0.3e9))
+    fit = ComputeFit(2e-3, 0.3e9)
+    no_fit = tuning_crossovers(link, world=8, tier_links=tiers)
+    assert no_fit["overlap_min_bytes"] == 0
+    cross = tuning_crossovers(link, world=8, tier_links=tiers,
+                              compute_fit=fit)
+    reg = cross["overlap_min_bytes"]
+    assert reg > 0
+    # every swept size at/above the register start wins by >5% under
+    # the shaped link — contiguity of the suffix, re-derived here
+    nb = reg
+    while nb <= (1 << 24):
+        cnt = nb // 4
+        comp = fit.seconds(nb)
+        s = best_overlap_stripes(tiers.outer, cnt, 4, 8,
+                                 compute_s=comp, rx_buf_bytes=4096)
+        plan = Plan(Protocol.EAGER, Algorithm.EAGER_RING_RS_AG, cnt, 1,
+                    stripes=s)
+        t_on = predict_overlapped(tiers.outer, plan, cnt, 4, 8,
+                                  compute_s=comp, rx_buf_bytes=4096)
+        t_off = predict_overlapped(tiers.outer, plan, cnt, 4, 8,
+                                   compute_s=comp, rx_buf_bytes=4096,
+                                   serial=True)
+        assert s > 1 and (t_off - t_on) > 0.05 * t_off, nb
+        nb *= 2

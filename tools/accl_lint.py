@@ -382,6 +382,18 @@ def run_schedules(deep: bool = False, sample: int = 0,
                 (Operation.allgather, 65536, DataType.none)):
             configs.append((world, scen, 0, count, "synth",
                             synth_tuning, wire))
+        # stripe-overlapped allreduce cells (sequencer/plan.py's
+        # OVERLAP_MIN_COUNT window + timing.best_overlap_stripes):
+        # the register-selected striped segmentation must interpret,
+        # model-check and certify exactly like the unstriped ring.
+        # Config tuples grow a trailing ("olap", stripes) extra; the
+        # depth is pinned per cell the way the hier sweep pins its
+        # stripe depths.
+        olap_tuning = TuningParams(overlap_min_count=1)
+        for count, stripes in ((64, 2), (4096, 4)):
+            configs.append((world, Operation.allreduce, 0, count,
+                            "olap", olap_tuning, DataType.none,
+                            ("olap", stripes)))
     # hierarchical two-tier cells (sequencer/hierarchical.py): the
     # striped composition selected through the register window for
     # every (inner, outer) factoring, several stripe depths, and the
@@ -414,6 +426,8 @@ def run_schedules(deep: bool = False, sample: int = 0,
             else None
         a2av = extra[1] if extra is not None and extra[0] == "a2av" \
             else None
+        olap = extra[1] if extra is not None and extra[0] == "olap" \
+            else None
         from accl_tpu.constants import CompressionFlags
 
         rsd = root if scen != Operation.send \
@@ -439,12 +453,32 @@ def run_schedules(deep: bool = False, sample: int = 0,
                            tier_links=TierLinks(
                                inner=LinkParams(2e-6, 2e9),
                                outer=LinkParams(30e-6, 0.25e9)))
+        olap_kw: dict = {}
+        if olap is not None:
+            from accl_tpu.sequencer.timing import (ComputeFit,
+                                                   LinkParams)
+
+            # a representative shaped-link + compute calibration: the
+            # register must engage through the REAL selection path;
+            # the sweep pins the stripe depth explicitly below
+            olap_kw = dict(overlap_link=LinkParams(600e-6, 0.3e9),
+                           overlap_compute=ComputeFit(2e-3, 0.3e9))
         plan = select_algorithm(
             scen, count, 4, world, comp_flags,
             max_eager_size=DEFAULT_MAX_EAGER_SIZE,
             eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
             tuning=tuning, compress_dtype=wire,
-            peer_counts=a2av or (), **hier_kw)
+            peer_counts=a2av or (), **hier_kw, **olap_kw)
+        if olap is not None:
+            import dataclasses as _dc
+
+            assert plan.algorithm.name == "EAGER_RING_RS_AG" \
+                and plan.stripes > 1, \
+                f"overlap config did not stripe the ring: {plan}"
+            seg = -(-count // olap)
+            seg += (-seg) % world
+            plan = _dc.replace(plan, stripes=olap, seg_count=seg,
+                               num_segments=max(-(-count // seg), 1))
         if a2av is not None:
             assert plan.algorithm.name == "FLAT_ALLTOALLV", \
                 f"alltoallv config did not select the v-schedule: {plan}"

@@ -56,6 +56,7 @@ def section_trajectory(out: list[str]) -> None:
     backfilled); a round genuinely missing it renders `?*` — the label
     is never recovered from prose."""
     rounds = []
+    prev_metric = None
     for p in sorted(REPO.glob("BENCH_r*.json")):
         try:
             d = json.loads(p.read_text())
@@ -65,18 +66,37 @@ def section_trajectory(out: list[str]) -> None:
         platform = parsed.get("platform")
         if platform is None:
             platform = "?*"
+        # a round whose headline cell diverges from the previous
+        # round's (a renamed or newly-added bench section) must say so
+        # explicitly: rendering its value on the same trajectory row
+        # set reads as a continuous series of one metric, which it is
+        # not — the silent-gap failure this marker replaces
+        metric = parsed.get("metric")
+        note = ""
+        if prev_metric is not None and metric is not None \
+                and metric != prev_metric:
+            note = "new-cell"
+        if metric is not None:
+            prev_metric = metric
         rounds.append((p.name, parsed.get("value"), parsed.get("unit", ""),
-                       platform))
+                       platform, note))
     if not rounds:
         return
     out.append("## Headline trajectory (`BENCH_r*.json`)\n")
-    out.append("| Round | Value | Unit | Platform |\n|---|---|---|---|")
-    for name, value, unit, platform in rounds:
-        out.append(f"| {name} | {value} | {unit} | {platform} |")
+    out.append("| Round | Value | Unit | Platform | Note |"
+               "\n|---|---|---|---|---|")
+    for name, value, unit, platform, note in rounds:
+        out.append(f"| {name} | {value} | {unit} | {platform} | "
+                   f"{note} |")
     out.append("")
-    if any(platform == "?*" for _, _, _, platform in rounds):
+    if any(platform == "?*" for _, _, _, platform, _ in rounds):
         out.append("`?*` = artifact genuinely missing the `platform` "
                    "schema field. ")
+    if any(note == "new-cell" for *_, note in rounds):
+        out.append("`new-cell` = the round's headline metric differs "
+                   "from the previous round's (renamed/added bench "
+                   "cell): values across that boundary are not one "
+                   "trajectory. ")
     out.append("Only same-platform rounds are comparable; cpu-fallback "
                "values are not a regression signal.\n")
 
