@@ -228,6 +228,11 @@ class ScheduleCompiler:
             # the Python bodies use — schedules as data end to end.
             # int8-wire entries carry their encode/decode lanes inside
             # the DAG, so the per-hop Wire built below stays off here.
+            # TIERED entries (spec.tiers) validate their hop annotation
+            # against the RankMap ring permutations and compile every
+            # hop as ONE global ppermute over those pairs — inner hops
+            # stay within a slice, outer hops cross — the same
+            # ring=(pos, perm) embedding the HIER branch below rides.
             from . import synthesis
 
             return synthesis.lower_plan(plan, options, world, axis)

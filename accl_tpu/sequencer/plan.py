@@ -178,6 +178,7 @@ def select_algorithm(
     peer_counts: tuple[int, ...] = (),
     overlap_link=None,
     overlap_compute=None,
+    tiered_synth_ok: bool = True,
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
@@ -242,15 +243,25 @@ def select_algorithm(
     # ONLY inside the HIER_ALLREDUCE_MIN_COUNT register window on a
     # caller that declared a two-tier topology — the same
     # measured-selection posture as the synth registers (register 0
-    # keeps selection bit-for-bit unchanged). Checked BEFORE the
-    # synthesized library: the synth windows were calibrated on a
+    # keeps selection bit-for-bit unchanged). Checked BEFORE the flat
+    # synthesized library: the flat synth windows were calibrated on a
     # uniform link, and on a declared two-tier world their flat
     # hop-DAGs would drag full payloads across the slow tier — a
     # caller who declared the topology and tuned the hier register has
-    # asserted the per-tier calibration governs here. Only exact
-    # uncompressed unstreamed calls are eligible; per-tier compression
-    # rides tier_wires/the plan's tier dtypes instead of the
-    # descriptor's global compression flag.
+    # asserted the per-tier calibration governs here. INSIDE the
+    # window, though, the hand-written composition no longer pre-empts
+    # unconditionally: a committed TIERED library entry for this exact
+    # factoring (synthesis.select_entry(tiers=...), scored per-tier
+    # against the striped composition itself) arbitrates BY PREDICTED
+    # TIME under the same per-tier calibration — the composition is
+    # one point in the factored search space, and the schedule that
+    # predicts faster wins the cell. No tiered entry (or no per-tier
+    # calibration) keeps the old behavior bit-for-bit;
+    # tiered_synth_ok=False pins the composition (the bench/lint
+    # twin-measurement escape, like select_wire's quantized_ok). Only
+    # exact uncompressed unstreamed calls are eligible; per-tier
+    # compression rides tier_wires/the plan's tier dtypes instead of
+    # the descriptor's global compression flag.
     if scenario == Operation.allreduce and topology is not None:
         inner_w, outer_w = topology
         if (tuning.hier_allreduce_min_count > 0
@@ -259,7 +270,7 @@ def select_algorithm(
                 and bytes_count >= tuning.hier_allreduce_min_count
                 and stream == StreamFlags.NO_STREAM
                 and compression == CompressionFlags.NO_COMPRESSION):
-            from .timing import best_stripes
+            from .timing import best_stripes, predict_tiered
 
             iw, ow = tier_wires
             links = tier_links
@@ -272,10 +283,30 @@ def select_algorithm(
                 stripes = best_stripes(
                     links, count, dtype_nbytes, inner_w, outer_w,
                     inner_wire=iw, outer_wire=ow)
-            return Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG,
-                        count, 1, inner_world=inner_w,
-                        outer_world=outer_w, stripes=stripes,
-                        inner_wire_dtype=iw, outer_wire_dtype=ow)
+            hier_plan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG,
+                             count, 1, inner_world=inner_w,
+                             outer_world=outer_w, stripes=stripes,
+                             inner_wire_dtype=iw, outer_wire_dtype=ow)
+            if tiered_synth_ok and links is not None:
+                from . import synthesis
+                from .timing import predict_synth_tiered
+
+                key = synthesis.select_entry(
+                    scenario, world_size, bytes_count,
+                    tiers=(inner_w, outer_w))
+                if key is not None:
+                    synth_plan = Plan(Protocol.EAGER,
+                                      Algorithm.SYNTHESIZED, count, 1,
+                                      synth_key=key,
+                                      inner_world=inner_w,
+                                      outer_world=outer_w)
+                    t_synth = predict_synth_tiered(
+                        links, synth_plan, count, dtype_nbytes)
+                    t_hier = predict_tiered(links, hier_plan, count,
+                                            dtype_nbytes)
+                    if t_synth < t_hier:
+                        return synth_plan
+            return hier_plan
 
     # Synthesized schedules (sequencer/synthesis.py): payloads inside a
     # synth crossover register run the search-produced hop-DAG for this

@@ -57,6 +57,55 @@ of-two worlds admit no valid candidate in these families and simply
 yield an empty library — the search never ships a schedule it cannot
 prove.
 
+Factored topologies (pod scale)
+-------------------------------
+TPU pod slices are not uniform rings: a world factors as
+inner x outer (L devices per slice on the fast tier, P slices across
+the slow tier), and a hop moves along exactly ONE axis of that 2-D
+torus. The tiered families (`t_<inner>_<outer>`, allreduce) search
+this factored space with TIER-ANNOTATED hops: every hop carries which
+tier it crosses (`hop_layout`), is charged against that tier's
+`timing.TierLinks` entry (`tiered_phase_costs` /
+`predict_spec_tiered` — the `timing.hier_phase_costs` accounting,
+generalized to arbitrary hop sequences), and compiles to that tier's
+ring permutation (the `ring=(pos, perm)` embedding `hierarchical.
+RankMap` provides; outer-major global ranks, g = outer*L + inner).
+Members compose one inner reduce-scatter, one outer shard-allreduce,
+and one inner allgather — HiCCL's multiply/factor shape — from
+per-tier family choices:
+
+  inner  `lg`    log-step halving/doubling over the inner distance
+                 tuple (power-of-two L)
+         `ring`  the bandwidth-optimal one-chunk-per-hop ring
+                 (any L; distance d with gcd(d, L) = 1)
+  outer  `exchange` / `rs_ag`  the flat families over the 1/L shard
+         `ring`  ring RS + ring AG over the shard
+
+The hand-written striped `HIER_RS_AR_AG` composition is exactly the
+`t_ring_ring` member at one stripe — a POINT in this space the search
+rediscovers (it scores identical to the composition's serial form) and
+then beats with the log-step members wherever per-message latency
+matters. Tiered entries arbitrate against the striped composition by
+predicted time inside the HIER_ALLREDUCE_MIN_COUNT window
+(plan.select_algorithm), never through a separate register.
+
+Scaling the enumeration (w16-w256)
+----------------------------------
+Distance tuples are enumerated by a branch-and-bound DFS
+(`_valid_distance_tuples`): a prefix is pruned the moment its subset
+sums collide, so the first valid tuple at w256 costs ~k*world set ops
+instead of the lexicographic-combinations scan's millions. Candidates
+are scored with the alpha-beta model BEFORE any certification is paid
+(`search` scores, beam-prunes to the `beam` best predicted advantages,
+then certifies only the survivors): the score is the model's EXACT
+serial cost of the emitted DAG — phases never overlap, each hop is
+charged to precisely the link it crosses — so pruning by it is
+admissible: certification only rejects candidates, never improves
+their score, and the kept set always contains the model's best
+certifiable candidate. Every survivor still pays the FULL existing
+stack (semantics ACCL501-504 + modelcheck ACCL205-207); an uncertified
+winner is a loud discard, never shipped.
+
 Everything here is deterministic: no RNG, candidates enumerated in
 lexicographic order, so the same inputs always produce the same winner
 DAG (pinned by tests/test_synthesis.py).
@@ -65,7 +114,6 @@ DAG (pinned by tests/test_synthesis.py).
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import json
 import math
 import pathlib
@@ -87,7 +135,9 @@ from ..analysis.hopdag import (
     Node,
     Piece,
     Value,
+    concat_values,
     from_json,
+    slice_value,
     to_json,
 )
 
@@ -97,9 +147,13 @@ __all__ = [
     "instantiate",
     "certify_spec",
     "enumerate_candidates",
+    "enumerate_tiered_candidates",
     "search",
     "cost_shape",
     "predict_spec",
+    "tiered_phase_costs",
+    "predict_spec_tiered",
+    "hop_layout",
     "lower_dag",
     "lower_plan",
     "library",
@@ -107,6 +161,7 @@ __all__ = [
     "select_entry",
     "clear_library_cache",
     "hand_written_best",
+    "hand_written_tiered_best",
     "SIZE_GRID",
 ]
 
@@ -136,14 +191,23 @@ class _NotRankSymmetric(SynthesisError):
 class SynthSpec:
     """One synthesized schedule family member: enough to regenerate its
     hop-DAG deterministically at any payload size. `key` names the
-    library entry (and rides Plan.synth_key into the XLA cache key)."""
+    library entry (and rides Plan.synth_key into the XLA cache key).
+
+    `tiers=(inner_world, outer_world)` marks a FACTORED-topology member
+    (family `t_<inner>_<outer>`): `distances` are then the inner-axis
+    tuple and `outer_distances` the outer-axis one, and every hop is
+    tier-annotated (`hop_layout`) — charged to its `TierLinks` entry
+    and compiled to its RankMap ring permutation. `tiers=()` is the
+    flat single-ring space."""
 
     key: str
     op: str  # "allreduce" | "allgather" | "reduce_scatter"
     world: int
-    family: str  # "exchange" | "doubling" | "halving" | "rs_ag"
+    family: str  # exchange | doubling | halving | rs_ag | t_<ik>_<ok>
     distances: tuple[int, ...]
     wire: str = ""  # "" = payload dtype on the wire, "int8" = quantized
+    tiers: tuple[int, ...] = ()  # (inner_world, outer_world) | () flat
+    outer_distances: tuple[int, ...] = ()
 
     @property
     def scenario(self) -> Operation:
@@ -156,6 +220,9 @@ class SynthSpec:
         }
         if self.wire:
             d["wire"] = self.wire
+        if self.tiers:
+            d["tiers"] = list(self.tiers)
+            d["outer_distances"] = list(self.outer_distances)
         return d
 
     @classmethod
@@ -163,7 +230,10 @@ class SynthSpec:
         return cls(key=str(d["key"]), op=str(d["op"]),
                    world=int(d["world"]), family=str(d["family"]),
                    distances=tuple(int(x) for x in d["distances"]),
-                   wire=str(d.get("wire", "")))
+                   wire=str(d.get("wire", "")),
+                   tiers=tuple(int(x) for x in d.get("tiers", ())),
+                   outer_distances=tuple(
+                       int(x) for x in d.get("outer_distances", ())))
 
 
 def _spec_key(op: str, world: int, family: str,
@@ -171,6 +241,24 @@ def _spec_key(op: str, world: int, family: str,
     d = "_".join(str(x) for x in distances)
     w = f"_{wire}" if wire else ""
     return f"{op}_w{world}_{family}_d{d}{w}"
+
+
+def _tiered_key(world: int, tiers: tuple[int, int], family: str,
+                di: tuple[int, ...], do: tuple[int, ...]) -> str:
+    L, P = tiers
+    return (f"allreduce_w{world}_t{L}x{P}_{family[2:]}"
+            f"_d{'_'.join(map(str, di))}_o{'_'.join(map(str, do))}")
+
+
+def _tier_kinds(family: str) -> tuple[str, str]:
+    """('lg'|'ring', 'exchange'|'rs_ag'|'ring') of a tiered family."""
+    if not family.startswith("t_"):
+        raise SynthesisError(f"not a tiered family: {family!r}")
+    ik, ok = family[2:].split("_", 1)
+    if ik not in ("lg", "ring") or ok not in ("exchange", "rs_ag",
+                                              "ring"):
+        raise SynthesisError(f"unknown tiered family {family!r}")
+    return ik, ok
 
 
 # ---------------------------------------------------------------------------
@@ -206,6 +294,39 @@ def coverage_sets(world: int,
     return sets
 
 
+def _valid_distance_tuples(world: int, k: int) -> Iterator[tuple[int, ...]]:
+    """Strictly-increasing k-tuples whose 2^k subset sums are pairwise
+    distinct mod `world`, in lexicographic order — enumerated by
+    branch-and-bound DFS: a prefix dies the moment its sums collide, so
+    the first valid tuple at w256 costs ~k*world set extensions instead
+    of the millions of complete tuples a combinations scan would build
+    and re-check (the scaling lever for w16-w256 enumeration)."""
+
+    def rec(start: int, sums: frozenset, prefix: tuple[int, ...],
+            ) -> Iterator[tuple[int, ...]]:
+        if len(prefix) == k:
+            yield prefix
+            return
+        for d in range(start, world):
+            shifted = {(s + d) % world for s in sums}
+            if sums & shifted:
+                continue  # collision: every extension collides too
+            yield from rec(d + 1, frozenset(sums | shifted),
+                           prefix + (d,))
+
+    yield from rec(1, frozenset({0}), ())
+
+
+def _first_valid_tuple(world: int) -> tuple[int, ...] | None:
+    """The lexicographically first valid k=log2(world) tuple (the
+    dominance representative: valid tuples within a family share the
+    per-step byte profile, so they are cost-identical)."""
+    if world < 2 or world & (world - 1):
+        return None
+    k = world.bit_length() - 1
+    return next(_valid_distance_tuples(world, k), None)
+
+
 # ---------------------------------------------------------------------------
 # DAG generation (rank-symmetric by construction)
 # ---------------------------------------------------------------------------
@@ -231,95 +352,178 @@ class _Builder:
         return ids
 
 
+class _FlatAxis:
+    """The single-ring geometry: positions ARE global ranks, a hop at
+    distance d is the full-ring rotation g -> g + d."""
+
+    tier = ""
+
+    def __init__(self, world: int):
+        self.world = world
+        self.nranks = world
+
+    def pos(self, g: int) -> int:
+        return g
+
+    def peer(self, g: int, d: int) -> int:
+        return (g + d) % self.world
+
+
+class _InnerAxis:
+    """The fast tier of an outer-major (g = outer*L + inner) factored
+    world: a hop rotates every slice's inner ring in lockstep — the
+    global pairs are exactly `hierarchical.RankMap.inner_perm(d)`."""
+
+    tier = "inner"
+
+    def __init__(self, L: int, P: int):
+        self.world = L
+        self.nranks = L * P
+        self._L = L
+
+    def pos(self, g: int) -> int:
+        return g % self._L
+
+    def peer(self, g: int, d: int) -> int:
+        return g - g % self._L + (g % self._L + d) % self._L
+
+
+class _OuterAxis:
+    """The slow tier: a hop rotates every inner row's outer ring in
+    lockstep — the global pairs of `RankMap.outer_perm(d)`."""
+
+    tier = "outer"
+
+    def __init__(self, L: int, P: int):
+        self.world = P
+        self.nranks = L * P
+        self._L = L
+
+    def pos(self, g: int) -> int:
+        return g // self._L
+
+    def peer(self, g: int, d: int) -> int:
+        return ((g // self._L + d) % self.world) * self._L + g % self._L
+
+
 def _scales_len(n: int) -> int:
     return max(1, math.ceil(n / QUANT_BLOCK_ELEMS))
+
+
+def _exchange_core(b: _Builder, axis, distances: tuple[int, ...],
+                   count: int, func: str, acc: list[Value],
+                   hop_base: int, wire: str) -> tuple[list[Value], int]:
+    """allreduce exchange along one axis: every rank sends its running
+    partial `acc[g]` distance d down the axis and folds the arrival
+    from distance -d. Returns (final partials, next free hop). The flat
+    family and the tiered outer-`exchange` phase share this emitter —
+    only the axis geometry differs."""
+    w = axis.world
+    hop = hop_base
+    for d in distances:
+        if wire == "int8":
+            enc = b.emit_round(lambda g, i: Node(
+                id=i, kind="encode", rank=g, length=count,
+                value=acc[g],
+                scales_len=_scales_len(count), dtype="int8"))
+            b.emit_round(lambda g, i: Node(
+                id=i, kind="send", rank=g, length=count,
+                value=(Piece(count, enc[g]),), hop=hop,
+                peer=axis.peer(g, d)))
+            b.emit_round(lambda g, i: Node(
+                id=i, kind="send", rank=g, length=_scales_len(count),
+                value=(Piece(_scales_len(count), enc[g], 0, SCALES),),
+                hop=hop + 1, peer=axis.peer(g, d)))
+            rq = b.emit_round(lambda g, i: Node(
+                id=i, kind="recv", rank=g, length=count, hop=hop,
+                peer=axis.peer(g, -d)))
+            rs = b.emit_round(lambda g, i: Node(
+                id=i, kind="recv", rank=g, length=_scales_len(count),
+                hop=hop + 1, peer=axis.peer(g, -d)))
+            dec = b.emit_round(lambda g, i: Node(
+                id=i, kind="decode", rank=g, length=count,
+                value=(Piece(count, rq[g]),),
+                value2=(Piece(_scales_len(count), rs[g]),)))
+            ids = b.emit_round(lambda g, i: Node(
+                id=i, kind="combine", rank=g, length=count,
+                value=acc[g],
+                value2=(Piece(count, dec[g]),), func=func))
+            acc = [(Piece(count, ids[g]),) for g in range(axis.nranks)]
+            hop += 2
+        else:
+            b.emit_round(lambda g, i: Node(
+                id=i, kind="send", rank=g, length=count,
+                value=acc[g], hop=hop, peer=axis.peer(g, d)))
+            rv = b.emit_round(lambda g, i: Node(
+                id=i, kind="recv", rank=g, length=count, hop=hop,
+                peer=axis.peer(g, -d)))
+            ids = b.emit_round(lambda g, i: Node(
+                id=i, kind="combine", rank=g, length=count,
+                value=acc[g],
+                value2=(Piece(count, rv[g]),), func=func))
+            acc = [(Piece(count, ids[g]),) for g in range(axis.nranks)]
+            hop += 1
+    return acc, hop
 
 
 def _exchange_dag(spec: SynthSpec, count: int, func: str) -> HopDag:
     """allreduce: acc[r] folds the arrival from r - d_i each step."""
     w = spec.world
     b = _Builder(w)
-    acc = b.emit_round(lambda r, i: Node(
+    args = b.emit_round(lambda r, i: Node(
         id=i, kind="arg", rank=r, length=count, arg=0, dtype="float32"))
-    hop = 0
-    for d in spec.distances:
-        if spec.wire == "int8":
-            enc = b.emit_round(lambda r, i: Node(
-                id=i, kind="encode", rank=r, length=count,
-                value=(Piece(count, acc[r]),),
-                scales_len=_scales_len(count), dtype="int8"))
-            b.emit_round(lambda r, i: Node(
-                id=i, kind="send", rank=r, length=count,
-                value=(Piece(count, enc[r]),), hop=hop, peer=(r + d) % w))
-            b.emit_round(lambda r, i: Node(
-                id=i, kind="send", rank=r, length=_scales_len(count),
-                value=(Piece(_scales_len(count), enc[r], 0, SCALES),),
-                hop=hop + 1, peer=(r + d) % w))
-            rq = b.emit_round(lambda r, i: Node(
-                id=i, kind="recv", rank=r, length=count, hop=hop,
-                peer=(r - d) % w))
-            rs = b.emit_round(lambda r, i: Node(
-                id=i, kind="recv", rank=r, length=_scales_len(count),
-                hop=hop + 1, peer=(r - d) % w))
-            dec = b.emit_round(lambda r, i: Node(
-                id=i, kind="decode", rank=r, length=count,
-                value=(Piece(count, rq[r]),),
-                value2=(Piece(_scales_len(count), rs[r]),)))
-            acc = b.emit_round(lambda r, i: Node(
-                id=i, kind="combine", rank=r, length=count,
-                value=(Piece(count, acc[r]),),
-                value2=(Piece(count, dec[r]),), func=func))
-            hop += 2
-        else:
-            b.emit_round(lambda r, i: Node(
-                id=i, kind="send", rank=r, length=count,
-                value=(Piece(count, acc[r]),), hop=hop, peer=(r + d) % w))
-            rv = b.emit_round(lambda r, i: Node(
-                id=i, kind="recv", rank=r, length=count, hop=hop,
-                peer=(r - d) % w))
-            acc = b.emit_round(lambda r, i: Node(
-                id=i, kind="combine", rank=r, length=count,
-                value=(Piece(count, acc[r]),),
-                value2=(Piece(count, rv[r]),), func=func))
-            hop += 1
-    outputs: tuple[Value, ...] = tuple(
-        (Piece(count, acc[r]),) for r in range(w))
+    acc: list[Value] = [(Piece(count, args[r]),) for r in range(w)]
+    acc, _hop = _exchange_core(b, _FlatAxis(w), spec.distances, count,
+                               func, acc, 0, spec.wire)
+    outputs: tuple[Value, ...] = tuple(acc[r] for r in range(w))
     return HopDag(world=w, n_in=1, in_elems=count, out_elems=count,
                   nodes=tuple(b.nodes), outputs=outputs)
+
+
+def _doubling_core(b: _Builder, axis, distances: tuple[int, ...],
+                   count: int, held: list[dict[int, Value]],
+                   hop_base: int) -> tuple[list[dict[int, Value]], int]:
+    """allgather doubling along one axis: each rank relays EVERY chunk
+    held so far; `held[g]` maps origin axis POSITION -> that origin's
+    chunk Value on rank g. Returns (full held maps, next free hop)."""
+    w = axis.world
+    sets = coverage_sets(w, distances)
+    for step, d in enumerate(distances):
+        rel = sorted(sets[step])  # canonical message layout
+        msg_len = len(rel) * count
+
+        def payload(g: int) -> Value:
+            out: tuple[Piece, ...] = ()
+            for s in rel:
+                out = out + held[g][(axis.pos(g) - s) % w]
+            return out
+
+        b.emit_round(lambda g, i: Node(
+            id=i, kind="send", rank=g, length=msg_len,
+            value=payload(g), hop=hop_base + step, peer=axis.peer(g, d)))
+        rv = b.emit_round(lambda g, i: Node(
+            id=i, kind="recv", rank=g, length=msg_len,
+            hop=hop_base + step, peer=axis.peer(g, -d)))
+        for g in range(axis.nranks):
+            for j, s in enumerate(rel):
+                origin = (axis.pos(g) - d - s) % w
+                held[g][origin] = (
+                    Piece(count, rv[g], j * count),)
+    return held, hop_base + len(distances)
 
 
 def _doubling_dag(spec: SynthSpec, count: int) -> HopDag:
     """allgather: each rank relays every chunk held so far; held sets
     are `coverage_sets` in relative offsets (held chunk = rank - s)."""
     w = spec.world
-    sets = coverage_sets(w, spec.distances)
     b = _Builder(w)
     args = b.emit_round(lambda r, i: Node(
         id=i, kind="arg", rank=r, length=count, arg=0, dtype="float32"))
     # held[r][origin] = Value holding origin's chunk on rank r
     held: list[dict[int, Value]] = [
         {r: (Piece(count, args[r]),)} for r in range(w)]
-    for step, d in enumerate(spec.distances):
-        rel = sorted(sets[step])  # canonical message layout
-        msg_len = len(rel) * count
-
-        def payload(r: int) -> Value:
-            out: tuple[Piece, ...] = ()
-            for s in rel:
-                out = out + held[r][(r - s) % w]
-            return out
-
-        b.emit_round(lambda r, i: Node(
-            id=i, kind="send", rank=r, length=msg_len,
-            value=payload(r), hop=step, peer=(r + d) % w))
-        rv = b.emit_round(lambda r, i: Node(
-            id=i, kind="recv", rank=r, length=msg_len, hop=step,
-            peer=(r - d) % w))
-        for r in range(w):
-            for j, s in enumerate(rel):
-                origin = (r - d - s) % w
-                held[r][origin] = (
-                    Piece(count, rv[r], j * count),)
+    held, _hop = _doubling_core(b, _FlatAxis(w), spec.distances, count,
+                                held, 0)
     outputs = []
     for r in range(w):
         v: tuple[Piece, ...] = ()
@@ -331,24 +535,122 @@ def _doubling_dag(spec: SynthSpec, count: int) -> HopDag:
                   outputs=tuple(outputs))
 
 
+def _halving_core(b: _Builder, axis, distances: tuple[int, ...],
+                  count: int, func: str,
+                  part: list[dict[int, Value]],
+                  hop_base: int) -> tuple[list[dict[int, Value]], int]:
+    """reduce_scatter halving along one axis: position p hands off
+    partials for chunks p + d + A_i to position p + d each step;
+    responsibility sets A_i halve (A_i = S_{k-i} of the reversed
+    distance sequence). `part[g]` maps ABSOLUTE axis chunk -> partial
+    Value; on return only position g's kept chunks remain. Returns
+    (part, next free hop)."""
+    w = axis.world
+    k = len(distances)
+    # A_i chain: A_k = {0}; A_{i-1} = A_i u (A_i + d_i)
+    A: list[set[int]] = [set() for _ in range(k + 1)]
+    A[k] = {0}
+    for i in range(k, 0, -1):
+        d = distances[i - 1]
+        A[i - 1] = A[i] | {(a + d) % w for a in A[i]}
+    for i in range(1, k + 1):
+        d = distances[i - 1]
+        send_rel = sorted((a + d) % w for a in A[i])
+        msg_len = len(send_rel) * count
+
+        def payload(g: int) -> Value:
+            out: tuple[Piece, ...] = ()
+            for a in send_rel:
+                out = out + part[g][(axis.pos(g) + a) % w]
+            return out
+
+        b.emit_round(lambda g, i_: Node(
+            id=i_, kind="send", rank=g, length=msg_len,
+            value=payload(g), hop=hop_base + i - 1,
+            peer=axis.peer(g, d)))
+        rv = b.emit_round(lambda g, i_: Node(
+            id=i_, kind="recv", rank=g, length=msg_len,
+            hop=hop_base + i - 1, peer=axis.peer(g, -d)))
+        # arrival from pos-d carries chunks (pos-d) + send_rel, i.e.
+        # pos + a for a = send_rel - d (mod w) — all kept chunks; fold
+        # each slice into the kept partial, rank-major per arrival slot
+        # so symmetry holds
+        arr_rel = [(a - d) % w for a in send_rel]
+        for j, a in enumerate(arr_rel):
+            ids = b.emit_round(lambda g, i_: Node(
+                id=i_, kind="combine", rank=g, length=count,
+                value=part[g][(axis.pos(g) + a) % w],
+                value2=(Piece(count, rv[g], j * count),), func=func))
+            for g in range(axis.nranks):
+                part[g][(axis.pos(g) + a) % w] = (Piece(count, ids[g]),)
+        # drop handed-off chunks (no longer this position's duty)
+        for g in range(axis.nranks):
+            part[g] = {c: v for c, v in part[g].items()
+                       if (c - axis.pos(g)) % w in A[i]}
+    return part, hop_base + k
+
+
+def _ring_rs_core(b: _Builder, axis, d: int, count: int, func: str,
+                  part: list[dict[int, Value]],
+                  hop_base: int) -> tuple[list[dict[int, Value]], int]:
+    """Bandwidth-optimal ring reduce-scatter along one axis — the
+    hand-written ring's structure as a searchable point: w-1 steps each
+    moving exactly ONE chunk partial distance d down the axis. At step
+    s position p sends its partial of chunk p - s*d and folds the
+    arrival into chunk p - (s+1)*d; after w-1 steps position p owns
+    chunk p fully reduced (gcd(d, w) = 1 walks the whole ring)."""
+    w = axis.world
+    hop = hop_base
+    for s in range(1, w):
+        b.emit_round(lambda g, i: Node(
+            id=i, kind="send", rank=g, length=count,
+            value=part[g][(axis.pos(g) - s * d) % w], hop=hop,
+            peer=axis.peer(g, d)))
+        rv = b.emit_round(lambda g, i: Node(
+            id=i, kind="recv", rank=g, length=count, hop=hop,
+            peer=axis.peer(g, -d)))
+        ids = b.emit_round(lambda g, i: Node(
+            id=i, kind="combine", rank=g, length=count,
+            value=part[g][(axis.pos(g) - (s + 1) * d) % w],
+            value2=(Piece(count, rv[g]),), func=func))
+        for g in range(axis.nranks):
+            part[g][(axis.pos(g) - (s + 1) * d) % w] = (
+                Piece(count, ids[g]),)
+        hop += 1
+    return part, hop
+
+
+def _ring_ag_core(b: _Builder, axis, d: int, count: int,
+                  held: list[dict[int, Value]],
+                  hop_base: int) -> tuple[list[dict[int, Value]], int]:
+    """Ring allgather along one axis: w-1 steps each relaying the chunk
+    received the previous step (at step 1 the own chunk), so every
+    position holds every origin after the walk."""
+    w = axis.world
+    hop = hop_base
+    for s in range(1, w):
+        b.emit_round(lambda g, i: Node(
+            id=i, kind="send", rank=g, length=count,
+            value=held[g][(axis.pos(g) - (s - 1) * d) % w], hop=hop,
+            peer=axis.peer(g, d)))
+        rv = b.emit_round(lambda g, i: Node(
+            id=i, kind="recv", rank=g, length=count, hop=hop,
+            peer=axis.peer(g, -d)))
+        for g in range(axis.nranks):
+            held[g][(axis.pos(g) - s * d) % w] = (Piece(count, rv[g]),)
+        hop += 1
+    return held, hop
+
+
 def _halving_dag(spec: SynthSpec, count: int, func: str,
                  b: _Builder | None = None,
                  part_in: list[dict[int, Value]] | None = None,
                  hop_base: int = 0) -> tuple[
                      "_Builder", list[dict[int, Value]]]:
-    """reduce_scatter core: rank r hands off partials for chunks
-    r + d + A_i to rank r + d each step; responsibility sets A_i halve
-    (A_i = S_{k-i} of the reversed distance sequence). Returns the
-    builder and per-rank {abs_chunk: partial Value} so `rs_ag` can
-    continue the same DAG."""
+    """reduce_scatter wrapper over `_halving_core` on the flat axis;
+    returns the builder and per-rank {abs_chunk: partial Value} so
+    `rs_ag` can continue the same DAG."""
     w = spec.world
-    k = len(spec.distances)
-    # A_i chain: A_k = {0}; A_{i-1} = A_i u (A_i + d_i)
-    A: list[set[int]] = [set() for _ in range(k + 1)]
-    A[k] = {0}
-    for i in range(k, 0, -1):
-        d = spec.distances[i - 1]
-        A[i - 1] = A[i] | {(a + d) % w for a in A[i]}
     if b is None:
         b = _Builder(w)
         args = b.emit_round(lambda r, i: Node(
@@ -358,40 +660,8 @@ def _halving_dag(spec: SynthSpec, count: int, func: str,
             {c: (Piece(count, args[r], c * count),) for c in range(w)}
             for r in range(w)]
     assert b is not None and part_in is not None
-    part = part_in
-    for i in range(1, k + 1):
-        d = spec.distances[i - 1]
-        send_rel = sorted((a + d) % w for a in A[i])
-        msg_len = len(send_rel) * count
-
-        def payload(r: int) -> Value:
-            out: tuple[Piece, ...] = ()
-            for a in send_rel:
-                out = out + part[r][(r + a) % w]
-            return out
-
-        b.emit_round(lambda r, i_: Node(
-            id=i_, kind="send", rank=r, length=msg_len,
-            value=payload(r), hop=hop_base + i - 1, peer=(r + d) % w))
-        rv = b.emit_round(lambda r, i_: Node(
-            id=i_, kind="recv", rank=r, length=msg_len,
-            hop=hop_base + i - 1, peer=(r - d) % w))
-        # arrival from r-d carries chunks (r-d) + send_rel, i.e. r + a
-        # for a = send_rel - d (mod w) — all kept chunks; fold each
-        # slice into the kept partial, rank-major per arrival slot so
-        # symmetry holds
-        arr_rel = [(a - d) % w for a in send_rel]
-        for j, a in enumerate(arr_rel):
-            ids = b.emit_round(lambda r, i_: Node(
-                id=i_, kind="combine", rank=r, length=count,
-                value=part[r][(r + a) % w],
-                value2=(Piece(count, rv[r], j * count),), func=func))
-            for r in range(w):
-                part[r][(r + a) % w] = (Piece(count, ids[r]),)
-        # drop handed-off chunks (no longer this rank's responsibility)
-        for r in range(w):
-            part[r] = {c: v for c, v in part[r].items()
-                       if (c - r) % w in A[i]}
+    part, _hop = _halving_core(b, _FlatAxis(w), spec.distances, count,
+                               func, part_in, hop_base)
     return b, part
 
 
@@ -415,29 +685,10 @@ def _rs_ag_dag(spec: SynthSpec, count: int, func: str) -> HopDag:
     k = len(spec.distances)
     b, part = _halving_dag(spec, chunk, func, hop_base=0)
     # allgather phase: start from the reduced chunk, doubling relays
-    sets = coverage_sets(w, spec.distances)
     held: list[dict[int, Value]] = [
         {r: part[r][r]} for r in range(w)]
-    for step, d in enumerate(spec.distances):
-        rel = sorted(sets[step])
-        msg_len = len(rel) * chunk
-
-        def payload(r: int) -> Value:
-            out: tuple[Piece, ...] = ()
-            for s in rel:
-                out = out + held[r][(r - s) % w]
-            return out
-
-        b.emit_round(lambda r, i: Node(
-            id=i, kind="send", rank=r, length=msg_len,
-            value=payload(r), hop=k + step, peer=(r + d) % w))
-        rv = b.emit_round(lambda r, i: Node(
-            id=i, kind="recv", rank=r, length=msg_len, hop=k + step,
-            peer=(r - d) % w))
-        for r in range(w):
-            for j, s in enumerate(rel):
-                origin = (r - d - s) % w
-                held[r][origin] = (Piece(chunk, rv[r], j * chunk),)
+    held, _hop = _doubling_core(b, _FlatAxis(w), spec.distances, chunk,
+                                held, k)
     outputs = []
     for r in range(w):
         v: tuple[Piece, ...] = ()
@@ -448,6 +699,95 @@ def _rs_ag_dag(spec: SynthSpec, count: int, func: str) -> HopDag:
                   nodes=tuple(b.nodes), outputs=tuple(outputs))
 
 
+def _tiered_dag(spec: SynthSpec, count: int, func: str) -> HopDag:
+    """Factored-topology allreduce over outer-major global ranks
+    (g = outer*L + inner): inner reduce-scatter -> outer allreduce of
+    the 1/L shard (the ONLY bytes that ever cross the slow tier) ->
+    inner allgather, each phase built from the per-tier family the spec
+    names. Every hop moves along exactly one axis of the (L, P) torus —
+    the tier annotation `hop_layout` records and the per-tier cost
+    accounting charges."""
+    L, P = spec.tiers
+    w = L * P
+    if count % (L * P):
+        raise SynthesisError(
+            f"{spec.key}: tiered payload must chunk by inner*outer "
+            f"({count} % {L * P})")
+    cpk = count // L  # one inner chunk == the outer shard
+    ik, ok = _tier_kinds(spec.family)
+    inner = _InnerAxis(L, P)
+    outer = _OuterAxis(L, P)
+    b = _Builder(w)
+    args = b.emit_round(lambda g, i: Node(
+        id=i, kind="arg", rank=g, length=count, arg=0, dtype="float32"))
+    part: list[dict[int, Value]] = [
+        {c: (Piece(cpk, args[g], c * cpk),) for c in range(L)}
+        for g in range(w)]
+    hop = 0
+    if ik == "ring":
+        part, hop = _ring_rs_core(b, inner, spec.distances[0], cpk,
+                                  func, part, hop)
+    else:
+        part, hop = _halving_core(b, inner, spec.distances, cpk, func,
+                                  part, hop)
+    shard: list[Value] = [part[g][inner.pos(g)] for g in range(w)]
+    if ok == "exchange":
+        shard, hop = _exchange_core(b, outer, spec.outer_distances,
+                                    cpk, func, shard, hop, "")
+    else:
+        ocpk = cpk // P
+        opart: list[dict[int, Value]] = [
+            {c: slice_value(shard[g], c * ocpk, ocpk) for c in range(P)}
+            for g in range(w)]
+        if ok == "ring":
+            od = spec.outer_distances[0]
+            opart, hop = _ring_rs_core(b, outer, od, ocpk, func,
+                                       opart, hop)
+            held_o: list[dict[int, Value]] = [
+                {outer.pos(g): opart[g][outer.pos(g)]} for g in range(w)]
+            held_o, hop = _ring_ag_core(b, outer, od, ocpk, held_o, hop)
+        else:  # rs_ag
+            opart, hop = _halving_core(b, outer, spec.outer_distances,
+                                       ocpk, func, opart, hop)
+            held_o = [
+                {outer.pos(g): opart[g][outer.pos(g)]} for g in range(w)]
+            held_o, hop = _doubling_core(b, outer, spec.outer_distances,
+                                         ocpk, held_o, hop)
+        shard = [concat_values(*(held_o[g][c] for c in range(P)))
+                 for g in range(w)]
+    held: list[dict[int, Value]] = [
+        {inner.pos(g): shard[g]} for g in range(w)]
+    if ik == "ring":
+        held, hop = _ring_ag_core(b, inner, spec.distances[0], cpk,
+                                  held, hop)
+    else:
+        held, hop = _doubling_core(b, inner, spec.distances, cpk,
+                                   held, hop)
+    outputs = tuple(concat_values(*(held[g][c] for c in range(L)))
+                    for g in range(w))
+    return HopDag(world=w, n_in=1, in_elems=count, out_elems=count,
+                  nodes=tuple(b.nodes), outputs=outputs)
+
+
+def _check_axis_family(spec: SynthSpec, kind: str, axis_world: int,
+                       distances: tuple[int, ...], what: str) -> None:
+    """Per-tier validity: the log-step families need the exact-cover
+    subset-sum condition over THEIR axis; a ring needs one distance
+    coprime to the axis extent (the walk must visit every position)."""
+    if kind in ("lg", "exchange", "rs_ag"):
+        if not _subset_sums_distinct(axis_world, distances):
+            raise SynthesisError(
+                f"{spec.key}: {what} distances {distances} do not "
+                f"cover Z_{axis_world} exactly once — not a valid "
+                "schedule")
+    else:  # ring
+        if len(distances) != 1 or math.gcd(distances[0],
+                                           axis_world) != 1:
+            raise SynthesisError(
+                f"{spec.key}: {what} ring distance {distances} must be "
+                f"a single generator of Z_{axis_world}")
+
+
 def instantiate(spec: SynthSpec, count: int,
                 func: str = "sum") -> HopDag:
     """Deterministically regenerate `spec`'s hop-DAG for a concrete
@@ -456,6 +796,16 @@ def instantiate(spec: SynthSpec, count: int,
     source DAG — there is exactly one structure to certify."""
     if count <= 0:
         raise SynthesisError(f"count must be positive, got {count}")
+    if spec.tiers:
+        L, P = spec.tiers
+        if L * P != spec.world or L < 2 or P < 2:
+            raise SynthesisError(
+                f"{spec.key}: tiers {spec.tiers} do not factor world "
+                f"{spec.world}")
+        ik, ok = _tier_kinds(spec.family)
+        _check_axis_family(spec, ik, L, spec.distances, "inner")
+        _check_axis_family(spec, ok, P, spec.outer_distances, "outer")
+        return _tiered_dag(spec, count, func)
     if not _subset_sums_distinct(spec.world, spec.distances):
         raise SynthesisError(
             f"{spec.key}: distances {spec.distances} do not cover "
@@ -478,6 +828,10 @@ CANONICAL_COUNT = {"exchange": 64, "doubling": 16, "halving": 16,
 
 
 def canonical_count(spec: SynthSpec) -> int:
+    if spec.tiers:
+        # must chunk by inner*outer (the 2-D torus chunking rule)
+        L, P = spec.tiers
+        return 8 * L * P
     base = CANONICAL_COUNT[spec.family]
     if spec.family == "rs_ag":
         return max(base, spec.world)  # must chunk by world
@@ -553,11 +907,69 @@ def _wire_bytes_per_elem(spec: SynthSpec, elem_bytes: int) -> float:
     return float(elem_bytes)
 
 
+def hop_layout(spec: SynthSpec) -> list[tuple[str, int]]:
+    """(tier, axis_distance) per hop channel of a tiered spec, in hop
+    order — THE tier annotation of the factored search space: each hop
+    is charged against its `TierLinks` entry (`tiered_phase_costs`) and
+    compiles to its tier's ring permutation (`lower_plan` cross-checks
+    the emitted DAG's send pairs against `RankMap.inner_perm` /
+    `outer_perm` at exactly these distances)."""
+    if not spec.tiers:
+        raise SynthesisError(f"{spec.key} is not a tiered spec")
+    L, P = spec.tiers
+    ik, ok = _tier_kinds(spec.family)
+    inner_hops = ([("inner", spec.distances[0])] * (L - 1)
+                  if ik == "ring"
+                  else [("inner", d) for d in spec.distances])
+    if ok == "exchange":
+        outer_hops = [("outer", d) for d in spec.outer_distances]
+    elif ok == "rs_ag":
+        outer_hops = [("outer", d) for d in spec.outer_distances] * 2
+    else:  # ring RS + ring AG
+        outer_hops = [("outer", spec.outer_distances[0])] * (2 * (P - 1))
+    # the inner allgather mirrors the inner reduce-scatter's hop count
+    return inner_hops + outer_hops + inner_hops
+
+
+def _tiered_step_elems(spec: SynthSpec,
+                       count: int) -> list[tuple[str, int]]:
+    """(tier, elements-sent-per-rank) per hop of a tiered spec, in hop
+    order (count padded up to the inner*outer chunking the DAG
+    requires — the same rule `lower_plan` applies)."""
+    L, P = spec.tiers
+    padded = count + (-count) % (L * P)
+    cpk = padded // L
+    ik, ok = _tier_kinds(spec.family)
+    k_i = len(spec.distances)
+    if ik == "ring":
+        inner_rs = [cpk] * (L - 1)
+        inner_ag = [cpk] * (L - 1)
+    else:
+        inner_rs = [cpk * (1 << (k_i - i)) // 2 for i in range(k_i)]
+        inner_ag = [cpk * (1 << i) for i in range(k_i)]
+    if ok == "exchange":
+        outer = [cpk] * len(spec.outer_distances)
+    else:
+        ocpk = cpk // P
+        if ok == "ring":
+            outer = [ocpk] * (2 * (P - 1))
+        else:
+            k_o = len(spec.outer_distances)
+            outer = ([ocpk * (1 << (k_o - i)) // 2 for i in range(k_o)]
+                     + [ocpk * (1 << i) for i in range(k_o)])
+    return ([("inner", e) for e in inner_rs]
+            + [("outer", e) for e in outer]
+            + [("inner", e) for e in inner_ag])
+
+
 def _step_elems(spec: SynthSpec, count: int) -> list[int]:
     """Per-step elements each rank sends (every rank sends the same —
     rank symmetry). `count` follows the descriptor convention of the
     op: allgather = chunk elems, reduce_scatter = output chunk elems,
-    allreduce = payload elems."""
+    allreduce = payload elems. Tiered specs flatten their per-tier hop
+    profile (the single-link fallback `cost_shape` documents)."""
+    if spec.tiers:
+        return [e for _t, e in _tiered_step_elems(spec, count)]
     w = spec.world
     k = len(spec.distances)
     if spec.family == "exchange":
@@ -598,9 +1010,50 @@ def cost_shape(spec: SynthSpec, count: int, elem_bytes: int,
 def predict_spec(link: Any, spec: SynthSpec, count: int,
                  elem_bytes: int, *, aggregate: bool = False) -> float:
     """Expected seconds under LinkParams `link` (timing.predict's synth
-    counterpart; timing.coefficients routes SYNTHESIZED plans here)."""
+    counterpart; timing.coefficients routes SYNTHESIZED plans here).
+    For a tiered spec this is the single-link FALLBACK (both tiers
+    charged to one link); the calibrated per-tier prediction is
+    `predict_spec_tiered`."""
     m, b = cost_shape(spec, count, elem_bytes, aggregate=aggregate)
     return float(link.seconds(m, b))
+
+
+def tiered_phase_costs(spec: SynthSpec, count: int, elem_bytes: int,
+                       *, aggregate: bool = False,
+                       ) -> list[tuple[str, float, float]]:
+    """(tier, messages, bytes) of a tiered spec's hops, summed per tier
+    — the `timing.hier_phase_costs` accounting generalized to arbitrary
+    tier-annotated hop sequences: every hop's wire bytes are charged to
+    exactly the link it crosses. aggregate=True sums over all ranks
+    (the serialized-host regime); default is the per-link critical
+    path (every hop is a full-torus permutation — all ranks move
+    concurrently)."""
+    wb = _wire_bytes_per_elem(spec, elem_bytes)
+    per: dict[str, list[float]] = {"inner": [0.0, 0.0],
+                                   "outer": [0.0, 0.0]}
+    for tier, elems in _tiered_step_elems(spec, count):
+        step_bytes = elems * wb
+        per[tier][0] += max(1, math.ceil(step_bytes / STREAM_SEG_BYTES))
+        per[tier][1] += step_bytes
+    scale = spec.world if aggregate else 1
+    return [("inner", per["inner"][0] * scale, per["inner"][1] * scale),
+            ("outer", per["outer"][0] * scale, per["outer"][1] * scale)]
+
+
+def predict_spec_tiered(links: Any, spec: SynthSpec, count: int,
+                        elem_bytes: int, *,
+                        aggregate: bool = False) -> float:
+    """Expected seconds for a tiered spec under a `timing.TierLinks`
+    calibration: the phases serialize (the emitted DAG never overlaps
+    tiers), so the prediction is the exact per-tier alpha-beta sum —
+    which is also why it is an ADMISSIBLE pruning bound for the search:
+    it is the model's exact cost of the candidate, not a relaxation,
+    and certification can only reject candidates, never improve this
+    score."""
+    return float(sum(
+        links.of(tier).seconds(m, b)
+        for tier, m, b in tiered_phase_costs(spec, count, elem_bytes,
+                                             aggregate=aggregate)))
 
 
 def hand_written_best(link: Any, op: Operation, count: int,
@@ -650,6 +1103,35 @@ def hand_written_best(link: Any, op: Operation, count: int,
     return best
 
 
+def hand_written_tiered_best(tier_links: Any, count: int,
+                             elem_bytes: int,
+                             tiers: tuple[int, int], *,
+                             rx_buf_bytes: int = 4096,
+                             aggregate: bool = False) -> float:
+    """The best PREDICTED two-tier-aware hand-written time for this
+    cell: the striped hierarchical composition at the cost model's own
+    stripe count (timing.best_stripes' argmin — the strongest
+    hand-written two-tier opponent, pipelining included) and the flat
+    zoo charged to the OUTER link (every flat ring step crosses the
+    slow tier — the same accounting the hier crossover scan uses). A
+    tiered synthesized entry ships only when it beats BOTH."""
+    from .plan import Algorithm, Plan, Protocol
+    from .timing import best_stripes, predict_tiered
+
+    L, P = tiers
+    s = best_stripes(tier_links, count, elem_bytes, L, P,
+                     aggregate=aggregate)
+    hplan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, count, 1,
+                 inner_world=L, outer_world=P, stripes=s)
+    t_hier = predict_tiered(tier_links, hplan, count, elem_bytes,
+                            aggregate=aggregate)
+    t_flat = hand_written_best(tier_links.outer, Operation.allreduce,
+                               count, elem_bytes, L * P,
+                               rx_buf_bytes=rx_buf_bytes,
+                               aggregate=aggregate)
+    return min(t_hier, t_flat)
+
+
 # ---------------------------------------------------------------------------
 # Search: enumerate -> prune -> certify -> score
 # ---------------------------------------------------------------------------
@@ -658,34 +1140,71 @@ def hand_written_best(link: Any, op: Operation, count: int,
 def enumerate_candidates(op: Operation, world: int,
                          include_wire: bool = True,
                          ) -> Iterator[SynthSpec]:
-    """All valid candidates for (op, world) in deterministic
+    """All valid FLAT candidates for (op, world) in deterministic
     lexicographic order. Distances are strictly increasing (two equal
     distances always collide in the subset-sum check) and k is pinned
     to log2(world) by the exact-cover condition; candidates with the
     same per-step byte profile are cost-equivalent, so dominance
-    pruning keeps only the lexicographically first of each family."""
+    pruning keeps only the lexicographically first of each family —
+    found by the branch-and-bound DFS (`_valid_distance_tuples`), which
+    is what keeps enumeration O(k*world) at w64-w256 instead of the
+    combinations scan's millions of dead tuples."""
     if world < 2 or world & (world - 1):
         return  # the symmetric families need 2^k == world
-    k = world.bit_length() - 1
     op_name = op.name
     families = {"allreduce": ("exchange", "rs_ag"),
                 "allgather": ("doubling",),
                 "reduce_scatter": ("halving",)}[op_name]
+    distances = _first_valid_tuple(world)
+    if distances is None:
+        return
     for family in families:
-        for distances in itertools.combinations(range(1, world), k):
-            if not _subset_sums_distinct(world, distances):
-                continue
+        yield SynthSpec(
+            key=_spec_key(op_name, world, family, distances, ""),
+            op=op_name, world=world, family=family,
+            distances=distances)
+        if include_wire and family == "exchange":
             yield SynthSpec(
-                key=_spec_key(op_name, world, family, distances, ""),
+                key=_spec_key(op_name, world, family, distances,
+                              "int8"),
                 op=op_name, world=world, family=family,
-                distances=distances)
-            if include_wire and family == "exchange":
-                yield SynthSpec(
-                    key=_spec_key(op_name, world, family, distances,
-                                  "int8"),
-                    op=op_name, world=world, family=family,
-                    distances=distances, wire="int8")
-            break  # dominance: later distance sets are cost-identical
+                distances=distances, wire="int8")
+
+
+def enumerate_tiered_candidates(world: int, tiers: tuple[int, int],
+                                ) -> Iterator[SynthSpec]:
+    """All tiered allreduce candidates for one (inner, outer) factoring
+    of `world`, deterministic order: the per-tier family product
+    {lg, ring} x {exchange, rs_ag, ring}, each at its dominance-
+    representative distance tuple. The log-step kinds need a
+    power-of-two axis; the ring kinds serve ANY axis extent (d = 1),
+    which is what keeps non-power-of-two pod slices searchable.
+    Degenerate duplicates are skipped (at an axis extent of 2 the ring
+    and the log-step member emit the same hops; ring == rs_ag on the
+    outer shard at P = 2)."""
+    L, P = tiers
+    if L < 2 or P < 2 or L * P != world:
+        return
+    inner_kinds: list[tuple[str, tuple[int, ...]]] = []
+    i_tuple = _first_valid_tuple(L)
+    if i_tuple is not None:
+        inner_kinds.append(("lg", i_tuple))
+    if L > 2 or i_tuple is None:
+        inner_kinds.append(("ring", (1,)))
+    outer_kinds: list[tuple[str, tuple[int, ...]]] = []
+    o_tuple = _first_valid_tuple(P)
+    if o_tuple is not None:
+        outer_kinds.append(("exchange", o_tuple))
+        outer_kinds.append(("rs_ag", o_tuple))
+    if P > 2 or o_tuple is None:
+        outer_kinds.append(("ring", (1,)))
+    for ik, di in inner_kinds:
+        for ok, do in outer_kinds:
+            family = f"t_{ik}_{ok}"
+            yield SynthSpec(
+                key=_tiered_key(world, (L, P), family, di, do),
+                op="allreduce", world=world, family=family,
+                distances=di, tiers=(L, P), outer_distances=do)
 
 
 @dataclasses.dataclass
@@ -699,6 +1218,25 @@ class SearchResult:
     predicted: dict[int, tuple[float, float]]  # bytes -> (synth, hand)
 
 
+def _narrow_contiguous(wins: list[int], size_grid: tuple[int, ...],
+                       key: str, say: Callable[[str], None],
+                       ) -> tuple[int, int]:
+    """Longest contiguous grid run of a win set: select_entry treats
+    every payload inside [lo, hi] as a predicted win, so a win set with
+    a losing cell in the middle must not overclaim the whole span."""
+    runs: list[list[int]] = [[wins[0]]]
+    for prev, nbytes in zip(wins, wins[1:]):
+        if size_grid.index(nbytes) - size_grid.index(prev) == 1:
+            runs[-1].append(nbytes)
+        else:
+            runs.append([nbytes])
+    run = max(runs, key=len)
+    if len(run) < len(wins):
+        say(f"narrow {key}: win cells non-contiguous across "
+            f"the grid; keeping [{run[0]}, {run[-1]}]")
+    return run[0], run[-1]
+
+
 def score_window(link: Any, spec: SynthSpec, *,
                  elem_bytes: int = 4,
                  size_grid: tuple[int, ...] = SIZE_GRID,
@@ -706,14 +1244,11 @@ def score_window(link: Any, spec: SynthSpec, *,
                  log: Callable[[str], None] | None = None,
                  ) -> tuple[tuple[int, int] | None,
                             dict[int, tuple[float, float]]]:
-    """Score one certified spec per size-grid cell against the best
+    """Score one FLAT spec per size-grid cell against the best
     hand-written prediction (strict inequality wins) and narrow the win
-    set to its longest CONTIGUOUS grid run: select_entry treats every
-    payload inside [lo, hi] as a predicted win, so a win set with a
-    losing cell in the middle (beats the zoo at both ends only) must
-    not overclaim the whole span. The ONE window rule shared by
-    search/--export and verify_library — a scoring change lands here or
-    nowhere. Returns (window or None, per-cell predictions)."""
+    set to its longest CONTIGUOUS grid run. The ONE window rule shared
+    by search/--export and verify_library — a scoring change lands here
+    or nowhere. Returns (window or None, per-cell predictions)."""
     say = log or (lambda m: None)
     wins: list[int] = []
     predicted: dict[int, tuple[float, float]] = {}
@@ -733,47 +1268,117 @@ def score_window(link: Any, spec: SynthSpec, *,
             wins.append(nbytes)
     if not wins:
         return None, predicted
-    runs: list[list[int]] = [[wins[0]]]
-    for prev, nbytes in zip(wins, wins[1:]):
-        if size_grid.index(nbytes) - size_grid.index(prev) == 1:
-            runs[-1].append(nbytes)
-        else:
-            runs.append([nbytes])
-    run = max(runs, key=len)
-    if len(run) < len(wins):
-        say(f"narrow {spec.key}: win cells non-contiguous across "
-            f"the grid; keeping [{run[0]}, {run[-1]}]")
-    return (run[0], run[-1]), predicted
+    return _narrow_contiguous(wins, size_grid, spec.key, say), predicted
+
+
+def score_window_tiered(tier_links: Any, spec: SynthSpec, *,
+                        elem_bytes: int = 4,
+                        size_grid: tuple[int, ...] = SIZE_GRID,
+                        aggregate: bool = False,
+                        log: Callable[[str], None] | None = None,
+                        ) -> tuple[tuple[int, int] | None,
+                                   dict[int, tuple[float, float]]]:
+    """The tiered-entry window rule: per size-grid cell, the spec's
+    per-tier prediction (every hop charged to ITS link) must strictly
+    beat `hand_written_tiered_best` — the striped hierarchical
+    composition at the model's own stripe count AND the flat zoo on the
+    outer link. Shared by search/--export and verify_library's tiered
+    leg exactly like `score_window` is for flat entries.
+
+    A win needs a (tiny) relative MARGIN, not one ULP: the composition
+    re-discovered (the ring x ring member) predicts EXACTLY the striped
+    composition's serial form, differing only in summation order — a
+    tie is a keep-out, never a shippable entry, and a summation-order
+    artifact must not flip windows between hosts."""
+    say = log or (lambda m: None)
+    wins: list[int] = []
+    predicted: dict[int, tuple[float, float]] = {}
+    L, P = spec.tiers
+    for nbytes in size_grid:
+        count = max(nbytes // elem_bytes, 1)
+        t_synth = predict_spec_tiered(tier_links, spec, count,
+                                      elem_bytes, aggregate=aggregate)
+        t_hand = hand_written_tiered_best(tier_links, count, elem_bytes,
+                                          (L, P), aggregate=aggregate)
+        predicted[nbytes] = (t_synth, t_hand)
+        if t_synth < t_hand * (1.0 - 1e-9):
+            wins.append(nbytes)
+    if not wins:
+        return None, predicted
+    return _narrow_contiguous(wins, size_grid, spec.key, say), predicted
 
 
 def search(op: Operation, world: int, link: Any, *,
            elem_bytes: int = 4, size_grid: tuple[int, ...] = SIZE_GRID,
            aggregate: bool = False,
            log: Callable[[str], None] | None = None,
+           beam: int | None = None,
+           tiers: tuple[int, int] | None = None,
+           tier_links: Any = None,
            ) -> list[SearchResult]:
-    """The full synthesize -> certify -> score loop for one (op, world).
+    """The full synthesize -> score -> prune -> certify loop for one
+    (op, world) — flat by default, or the factored space for one
+    (inner, outer) factoring when `tiers` is given (then `tier_links`
+    supplies the per-tier scoring calibration).
 
-    Every candidate that survives enumeration pruning is CERTIFIED with
-    the existing stack before it is scored; a candidate with any
-    diagnostic is discarded LOUDLY (reported through `log`) and can
-    never reach the library. Certified candidates are scored per
-    size-grid cell against the best hand-written prediction; a
-    candidate wins a cell only by strict inequality. Winners are
-    returned with their contiguous winning window."""
+    Candidates are SCORED FIRST with the alpha-beta model (per-tier
+    charged for tiered candidates) — the model's exact serial cost of
+    the emitted DAG, so pruning on it is admissible (see module
+    docstring) — and only the survivors pay certification: losers are
+    reported as keep-outs without ever instantiating a DAG, and
+    `beam` keeps only the beam best predicted advantages (ranked by
+    best hand/synth ratio over the window; ties break to key order so
+    the prune is deterministic). Every survivor is then CERTIFIED with
+    the existing stack; a candidate with any diagnostic is discarded
+    LOUDLY (reported through `log`) and can never reach the library.
+    Winners are returned in enumeration order with their contiguous
+    winning windows."""
     say = log or (lambda m: None)
+    if tiers is not None and op != Operation.allreduce:
+        raise SynthesisError(
+            f"the tiered families implement allreduce only; a tiered "
+            f"{op.name} search has no candidates to return (and must "
+            "not silently hand back allreduce schedules)")
+    if tiers is not None and tier_links is None:
+        raise SynthesisError(
+            "tiered search needs tier_links (per-tier scoring "
+            "calibration): pass timing.TierLinks or run "
+            "bench.py --hier-gate to ship one")
+    scored: list[tuple[SynthSpec, tuple[int, int],
+                       dict[int, tuple[float, float]], float]] = []
+    cands = (enumerate_tiered_candidates(world, tiers)
+             if tiers is not None else enumerate_candidates(op, world))
+    for spec in cands:
+        if spec.tiers:
+            window, predicted = score_window_tiered(
+                tier_links, spec, elem_bytes=elem_bytes,
+                size_grid=size_grid, aggregate=aggregate, log=say)
+        else:
+            window, predicted = score_window(
+                link, spec, elem_bytes=elem_bytes, size_grid=size_grid,
+                aggregate=aggregate, log=say)
+        if window is None:
+            say(f"keep-out {spec.key}: never beats the hand-written "
+                "baselines on this link (pruned before certification)")
+            continue
+        advantage = max(
+            hand / synth
+            for nb, (synth, hand) in predicted.items()
+            if window[0] <= nb <= window[1] and synth > 0)
+        scored.append((spec, window, predicted, advantage))
+    if beam is not None and len(scored) > beam:
+        ranked = sorted(scored, key=lambda s: (-s[3], s[0].key))
+        kept = {id(s) for s in ranked[:beam]}
+        for spec, _w, _p, adv in ranked[beam:]:
+            say(f"PRUNE {spec.key}: outside the beam of {beam} "
+                f"(predicted advantage {adv:.2f}x) — never certified")
+        scored = [s for s in scored if id(s) in kept]
     results: list[SearchResult] = []
-    for spec in enumerate_candidates(op, world):
+    for spec, window, predicted, _adv in scored:
         ok, diags = certify_spec(spec)
         if not ok:
             say(f"DISCARD {spec.key}: candidate failed certification: "
                 + "; ".join(str(d) for d in diags[:4]))
-            continue
-        window, predicted = score_window(
-            link, spec, elem_bytes=elem_bytes, size_grid=size_grid,
-            aggregate=aggregate, log=say)
-        if window is None:
-            say(f"keep-out {spec.key}: certified clean but never beats "
-                "the hand-written zoo on this link")
             continue
         dag = instantiate(spec, canonical_count(spec))
         results.append(SearchResult(
@@ -841,16 +1446,21 @@ def library() -> dict[str, LibraryEntry]:
 
 
 def select_entry(op: Operation, world: int, payload_bytes: int,
-                 wire: str = "") -> str | None:
+                 wire: str = "",
+                 tiers: tuple[int, ...] = ()) -> str | None:
     """The library entry `plan.select_algorithm` should use for this
-    cell, or None. Among matching entries the one whose predicted
-    winning window contains the payload wins; ties break to the
-    narrower window (the more specialized schedule), then key order —
-    all deterministic."""
+    cell, or None. `tiers=()` (the default) matches only FLAT entries —
+    the synth registers' uniform-link windows; `tiers=(inner, outer)`
+    matches only the tiered entries of that exact factoring (the
+    HIER_ALLREDUCE_MIN_COUNT window's predicted-time arbitration).
+    Among matching entries the one whose predicted winning window
+    contains the payload wins; ties break to the narrower window (the
+    more specialized schedule), then key order — all deterministic."""
     best: LibraryEntry | None = None
     for entry in library().values():
         s = entry.spec
-        if s.op != op.name or s.world != world or s.wire != wire:
+        if (s.op != op.name or s.world != world or s.wire != wire
+                or s.tiers != tuple(tiers)):
             continue
         lo, hi = entry.win_bytes
         if not (lo <= payload_bytes <= hi):
@@ -907,17 +1517,37 @@ def shipped_link() -> Any:
             f"(needed to re-validate library win_bytes): {e!r}") from e
 
 
+def shipped_tier_links() -> Any:
+    """TierLinks from the committed calibrated timing model's
+    `link_tiers` section (written by bench.py --hier-gate) — the
+    scoring calibration tiered library entries are verified under.
+    Raises loudly when absent: a library with tiered entries and no
+    shipped per-tier calibration cannot be re-validated."""
+    from ..telemetry.feedback import default_tier_links
+
+    tiers = default_tier_links()
+    if tiers is None:
+        raise SynthesisError(
+            "the shipped timing model carries no link_tiers (needed to "
+            "re-validate tiered library windows) — run "
+            "bench.py --hier-gate to calibrate the two-tier world")
+    return tiers
+
+
 def verify_library(log: Callable[[str], None] | None = None,
-                   link: Any = None) -> bool:
+                   link: Any = None, tier_links: Any = None) -> bool:
     """Re-certify every committed entry from scratch: the spec must
     regenerate the committed DAG byte-for-byte (generator drift check),
     the DAG must pass semantics + deep modelcheck clean, and the
     committed win_bytes window must equal a fresh `score_window` under
     `link` (default: the shipped calibrated model) — a timing-model or
     cost-model change that leaves stale selection windows fails here
-    instead of silently steering `select_entry`. The CI step that keeps
-    a stale library or a checker change from silently shipping an
-    uncertified schedule."""
+    instead of silently steering `select_entry`. TIERED entries
+    re-score under `tier_links` (default: the shipped `link_tiers`
+    calibration, never the flat link — their windows are per-tier
+    predictions against the striped composition). The CI step that
+    keeps a stale library or a checker change from silently shipping
+    an uncertified schedule."""
     say = log or print
     ok = True
     entries = library()
@@ -941,7 +1571,12 @@ def verify_library(log: Callable[[str], None] | None = None,
                 + "; ".join(str(d) for d in diags[:4]))
             ok = False
             continue
-        window, _ = score_window(link, entry.spec)
+        if entry.spec.tiers:
+            if tier_links is None:
+                tier_links = shipped_tier_links()
+            window, _ = score_window_tiered(tier_links, entry.spec)
+        else:
+            window, _ = score_window(link, entry.spec)
         if window != entry.win_bytes:
             say(f" FAIL {key}: committed win_bytes "
                 f"{list(entry.win_bytes)} != fresh scoring "
@@ -949,9 +1584,11 @@ def verify_library(log: Callable[[str], None] | None = None,
                 "link (stale selection window — re-export the library)")
             ok = False
             continue
+        tier_note = (f", tiers {entry.spec.tiers[0]}x"
+                     f"{entry.spec.tiers[1]}" if entry.spec.tiers else "")
         say(f"  ok  {key}: regenerates + certifies clean, win window "
             f"current ({len(committed.nodes)} nodes, "
-            f"world {entry.spec.world})")
+            f"world {entry.spec.world}{tier_note})")
     return ok
 
 
@@ -1273,13 +1910,53 @@ def _lower_generic(dag: HopDag, axis_name: str) -> Callable[[Any], Any]:
     return body
 
 
+def _check_tier_layout(dag: HopDag, spec: SynthSpec) -> None:
+    """Cross-check the spec's tier annotation against the emitted DAG:
+    every hop's (rank -> peer) send pairs must be EXACTLY the RankMap
+    ring permutation of its annotated (tier, distance) — the
+    `ring=(pos, perm)` embedding the compiled ppermute uses and the
+    per-tier cost accounting charges. A mismatch means the annotation
+    would charge (or compile) the hop on the wrong tier: FATAL, never
+    a fallback — a mis-annotated hop would silently bill DCN traffic
+    to ICI."""
+    from .hierarchical import RankMap
+
+    L, P = spec.tiers
+    rm = RankMap(L, P, "outer_major")
+    layout = hop_layout(spec)
+    pairs: dict[int, set[tuple[int, int]]] = {}
+    for n in dag.nodes:
+        if n.kind == "send":
+            pairs.setdefault(n.hop, set()).add((n.rank, n.peer))
+    if sorted(pairs) != list(range(len(layout))):
+        raise SynthesisError(
+            f"{spec.key}: DAG hops {sorted(pairs)} do not match the "
+            f"tier annotation's {len(layout)} channels")
+    for h, (tier, d) in enumerate(layout):
+        want = set(rm.inner_perm(d) if tier == "inner"
+                   else rm.outer_perm(d))
+        if pairs[h] != want:
+            raise SynthesisError(
+                f"{spec.key}: hop {h} send pairs are not the {tier} "
+                f"ring permutation at distance {d} — the tier "
+                "annotation disagrees with the emitted DAG")
+
+
 def lower_plan(plan: Any, options: Any, world: int,
                axis_name: str) -> tuple[Callable[[Any], Any], int]:
     """The ScheduleCompiler._body seam for Algorithm.SYNTHESIZED plans:
     resolve the plan's library entry, regenerate the DAG at the call's
     count, and lower it. Raises loudly when the key is missing or the
     entry's world disagrees — a synthesized plan must never silently
-    fall back to a different schedule."""
+    fall back to a different schedule.
+
+    Tiered entries validate their hop annotation against the RankMap
+    ring permutations first (`_check_tier_layout`) and then compile
+    through the generic same-rank-dataflow lowering, whose per-hop
+    `ppermute` perm is built from the DAG's sends — i.e. exactly the
+    validated `inner_perm`/`outer_perm` global pairs of the PR 8
+    `ring=(pos, perm)` embedding: inner hops stay within a slice,
+    outer hops cross, one compiled program either way."""
     entry = entry_for_key(plan.synth_key)
     spec = entry.spec
     if spec.world != world:
@@ -1293,11 +1970,18 @@ def lower_plan(plan: Any, options: Any, world: int,
     func = ("max" if ReduceFunction(options.function)
             == ReduceFunction.MAX else "sum")
     count = int(options.count)
-    if spec.family == "rs_ag" and count % world:
-        # chunked families pad to a world multiple and trim, the same
-        # rule allreduce_ring_schedule applies per segment
-        padded = count + (-count) % world
+    chunk_by = 0
+    if spec.tiers:
+        chunk_by = spec.tiers[0] * spec.tiers[1]
+    elif spec.family == "rs_ag":
+        chunk_by = world
+    if chunk_by and count % chunk_by:
+        # chunked families pad to a chunking multiple and trim, the
+        # same rule allreduce_ring_schedule applies per segment
+        padded = count + (-count) % chunk_by
         dag = instantiate(spec, padded, func)
+        if spec.tiers:
+            _check_tier_layout(dag, spec)
         inner = lower_dag(dag, axis_name)
 
         def body(x: Any) -> Any:
@@ -1308,4 +1992,6 @@ def lower_plan(plan: Any, options: Any, world: int,
 
         return body, 1
     dag = instantiate(spec, count, func)
+    if spec.tiers:
+        _check_tier_layout(dag, spec)
     return lower_dag(dag, axis_name), 1

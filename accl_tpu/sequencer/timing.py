@@ -570,6 +570,28 @@ def best_stripes(
     return best_s
 
 
+def predict_synth_tiered(
+    links: TierLinks,
+    plan: Plan,
+    count: int,
+    elem_bytes: int,
+    *,
+    aggregate: bool = False,
+) -> float:
+    """Per-tier prediction for a SYNTHESIZED plan whose library entry
+    is TIERED (synthesis.SynthSpec.tiers): every hop charged against
+    its own TierLinks entry — the hier_phase_costs accounting
+    generalized to tier-annotated hop-DAGs. The flat
+    coefficients/predict path keeps charging both tiers to one link
+    for single-link consumers (facade prediction, refit sampling);
+    this is the calibrated form selection arbitrates with inside the
+    HIER_ALLREDUCE_MIN_COUNT window."""
+    from .synthesis import entry_for_key, predict_spec_tiered
+
+    return predict_spec_tiered(links, entry_for_key(plan.synth_key).spec,
+                               count, elem_bytes, aggregate=aggregate)
+
+
 def predict_overlapped(
     params: LinkParams,
     plan: Plan,
@@ -886,6 +908,11 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
     # auto-substitutes them (they are not rank-consistent — see the
     # synthesized branch in plan.select_algorithm), so the register
     # must describe exactly the fp32 window selection will honor.
+    # Tiered entries are excluded too: their windows are PER-TIER
+    # predictions against the striped composition, selected through
+    # the HIER_ALLREDUCE_MIN_COUNT window's arbitration — scoring them
+    # on this uniform link would claim a win the calibration never
+    # measured.
     from . import synthesis as _synth
 
     synth_regs: dict[str, int] = {}
@@ -894,7 +921,7 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                          ("reduce_scatter", Operation.reduce_scatter)):
         entries = [e for e in _synth.library().values()
                    if e.spec.op == op_key and e.spec.world == P
-                   and not e.spec.wire]
+                   and not e.spec.wire and not e.spec.tiers]
         best_bytes = 0
         if entries:
             sbytes = 1 << 10

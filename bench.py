@@ -1726,6 +1726,41 @@ def _hier_gate_main():
     print(f"  hier crossover window: >= {hier_window} B",
           file=sys.stderr)
 
+    # The pod-scale synthesis leg (ROADMAP item 3): under THIS run's
+    # refit per-tier calibration — the emulated 2-tier world's own
+    # measured links — a committed tiered library entry must beat the
+    # hand-written striped composition (best stripe count per size, the
+    # strongest hand-written two-tier opponent) at >= 1 size. Scored in
+    # the aggregate shape the spans were fitted in, the same posture as
+    # the measured/predicted legs above; the measured-on-mesh twin is
+    # bench --check's allreduce_synth_tier cell.
+    from accl_tpu.sequencer import synthesis as _synth
+
+    synth_tier_rows = []
+    for nbytes in sizes:
+        cnt = nbytes // 4
+        key = _synth.select_entry(Operation.allreduce, world, nbytes,
+                                  tiers=(inner, pods))
+        if key is None:
+            synth_tier_rows.append({"bytes": nbytes, "entry": None})
+            continue
+        spec = _synth.entry_for_key(key).spec
+        t_st = _synth.predict_spec_tiered(tiers, spec, cnt, 4,
+                                          aggregate=True)
+        s_h = best_stripes(tiers, cnt, 4, inner, pods, aggregate=True)
+        hplan = Plan(Protocol.EAGER, Algorithm.HIER_RS_AR_AG, cnt, 1,
+                     inner_world=inner, outer_world=pods, stripes=s_h)
+        t_hw = predict_tiered(tiers, hplan, cnt, 4, aggregate=True)
+        synth_tier_rows.append({
+            "bytes": nbytes, "entry": key,
+            "predicted_synth_s": t_st,
+            "predicted_hand_striped_s": t_hw,
+            "predicted_ratio": t_hw / t_st})
+        print(f"  synth-tier {nbytes:>8d} B: {key} "
+              f"{t_st * 1e6:9.1f} us vs striped composition "
+              f"{t_hw * 1e6:9.1f} us ({t_hw / t_st:5.2f}x predicted "
+              "under the refit tier links)", file=sys.stderr)
+
     # persist the per-tier fit for default_tier_links consumers
     # (ACCL.autotune, bench --check's hier cell, plan stripe selection)
     model_path = outdir / "timing_model.json"
@@ -1745,6 +1780,8 @@ def _hier_gate_main():
     wins = [r for r in per_size
             if r["measured_ratio"] > 1.0 and r["predicted_ratio"] > 1.0]
     best = max((r["measured_ratio"] for r in per_size), default=0.0)
+    synth_wins = [r for r in synth_tier_rows
+                  if r.get("predicted_ratio", 0.0) > 1.0]
     print(json.dumps({
         "metric": "hierarchical allreduce vs flat TCP ring, emulated "
                   f"2-tier world ({pods} pods x {inner}, local POE "
@@ -1755,6 +1792,7 @@ def _hier_gate_main():
         "sizes": per_size,
         "hier_crossover_min_bytes": hier_window,
         "tier_links": model["link_tiers"],
+        "synth_tier": synth_tier_rows,
     }))
     if not wins:
         print("FAIL: hierarchical allreduce beat the flat ring at NO "
@@ -1767,6 +1805,15 @@ def _hier_gate_main():
               "HIER_ALLREDUCE_MIN_COUNT window (hier never predicts "
               "faster than flat) — autotune could never enable the "
               "composition", file=sys.stderr)
+        sys.exit(1)
+    if not synth_wins:
+        print("FAIL: no committed tiered synthesized entry beats the "
+              "hand-written striped composition at any size under "
+              "THIS world's refit per-tier calibration — the "
+              "pod-scale synthesis claim does not hold (re-run "
+              "tools/accl_synth.py --export --tiers "
+              f"{inner}x{pods} if the calibration legitimately moved)",
+              file=sys.stderr)
         sys.exit(1)
 
 
@@ -1958,6 +2005,21 @@ def _check_sections(jax):
     # the window genuinely moves above it (then the cell follows the
     # window and the baseline is re-written deliberately)
     hier_nb = max(tuning_hier.hier_allreduce_min_count, 1 << 19)
+    # the tiered synthesized cell needs a committed library entry for
+    # this factoring whose window covers the hier cell's payload, and
+    # the in-window arbitration must actually pick it at that payload
+    # under the shipped per-tier calibration — both are selection
+    # preconditions like the register checks above
+    from accl_tpu.sequencer import synthesis as _synth_mod
+
+    if _synth_mod.select_entry(Operation.allreduce, world, hier_nb,
+                               tiers=hier_topo) is None:
+        raise SystemExit(
+            f"FAIL: allreduce_synth_tier cell unavailable: no "
+            f"committed tiered library entry serves "
+            f"({hier_topo[0]}x{hier_topo[1]}, {hier_nb} B) — run "
+            "tools/accl_synth.py --export --tiers "
+            f"{hier_topo[0]}x{hier_topo[1]}")
     cells = [
         dict(name="allreduce_hand", op=Operation.allreduce, nbytes=4096,
              tuning=tuning_hand, expect="hand"),
@@ -1980,11 +2042,34 @@ def _check_sections(jax):
         dict(name="allreduce_flat_hier_twin", op=Operation.allreduce,
              nbytes=hier_nb, tuning=tuning_hand, expect="hand",
              rounds=6, warm=2, refit=False),
+        # tiered_ok=False: the hand-written striped composition is now
+        # the SLOW TWIN of the tiered synthesized cell below, so this
+        # cell pins the composition through the twin-measurement
+        # escape (select_algorithm tiered_synth_ok=False) the way
+        # tuning_hand pins the hand cells — through the register path
+        # the in-window arbitration would otherwise resolve away
+        # rounds=24 on the two fast two-tier cells (a dispatch costs
+        # ~4 ms here, unlike their 1.4 s/dispatch flat twin): their
+        # gate ratio margin is ~1.15x, which a 6-round median
+        # demonstrably flaked through on this CPU-share-throttled host
         dict(name="allreduce_hier", op=Operation.allreduce,
              nbytes=hier_nb, tuning=tuning_hier, expect="hier",
-             topology=hier_topo, rounds=6, warm=2, refit=False,
+             topology=hier_topo, rounds=24, warm=2, refit=False,
+             tiered_ok=False,
              gate=("allreduce_flat_hier_twin", 10.0,
                    "hier_allreduce_beats_flat")),
+        # the pod-scale synthesis claim (ROADMAP item 3): inside the
+        # SAME register window at the SAME payload, the in-window
+        # arbitration must pick the committed tiered hop-DAG over the
+        # striped composition by predicted time, and the compiled
+        # tiered program must at least match the composition measured
+        # (its log-step phases move the same slow-tier bytes in fewer
+        # hops; the shaped-link predicted margin is --hier-gate's leg)
+        dict(name="allreduce_synth_tier", op=Operation.allreduce,
+             nbytes=hier_nb, tuning=tuning_hier, expect="synth_tier",
+             topology=hier_topo, rounds=24, warm=2, refit=False,
+             gate=("allreduce_hier", 1.0,
+                   "synth_tier_matches_hier")),
     ]
     synth_cells = [(c["name"], c["op"], c["nbytes"], c["gate"][1])
                    for c in cells
@@ -1996,15 +2081,26 @@ def _check_sections(jax):
         count = max(nbytes // 4, 1)
         sel_kw = dict(kw)
         if c.get("topology") is not None:
-            sel_kw.update(topology=c["topology"], tier_links=tiers)
+            sel_kw.update(topology=c["topology"], tier_links=tiers,
+                          tiered_synth_ok=c.get("tiered_ok", True))
         plan = select_algorithm(op, count, 4, world, tuning=c["tuning"],
                                 **sel_kw)
         want = {"synth": Algorithm.SYNTHESIZED,
+                "synth_tier": Algorithm.SYNTHESIZED,
                 "hier": Algorithm.HIER_RS_AR_AG}.get(c["expect"])
         if want is not None and plan.algorithm != want:
             raise SystemExit(
                 f"FAIL: {name}/w{world}/{nbytes}: measured crossovers "
                 f"did not select {want.name} (got {plan.algorithm.name})")
+        if c["expect"] == "synth_tier":
+            from accl_tpu.sequencer import synthesis as _sm
+
+            spec = _sm.entry_for_key(plan.synth_key).spec
+            if tuple(spec.tiers) != tuple(c["topology"]):
+                raise SystemExit(
+                    f"FAIL: {name}/w{world}/{nbytes}: arbitration "
+                    f"selected {plan.synth_key}, not a "
+                    f"{c['topology']} tiered entry")
         if want is None and plan.algorithm in (Algorithm.SYNTHESIZED,
                                                Algorithm.HIER_RS_AR_AG):
             raise SystemExit(
@@ -2424,10 +2520,20 @@ def _tpu_reachable_backoff(attempts=(20, 40, 90), cache_ttl_s=900.0) -> bool:
     suite, the full sweep, and the timing-model refresh back to back)
     reads the cached verdict instead of re-paying a multi-minute hang;
     a cache older than cache_ttl_s re-probes, since tunnels do recover
-    (tools/tpu_probe_loop.py exists to catch exactly that)."""
+    (tools/tpu_probe_loop.py exists to catch exactly that).
+
+    The verdict is keyed by the JAX_PLATFORMS environment too, not TTL
+    alone: a forced-CPU invocation (JAX_PLATFORMS=cpu) probes and
+    caches ok=False by construction, and without the key a real-TPU
+    run inside the TTL would read that poisoned verdict and silently
+    fall back — every artifact's `platform` field would claim
+    cpu-fallback on a healthy chip. A cache written under a different
+    JAX_PLATFORMS is ignored and re-probed."""
+    plat_env = os.environ.get("JAX_PLATFORMS", "")
     try:
         c = json.loads(_PROBE_CACHE.read_text())
-        if time.time() - float(c["ts"]) < cache_ttl_s:
+        if (time.time() - float(c["ts"]) < cache_ttl_s
+                and c.get("jax_platforms", "") == plat_env):
             print(f"TPU probe: cached verdict ok={c['ok']} "
                   f"({time.time() - c['ts']:.0f}s old)", file=sys.stderr)
             return bool(c["ok"])
@@ -2444,7 +2550,8 @@ def _tpu_reachable_backoff(attempts=(20, 40, 90), cache_ttl_s=900.0) -> bool:
               f"(timeout {t}s): {detail.splitlines()[0]}", file=sys.stderr)
     _PROBE_CACHE.parent.mkdir(exist_ok=True)
     try:
-        _PROBE_CACHE.write_text(json.dumps({"ok": ok, "ts": time.time()}))
+        _PROBE_CACHE.write_text(json.dumps(
+            {"ok": ok, "ts": time.time(), "jax_platforms": plat_env}))
     except OSError:
         pass  # probe verdict is still good for this process
     return ok

@@ -751,8 +751,23 @@ def test_hier_fused_vs_eager_bitwise(seed):
                     function=int(ReduceFunction.SUM),
                     data_type=from_numpy_dtype(np.dtype(np.float32))),
         dev._comm_ctx(0))
-    assert plan.algorithm == Algorithm.HIER_RS_AR_AG, \
-        f"seed {seed}: register window did not engage ({plan.algorithm})"
+    # the register window engages the TWO-TIER path: the striped
+    # composition, or — at the (2, 4) factoring, where the committed
+    # tiered library serves the payload — the tiered synthesized
+    # hop-DAG the in-window arbitration picks instead (ISSUE 12); the
+    # (4, 2) seeds keep fuzzing the composition itself, so BOTH
+    # two-tier forms stay covered through the full facade path
+    if plan.algorithm == Algorithm.SYNTHESIZED:
+        from accl_tpu.sequencer import synthesis
+
+        assert (inner, outer) == (2, 4), \
+            f"seed {seed}: unexpected tiered entry at ({inner}x{outer})"
+        assert synthesis.entry_for_key(plan.synth_key).spec.tiers == \
+            (inner, outer)
+    else:
+        assert plan.algorithm == Algorithm.HIER_RS_AR_AG, \
+            f"seed {seed}: register window did not engage " \
+            f"({plan.algorithm})"
 
     init = rng.integers(-50, 50, (world, n)).astype(np.float32)
     eager_in = accl.create_buffer(n, data=init)

@@ -172,18 +172,29 @@ def test_hier_register_window_selects_composition():
     """Inside the window (payload >= min) with a matching topology the
     striped composition is selected, tier wires riding the plan; below
     the min, without a topology, or with a non-factoring topology the
-    flat selection stands."""
+    flat selection stands. Pinned at the (4, 2) factoring, which has
+    NO committed tiered library entry — the old unconditional-
+    composition behavior must survive exactly there (the other order
+    is test_hier_window_arbitrates_tiered_synth)."""
     from accl_tpu.constants import DataType
 
     t = TuningParams(hier_allreduce_min_count=4096)
-    p = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
+    p = sel(Operation.allreduce, 1024, tuning=t, topology=(4, 2),
             tier_links=_tier_links(),
             tier_wires=(DataType.none, DataType.int8))
     assert p.algorithm == Algorithm.HIER_RS_AR_AG
-    assert (p.inner_world, p.outer_world) == (2, 4)
+    assert (p.inner_world, p.outer_world) == (4, 2)
     assert p.outer_wire_dtype == DataType.int8
     assert p.inner_wire_dtype == DataType.none
     assert p.stripes >= 1
+    # the (2, 4) factoring HAS committed tiered entries; the
+    # twin-measurement escape must still pin the composition there
+    pe = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
+             tier_links=_tier_links(),
+             tier_wires=(DataType.none, DataType.int8),
+             tiered_synth_ok=False)
+    assert pe.algorithm == Algorithm.HIER_RS_AR_AG
+    assert pe.outer_wire_dtype == DataType.int8
     # below the min-bytes threshold: flat
     assert sel(Operation.allreduce, 512, tuning=t, topology=(2, 4),
                tier_links=_tier_links()).algorithm != \
@@ -197,19 +208,41 @@ def test_hier_register_window_selects_composition():
         Algorithm.HIER_RS_AR_AG
 
 
-def test_hier_takes_precedence_over_synth_window():
-    """With BOTH the synth and hier windows open, a declared two-tier
-    topology selects the hierarchical composition: the synth library's
-    windows were calibrated on a uniform link and its flat hop-DAGs
-    would drag full payloads across the slow tier."""
+def test_hier_window_arbitrates_tiered_synth():
+    """BOTH selection orders of the hier window, pinned (the ISSUE 12
+    precedence fix): with a committed TIERED entry serving the cell,
+    the arbitration is by predicted time under the per-tier
+    calibration — the tiered hop-DAG displaces the striped composition
+    where it predicts faster; with no tiered entry for the factoring
+    (or the twin escape), the old composition-wins behavior is
+    bit-for-bit preserved. The flat synth window keeps governing
+    topology-free callers."""
+    from accl_tpu.sequencer import synthesis
+
     t = TuningParams(synth_allreduce_max_count=1 << 20,
                      hier_allreduce_min_count=1)
+    # (2, 4): a committed tiered entry covers 4 KiB and predicts
+    # faster than the composition on the fast-inner/slow-outer links
+    # (fewer slow-tier messages, same slow-tier bytes)
     p = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
             tier_links=_tier_links())
-    assert p.algorithm == Algorithm.HIER_RS_AR_AG
-    # same tuning, no topology: the synth window governs as before
+    assert p.algorithm == Algorithm.SYNTHESIZED
+    spec = synthesis.entry_for_key(p.synth_key).spec
+    assert spec.tiers == (2, 4)
+    assert (p.inner_world, p.outer_world) == (2, 4)
+    # the twin escape pins the composition at the same cell
+    p_esc = sel(Operation.allreduce, 1024, tuning=t, topology=(2, 4),
+                tier_links=_tier_links(), tiered_synth_ok=False)
+    assert p_esc.algorithm == Algorithm.HIER_RS_AR_AG
+    # (4, 2): no committed tiered entry -> old behavior preserved
+    p42 = sel(Operation.allreduce, 1024, tuning=t, topology=(4, 2),
+              tier_links=_tier_links())
+    assert p42.algorithm == Algorithm.HIER_RS_AR_AG
+    # same tuning, no topology: the flat synth window governs as
+    # before and never selects a tiered entry
     p2 = sel(Operation.allreduce, 1024, tuning=t)
     assert p2.algorithm == Algorithm.SYNTHESIZED
+    assert not synthesis.entry_for_key(p2.synth_key).spec.tiers
 
 
 def test_hier_only_exact_unstreamed_calls():

@@ -649,7 +649,11 @@ def test_facade_autotune_sets_hier_register_and_tier_wires(mesh8):
     assert dev.hier_wires[1] == DataType.int8  # slow outer compresses
     assert dev.hier_wires[0] == DataType.none  # fast inner stays exact
 
-    cnt = max(applied.hier_allreduce_min_count // 4, 1) * 2
+    # 32 MiB payload: beyond every SIZE_GRID window, so the in-window
+    # tiered-entry arbitration is inapplicable and the cell pins the
+    # COMPOSITION carrying the arbitrated wires (the arbitration
+    # itself is pinned in test_plan_selection)
+    cnt = max(applied.hier_allreduce_min_count // 4, 1 << 23)
     plan, _, _ = dev._resolve_step(
         CallOptions(scenario=Operation.allreduce, count=cnt, function=0,
                     data_type=DataType.float32), dev._comm_ctx(0))

@@ -412,6 +412,18 @@ def run_schedules(deep: bool = False, sample: int = 0,
                     configs.append((world, Operation.allreduce, 0, count,
                                     "hier", hier_tuning, DataType.none,
                                     ("hier", (L, P), tw, stripes)))
+    # tiered synthesized cells (sequencer/synthesis.py factored
+    # families): the committed tiered hop-DAG selected through the
+    # REAL in-window arbitration (the hier register + a declared
+    # topology + the predicted-time tie-break against the striped
+    # composition) — its lowered body must interpret, model-check and
+    # certify exactly like the composition it displaces. Config tuples
+    # grow a trailing ("synth_tier", topology) extra; the plain hier
+    # rows above pin the composition itself via tiered_synth_ok=False.
+    for world, topo, count in ((8, (2, 4), 8192), (8, (2, 4), 65536)):
+        configs.append((world, Operation.allreduce, 0, count,
+                        "synth_tier", hier_tuning, DataType.none,
+                        ("synth_tier", topo)))
     if sample and sample < len(configs):
         # deterministic slice: every ceil(total/sample)-th config, so
         # the CI subset is stable across runs and spans all families
@@ -428,6 +440,8 @@ def run_schedules(deep: bool = False, sample: int = 0,
             else None
         olap = extra[1] if extra is not None and extra[0] == "olap" \
             else None
+        synth_tier = (extra[1] if extra is not None
+                      and extra[0] == "synth_tier" else None)
         from accl_tpu.constants import CompressionFlags
 
         rsd = root if scen != Operation.send \
@@ -442,17 +456,32 @@ def run_schedules(deep: bool = False, sample: int = 0,
             compress_dtype=wire, compression_flags=comp_flags,
             peer_counts=a2av or ())
         hier_kw: dict = {}
+        if hier is not None or synth_tier is not None:
+            from accl_tpu.sequencer.timing import LinkParams, TierLinks
         if hier is not None:
             topo, tier_wires, stripes = hier
-            from accl_tpu.sequencer.timing import LinkParams, TierLinks
 
             # a representative fast-inner/slow-outer calibration: only
             # the stripe count depends on it, and the sweep pins the
-            # depth explicitly below
+            # depth explicitly below. tiered_synth_ok=False pins the
+            # COMPOSITION through the twin-measurement escape — the
+            # in-window arbitration would otherwise resolve these
+            # cells to the committed tiered entries, which have their
+            # own synth_tier rows below
             hier_kw = dict(topology=topo, tier_wires=tier_wires,
+                           tiered_synth_ok=False,
                            tier_links=TierLinks(
                                inner=LinkParams(2e-6, 2e9),
                                outer=LinkParams(30e-6, 0.25e9)))
+        if synth_tier is not None:
+            # a WAN-class outer link (the hier-gate's shaped regime):
+            # per-message latency on the slow tier dominates, which is
+            # exactly where the log-step tiered entries displace the
+            # striped composition in the arbitration
+            hier_kw = dict(topology=synth_tier,
+                           tier_links=TierLinks(
+                               inner=LinkParams(2e-6, 2e9),
+                               outer=LinkParams(300e-6, 0.25e9)))
         olap_kw: dict = {}
         if olap is not None:
             from accl_tpu.sequencer.timing import (ComputeFit,
@@ -488,6 +517,11 @@ def run_schedules(deep: bool = False, sample: int = 0,
             assert plan.algorithm.name == "HIER_RS_AR_AG", \
                 f"hier config did not select the composition: {plan}"
             plan = _dc.replace(plan, stripes=hier[2])
+        if synth_tier is not None:
+            assert plan.algorithm.name == "SYNTHESIZED" \
+                and plan.synth_key, \
+                f"synth_tier config did not arbitrate to a tiered " \
+                f"entry: {plan}"
         # trace each schedule body ONCE (the dominant cost): the hops
         # feed the per-config interpretation AND, under --deep, the
         # exhaustive-interleaving checker

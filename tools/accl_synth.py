@@ -5,8 +5,9 @@ manage the committed synthesized-schedule library
 
 Modes:
 
-  --search            run the synthesize -> certify -> score loop for
-                      every (op, world) in --ops/--worlds and print the
+  --search            run the synthesize -> score -> prune -> certify
+                      loop for every (op, world) in --ops/--worlds
+                      (plus every --tiers factoring) and print the
                       winner table (no files written)
   --export            like --search, but write every winner to the
                       library directory and prune in-scope entries
@@ -21,13 +22,27 @@ Modes:
                       DAG must pass the semantic certifier + deep
                       model checker clean, and the committed win_bytes
                       window must match fresh scoring under the link
-                      (the CI gate that keeps a stale library, stale
-                      selection window, or a checker change from
+                      — TIERED entries re-score under the shipped
+                      link_tiers per-tier calibration, never the flat
+                      link (the CI gate that keeps a stale library,
+                      stale selection window, or a checker change from
                       silently shipping an uncertified schedule)
+
+  --tiers LxP [...]   factored topologies to search (e.g. 2x4 4x4):
+                      each searches the tier-annotated hop-DAG space
+                      over inner=L x outer=P, scored per tier against
+                      the striped hand-written composition under the
+                      shipped link_tiers calibration
+  --beam N            certify only the N best predicted advantages per
+                      (op, world) cell (branch-and-bound: losers are
+                      pruned on the admissible alpha-beta bound BEFORE
+                      any certification is paid; default: certify
+                      every candidate with a non-empty window)
 
 The scoring link defaults to the committed calibrated timing model
 (accl_log/timing_model.json, bcast row — the same link ACCL.autotune
-reads); --alpha-us/--beta-gbps override it.
+reads); --alpha-us/--beta-gbps override it. Tiered scoring reads the
+same model's link_tiers section.
 
 Exit status is 0 only when every requested gate holds.
 """
@@ -79,6 +94,28 @@ def load_link(args) -> LinkParams:
         raise SystemExit(f"{args.timing_model}: {e}") from e
 
 
+def parse_tiers(specs: list[str]) -> list[tuple[int, int]]:
+    out = []
+    for s in specs:
+        try:
+            L, P = (int(x) for x in s.lower().split("x"))
+        except ValueError:
+            raise SystemExit(f"--tiers wants LxP (e.g. 2x4), got {s!r}")
+        out.append((L, P))
+    return out
+
+
+def load_tier_links(args):
+    from accl_tpu.telemetry.feedback import default_tier_links
+
+    tiers = default_tier_links(args.timing_model)
+    if tiers is None:
+        raise SystemExit(
+            f"{args.timing_model} carries no link_tiers (needed to "
+            "score tiered candidates) — run bench.py --hier-gate")
+    return tiers
+
+
 def run_search(args, export: bool) -> bool:
     link = load_link(args)
     print(f"scoring link: alpha {link.alpha * 1e6:.2f} us, "
@@ -88,6 +125,7 @@ def run_search(args, export: bool) -> bool:
     for world in args.worlds:
         for op_name in args.ops:
             results = synthesis.search(OPS[op_name], world, link,
+                                       beam=args.beam,
                                        log=lambda m: print("  " + m))
             for res in results:
                 n_winners += 1
@@ -95,22 +133,46 @@ def run_search(args, export: bool) -> bool:
                     path = synthesis.export_entry(res)
                     written.add(path.name)
                     print(f"  wrote {_rel(path)}")
+    tier_specs = parse_tiers(args.tiers or [])
+    if tier_specs:
+        tl = load_tier_links(args)
+        print(f"tier links: inner alpha {tl.inner.alpha * 1e6:.1f} us "
+              f"beta {tl.inner.beta / 1e9:.2f} GB/s / outer alpha "
+              f"{tl.outer.alpha * 1e6:.1f} us beta "
+              f"{tl.outer.beta / 1e9:.3f} GB/s")
+        for L, P in tier_specs:
+            results = synthesis.search(
+                synthesis.Operation.allreduce, L * P, link,
+                beam=args.beam, tiers=(L, P), tier_links=tl,
+                log=lambda m: print("  " + m))
+            for res in results:
+                n_winners += 1
+                if export:
+                    path = synthesis.export_entry(res)
+                    written.add(path.name)
+                    print(f"  wrote {_rel(path)}")
     print(f"{n_winners} winner(s) across worlds {args.worlds} "
-          f"x ops {args.ops}")
+          f"x ops {args.ops} + tiers {args.tiers or []}")
     if export:
         # prune in-scope entries that stopped winning: after a timing-
         # or cost-model change an entry whose fresh window is None is
         # never rewritten by the loop above, and verify_library would
         # fail it forever with advice (--export) that otherwise could
-        # not resolve the failure. Out-of-scope entries (ops/worlds not
-        # searched this run) are kept untouched.
+        # not resolve the failure. Out-of-scope entries (ops/worlds/
+        # factorings not searched this run) are kept untouched — a
+        # flat search never prunes tiered entries and vice versa.
         op_names = {OPS[o].name for o in args.ops}
+        searched_tiers = set(tier_specs)
         for p in sorted(synthesis.library_dir().glob("*.json")):
             if p.name in written:
                 continue
             spec = synthesis.SynthSpec.from_json(
                 json.loads(p.read_text()))
-            if spec.op in op_names and spec.world in args.worlds:
+            in_scope = (
+                (spec.tiers and tuple(spec.tiers) in searched_tiers)
+                or (not spec.tiers and spec.op in op_names
+                    and spec.world in args.worlds))
+            if in_scope:
                 p.unlink()
                 print(f"  pruned {_rel(p)} "
                       "(no longer wins any cell under this link)")
@@ -124,15 +186,25 @@ def run_score(args) -> bool:
     if not entries:
         print("synthesized library is empty", file=sys.stderr)
         return False
+    tl = None
+    if any(e.spec.tiers for e in entries.values()):
+        tl = load_tier_links(args)
     print(f"{'entry':44s} {'bytes':>10s} {'synth_us':>10s} "
           f"{'hand_us':>10s}  verdict")
     for key, entry in sorted(entries.items()):
         s = entry.spec
         for nbytes in synthesis.SIZE_GRID:
             count = max(nbytes // 4, 1)
-            t_s = synthesis.predict_spec(link, s, count, 4)
-            t_h = synthesis.hand_written_best(
-                link, s.scenario, count, 4, s.world, wire=s.wire)
+            if s.tiers:
+                # per-tier scoring against the striped composition —
+                # the baseline a tiered entry actually displaces
+                t_s = synthesis.predict_spec_tiered(tl, s, count, 4)
+                t_h = synthesis.hand_written_tiered_best(
+                    tl, count, 4, (s.tiers[0], s.tiers[1]))
+            else:
+                t_s = synthesis.predict_spec(link, s, count, 4)
+                t_h = synthesis.hand_written_best(
+                    link, s.scenario, count, 4, s.world, wire=s.wire)
             verdict = "WINS" if t_s < t_h else ("tie" if t_s == t_h
                                                 else "loses")
             print(f"{key:44s} {nbytes:>10d} {t_s * 1e6:>10.1f} "
@@ -151,9 +223,14 @@ def main(argv=None) -> int:
                          "the committed library")
     ap.add_argument("--verify-library", action="store_true",
                     help="re-certify every committed entry (CI gate)")
-    ap.add_argument("--worlds", type=int, nargs="+", default=[2, 4, 8])
+    ap.add_argument("--worlds", type=int, nargs="+",
+                    default=[2, 4, 8, 16])
     ap.add_argument("--ops", nargs="+", default=sorted(OPS),
                     choices=sorted(OPS))
+    ap.add_argument("--tiers", nargs="+", default=None, metavar="LxP",
+                    help="factored topologies to search, e.g. 2x4 4x4")
+    ap.add_argument("--beam", type=int, default=None,
+                    help="certify only the N best predicted advantages")
     ap.add_argument("--timing-model", default=str(DEFAULT_MODEL))
     ap.add_argument("--alpha-us", type=float, default=None)
     ap.add_argument("--beta-gbps", type=float, default=None)
@@ -168,7 +245,14 @@ def main(argv=None) -> int:
     if args.score:
         ok &= run_score(args)
     if args.verify_library:
-        ok &= synthesis.verify_library(log=print, link=load_link(args))
+        from accl_tpu.telemetry.feedback import default_tier_links
+
+        # tiered entries re-score under the SHIPPED per-tier
+        # calibration of --timing-model (verify_library falls back to
+        # the committed model's link_tiers when this resolves None)
+        ok &= synthesis.verify_library(
+            log=print, link=load_link(args),
+            tier_links=default_tier_links(args.timing_model))
     return 0 if ok else 1
 
 
