@@ -326,7 +326,7 @@ class ACCL:
                       int(opts.stream_flags))
             req = self.cclo.start(opts)
             ret = self._complete(req, sync_out, to_device, run_async)
-            if get_tracer().enabled:  # attach what the device resolved
+            if get_tracer().active:  # attach what the device resolved
                 sp.set(op=opts.scenario.name, count=opts.count,
                        retcode=req.retcode)
                 if run_async:
@@ -1140,7 +1140,7 @@ class SequenceRecorder:
                       "+".join(o.scenario.name for o in self.calls))
             req = accl.cclo.start_sequence(self.calls, lint=self._lint)
             ret = accl._complete(req, sync_out, to_device, run_async)
-            if get_tracer().enabled:
+            if get_tracer().active:
                 sp.set(n_steps=len(self.calls),
                        ops="+".join(o.scenario.name for o in self.calls))
                 if run_async:
@@ -1189,7 +1189,7 @@ class SequenceProgram:
             accl._stage_in(self._sync_in, from_device)
             req = accl.cclo.dispatch_sequence(self._prepared)
             ret = accl._complete(req, self._sync_out, to_device, run_async)
-            if get_tracer().enabled:
+            if get_tracer().active:
                 sp.set(n_steps=self.n_steps, ops=self._ops, prepared=True)
                 if run_async:
                     sp.set(dispatch_only=True)

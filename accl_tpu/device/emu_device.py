@@ -187,6 +187,9 @@ class EmuRank:
         self._lib = lib
         self._keepalive: dict[int, tuple] = {}
         self._durations: dict[int, int] = {}
+        # per-handle descriptor, so a failed wait can name the call in
+        # the flight-recorder post-mortem (popped with the keepalive)
+        self._call_opts: dict[int, CallOptions] = {}
 
     def close(self):
         if self._rt:
@@ -284,6 +287,7 @@ class EmuRank:
         # operands must outlive the call (reference: buffers owned by caller
         # until request completion, acclrequest.hpp)
         self._keepalive[h] = (op0, op1, res)
+        self._call_opts[h] = opts
         return h
 
     def wait(self, handle: int, timeout_ms: int = 0) -> None:
@@ -295,7 +299,21 @@ class EmuRank:
         self._durations[handle] = self._lib.accl_rt_duration_ns(self._rt, handle)
         self._lib.accl_rt_release(self._rt, handle)
         self._keepalive.pop(handle, None)
+        opts = self._call_opts.pop(handle, None)
         if rc:
+            # dump-on-error: report the failing call (its descriptor's
+            # op name + count, this rank, the sticky retcode) to the
+            # armed flight recorder BEFORE the typed raise, so the
+            # post-mortem names the span that died. The device trace
+            # ring is deliberately NOT drained here — consuming it
+            # would steal the wedged span from an explicit
+            # trace_read()/drain_world a caller runs after the failure.
+            from ..errors import notify_sticky_retcode
+
+            notify_sticky_retcode(
+                opts.scenario.name if opts is not None
+                else f"emu rank {self.rank}", rc, rank=self.rank,
+                count=opts.count if opts is not None else None)
             raise ACCLError(f"emu rank {self.rank}", rc)
 
     def test(self, handle: int) -> bool:

@@ -442,7 +442,7 @@ class TPUDevice(CCLODevice):
 
         req = TPURequest(options.scenario.name, [out], on_complete=place)
         req.plan = plan
-        if get_tracer().enabled:
+        if get_tracer().active:
             # the facade span drains this: every traced call carries its
             # timing.predict estimate next to the measured duration
             req.predicted_s = self._predict_call(options, plan, ctx.world)
@@ -523,7 +523,7 @@ class TPUDevice(CCLODevice):
         # followed across tracks in the exported trace. A content digest,
         # not hash(): enum hashes are PYTHONHASHSEED-salted, and the
         # signature must match across runs so archived traces correlate.
-        if tracer.enabled:
+        if tracer.active:
             import hashlib
 
             sig = hashlib.sha256(
@@ -597,7 +597,7 @@ class TPUDevice(CCLODevice):
                     buf.device = self._scatter_rows(buf.device, ctx, out)
 
         req = SequenceRequest(list(outs), list(plans), on_complete=place)
-        if tracer.enabled:
+        if tracer.active:
             # per-step marker spans: the fused program executes the steps
             # inside ONE dispatch, so each step carries its timing.predict
             # estimate (and the batch signature) rather than a host-
@@ -619,6 +619,7 @@ class TPUDevice(CCLODevice):
                     "op": o.scenario.name,
                     "count": o.count,
                     "step": i,
+                    "world": ctx.world,
                     "algorithm": p.algorithm.name,
                     "protocol": p.protocol.name,
                     "signature": sig,

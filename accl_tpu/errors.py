@@ -52,6 +52,31 @@ class DtypeMismatchError(ACCLValidationError, NotImplementedError):
     lint_code = "ACCL401"
 
 
+def notify_sticky_retcode(function_name: str, retcode: int, *,
+                          detail: int = 0, rank: int | None = None,
+                          count: int | None = None):
+    """The dump-on-error seam of the sticky-retcode contract: every
+    path that materializes a nonzero sticky error word (request
+    completion in request.py, the native EmuRank.wait) reports it here
+    BEFORE raising. The telemetry flight recorder — when armed — emits
+    an error marker span (the failing call's op name, count, rank, and
+    sticky retcode) through the span stream and freezes its
+    last-N-spans-per-track ring into a self-contained post-mortem
+    trace (telemetry.recorder.on_sticky_retcode,
+    docs/observability.md).
+
+    Never raises and costs one armed() predicate when observability is
+    off: error reporting must not mask or slow the error."""
+    try:
+        from .telemetry import recorder
+
+        return recorder.on_sticky_retcode(function_name, int(retcode),
+                                          detail=detail, rank=rank,
+                                          count=count)
+    except Exception:
+        return None
+
+
 class SequenceReuseError(RuntimeError):
     """A completed SequenceRecorder handle was reused — recording into or
     re-running an executed batch. RuntimeError subclass for backward

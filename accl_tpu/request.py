@@ -43,6 +43,13 @@ class BaseRequest:
         )
         self.status = OperationStatus.COMPLETED
         self._done.set()
+        if retcode:
+            # the sticky-error-word write point: the telemetry flight
+            # recorder (when armed) freezes its span rings into a
+            # post-mortem here, whether or not the caller ever check()s
+            from .errors import notify_sticky_retcode
+
+            notify_sticky_retcode(self.function_name, int(retcode))
 
     def wait(self, timeout: float | None = None) -> bool:
         """Block until completion; returns False on timeout (reference

@@ -37,7 +37,35 @@ EVENT_SCHEMA: dict = {
     "required": ["schema", "spans"],
     "properties": {
         "schema": {"const": SCHEMA_VERSION},
-        "meta": {"type": "object"},
+        # meta stays open, but the observability keys the always-on
+        # layer embeds are typed: a drifted registry snapshot or
+        # sentinel report fails validation instead of silently shipping
+        # a malformed metrics section in every exported trace
+        "meta": {
+            "type": "object",
+            "properties": {
+                "metrics": {
+                    "type": "object",
+                    "required": ["counters", "gauges", "histograms"],
+                    "properties": {
+                        "counters": {"type": "object"},
+                        "gauges": {"type": "object"},
+                        "histograms": {"type": "object"},
+                    },
+                },
+                "drift_sentinel": {
+                    "type": "object",
+                    "required": ["verdict", "flagged"],
+                    "properties": {
+                        "window": {"type": "integer"},
+                        "verdict": {"type": "object"},
+                        "flagged": {"type": "array",
+                                    "items": {"type": "string"}},
+                        "stragglers": {"type": "array"},
+                    },
+                },
+            },
+        },
         "spans": {
             "type": "array",
             "items": {
@@ -51,9 +79,13 @@ EVENT_SCHEMA: dict = {
                         # collectives (args.compute_bytes carries the
                         # operand bytes it materializes) — the
                         # ComputeFit calibration samples of the
-                        # overlap pipeline (feedback.compute_samples)
+                        # overlap pipeline (feedback.compute_samples).
+                        # "error": the sticky-retcode marker the flight
+                        # recorder emits at dump-on-error time
+                        # (telemetry.recorder — args.retcode is the
+                        # failing call's sticky error word)
                         "enum": ["call", "step", "phase", "sequence",
-                                 "native", "compute"],
+                                 "native", "compute", "error"],
                     },
                     "track": {"type": "string"},
                     "ts_ns": {"type": "integer", "minimum": 0},
@@ -142,19 +174,30 @@ def to_chrome(trace: dict) -> dict:
 
 def measured_seconds(span: dict) -> float:
     """A span's measured wall seconds: explicit args.measured_s when the
-    emitter recorded one (native spans), else the span duration."""
-    args = span.get("args", {})
-    if "measured_s" in args:
-        return float(args["measured_s"])
-    return span["dur_ns"] / 1e9
+    emitter recorded one (native spans), else the span duration.
+    Partially-populated spans (hand-built fixtures, truncated dumps)
+    degrade to 0.0 — "no measurement" — rather than raising."""
+    args = span.get("args") or {}
+    try:
+        if "measured_s" in args:
+            return float(args["measured_s"])
+        return float(span.get("dur_ns", 0)) / 1e9
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def residual_rows(trace: dict) -> list[dict]:
     """All spans carrying BOTH a prediction and a nonzero measurement,
-    as rows of (name, track, predicted_s, measured_s, rel_err)."""
+    as rows of (name, track, predicted_s, measured_s, rel_err). Robust
+    against empty and partially-populated traces: a span with no
+    `predicted_s`, a non-numeric prediction, or a zero/absent
+    measurement contributes no row (it has no residual to claim) —
+    never an exception."""
     rows = []
     for sp in trace.get("spans", []):
-        args = sp.get("args", {})
+        if not isinstance(sp, dict):
+            continue
+        args = sp.get("args") or {}
         if "predicted_s" not in args:
             continue
         if args.get("dispatch_only"):
@@ -165,10 +208,13 @@ def residual_rows(trace: dict) -> list[dict]:
         meas = measured_seconds(sp)
         if meas <= 0:
             continue
-        pred = float(args["predicted_s"])
+        try:
+            pred = float(args["predicted_s"])
+        except (TypeError, ValueError):
+            continue
         rows.append({
-            "name": sp["name"],
-            "track": sp["track"],
+            "name": sp.get("name", "?"),
+            "track": sp.get("track", "?"),
             "predicted_s": pred,
             "measured_s": meas,
             "rel_err": abs(pred - meas) / meas,
@@ -186,7 +232,14 @@ def median(xs: list[float]) -> float:
 
 def residual_summary(rows: list[dict]) -> dict:
     """Aggregate the residual table: overall and per-op median relative
-    error (|predicted - measured| / measured)."""
+    error (|predicted - measured| / measured). An empty table (a trace
+    from a run with no predictions, or drained before any call
+    completed) yields the well-typed empty summary — `median_rel_err`
+    is None, never NaN (NaN round-trips as Infinity-adjacent garbage
+    through strict JSON consumers) and never an exception."""
+    if not rows:
+        return {"rows": 0, "median_rel_err": None,
+                "per_op_median_rel_err": {}}
     by_op: dict[str, list[float]] = {}
     for r in rows:
         by_op.setdefault(r["name"], []).append(r["rel_err"])

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import pathlib
+import time
 
 from ..sequencer.timing import (
     ComputeFit,
@@ -40,23 +41,59 @@ _MODEL_PATH = (pathlib.Path(__file__).resolve().parents[2]
                / "accl_log" / "timing_model.json")
 
 
+# (path, kind) -> (mtime_ns | None, last_stat_monotonic, value)
 _default_link_cache: dict = {}
+_MODEL_CACHE_MAX = 64
+# how long a cache entry may serve without re-stat()ing the model file:
+# the freshness bound of the staleness fix below. This sits on the
+# per-call plan-selection hot path, so the mtime check is amortized —
+# at most one stat() per path per TTL, a pure dict hit otherwise.
+_STAT_TTL_S = 0.5
+
+
+def _mtime_ns(p: pathlib.Path) -> int | None:
+    try:
+        return p.stat().st_mtime_ns
+    except OSError:
+        return None
+
+
+def _model_cache_get(p: pathlib.Path, kind: str, load):
+    """Freshness-checked cache for loaded timing-model sections. A
+    `timing_model.json` OVERWRITTEN later in the same process (bench
+    gates rewrite link_tiers / compute_fit; a live refitter will
+    rewrite the link) bumps the file's mtime and is re-read within
+    _STAT_TTL_S, where the old per-path cache served the stale model
+    for the rest of the process. A missing file caches its negative
+    result under mtime None, so the file appearing later is still
+    picked up."""
+    key = (str(p), kind)
+    now = time.monotonic()
+    ent = _default_link_cache.get(key)
+    if ent is not None and now - ent[1] < _STAT_TTL_S:
+        return ent[2]
+    mtime = _mtime_ns(p)
+    if ent is not None and ent[0] == mtime:
+        _default_link_cache[key] = (mtime, now, ent[2])
+        return ent[2]
+    value = load(p)
+    if len(_default_link_cache) >= _MODEL_CACHE_MAX:
+        _default_link_cache.clear()
+    _default_link_cache[key] = (mtime, now, value)
+    return value
 
 
 def default_link(path=None) -> LinkParams | None:
     """The shipped emulator-tier LinkParams (the same selection rule as
     ACCL.autotune: per-collective bcast fit, legacy single-link
-    fallback). None when no timing model is committed. Hits are cached
-    per path (live span emission calls this once per traced call);
-    misses are NOT, so a model fitted and written later in the same
-    process is picked up."""
+    fallback). None when no timing model is committed. Results (hits
+    AND misses) are cached with an mtime freshness check — live span
+    emission calls this once per traced call (a dict hit; at most one
+    stat per _STAT_TTL_S), while a refit that overwrites the model
+    file mid-process bumps the mtime and is picked up within the
+    TTL."""
     p = pathlib.Path(path) if path else _MODEL_PATH
-    if p in _default_link_cache:
-        return _default_link_cache[p]
-    link = _load_link(p)
-    if link is not None:
-        _default_link_cache[p] = link
-    return link
+    return _model_cache_get(p, "link", _load_link)
 
 
 def _load_link(p: pathlib.Path) -> LinkParams | None:
@@ -89,13 +126,18 @@ def hop_samples(trace: dict,
     tier labels exist to prevent)."""
     samples = []
     for sp in trace.get("spans", []):
-        args = sp.get("args", {})
+        if not isinstance(sp, dict):
+            continue
+        args = sp.get("args") or {}
         if "coef_messages" not in args or "coef_bytes" not in args:
             continue
         if args.get("tier") != tier:
             continue
-        m = float(args["coef_messages"])
-        b = float(args["coef_bytes"])
+        try:
+            m = float(args["coef_messages"])
+            b = float(args["coef_bytes"])
+        except (TypeError, ValueError):
+            continue  # partially-populated span: no calibratable cost
         if m <= 0 and b <= 0:
             continue  # cost-free spans (world==1 degenerate calls)
         t = measured_seconds(sp)
@@ -141,26 +183,25 @@ def default_tier_links(path=None) -> TierLinks | None:
     selection) must then leave hierarchical selection off rather than
     invent a slow-tier model."""
     p = pathlib.Path(path) if path else _MODEL_PATH
-    key = (p, "tiers")
-    if key in _default_link_cache:
-        return _default_link_cache[key]
+    # negative results cached too (per mtime): this sits on the
+    # per-call plan selection path (an in-window select_algorithm with
+    # no caller tier_links lands here), and re-reading the model file
+    # on every call is hot-path disk I/O for the same None
+    return _model_cache_get(p, "tiers", _load_tier_links)
+
+
+def _load_tier_links(p: pathlib.Path) -> TierLinks | None:
     try:
         model = json.loads(p.read_text())
         tiers = model.get("link_tiers")
-        links: TierLinks | None = TierLinks(
+        return TierLinks(
             inner=LinkParams(alpha=tiers["inner"]["alpha_us"] * 1e-6,
                              beta=tiers["inner"]["beta_gbps"] * 1e9),
             outer=LinkParams(alpha=tiers["outer"]["alpha_us"] * 1e-6,
                              beta=tiers["outer"]["beta_gbps"] * 1e9),
         )
     except (OSError, ValueError, KeyError, TypeError, AttributeError):
-        # negative result cached too: this sits on the per-call plan
-        # selection path (an in-window select_algorithm with no caller
-        # tier_links lands here), and re-reading the model file on
-        # every call is hot-path disk I/O for the same None
-        links = None
-    _default_link_cache[key] = links
-    return links
+        return None
 
 
 def compute_samples(trace: dict) -> list[tuple[float, float]]:
@@ -173,10 +214,15 @@ def compute_samples(trace: dict) -> list[tuple[float, float]]:
     that stage materializes."""
     samples = []
     for sp in trace.get("spans", []):
-        args = sp.get("args", {})
+        if not isinstance(sp, dict):
+            continue
+        args = sp.get("args") or {}
         if "compute_bytes" not in args:
             continue
-        b = float(args["compute_bytes"])
+        try:
+            b = float(args["compute_bytes"])
+        except (TypeError, ValueError):
+            continue
         t = measured_seconds(sp)
         if b <= 0 or t <= 0:
             continue
@@ -202,23 +248,22 @@ def default_compute_fit(path=None) -> ComputeFit | None:
     document's `compute_fit` section ({alpha_us, grad_gbps}, written
     by bench.py --overlap-gate's refit). None when no fit is committed
     — callers (autotune, overlap stripe selection) must then leave the
-    overlap register off rather than invent a compute model. Positive
-    results are cached per path (this sits on the per-call plan
-    selection path); misses are NOT, so a fit written later in the
-    same process is picked up."""
+    overlap register off rather than invent a compute model. Results
+    are cached per (path, mtime) — this sits on the per-call plan
+    selection path, and a fit written later in the same process bumps
+    the mtime and is picked up."""
     p = pathlib.Path(path) if path else _MODEL_PATH
-    key = (p, "compute")
-    if key in _default_link_cache:
-        return _default_link_cache[key]
+    return _model_cache_get(p, "compute", _load_compute_fit)
+
+
+def _load_compute_fit(p: pathlib.Path) -> ComputeFit | None:
     try:
         model = json.loads(p.read_text())
         cf = model["compute_fit"]
-        fit: ComputeFit | None = ComputeFit(
+        return ComputeFit(
             alpha=cf["alpha_us"] * 1e-6, rate=cf["grad_gbps"] * 1e9)
     except (OSError, ValueError, KeyError, TypeError, AttributeError):
         return None
-    _default_link_cache[key] = fit
-    return fit
 
 
 def _rel_errs(trace: dict, link: LinkParams) -> list[float]:

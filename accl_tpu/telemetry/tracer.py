@@ -29,6 +29,15 @@ Tracing is OFF by default and costs one predicate per instrumented site
 when off (`span()` returns a shared no-op object before any argument
 handling): the bench smoke path gates that disabled overhead under 1%.
 Enable with ACCL_TELEMETRY=1 in the environment or telemetry.enable().
+
+The tracer is also the ONE emission seam of the always-on observability
+layer (telemetry.metrics / telemetry.recorder): observers registered
+with `add_observer()` receive every emitted event at span-emission time
+— whether or not the ring itself is collecting — so the streaming
+metrics registry and the flight recorder stay live without a trace ever
+being drained. `span()` returns a live span whenever the tracer is
+`active` (ring enabled OR observers installed); the ring only retains
+events when `enabled`.
 """
 
 from __future__ import annotations
@@ -110,6 +119,11 @@ class Tracer:
         self._spans: deque = deque()
         self._mu = threading.Lock()
         self.drops = 0
+        # observers are stored as an immutable tuple so the hot-path
+        # read (`span()`'s predicate, `emit()`'s fan-out) is lock-free;
+        # installs/removals copy-on-write under the ring lock
+        self._observers: tuple = ()
+        self.observer_errors = 0
 
     # -- switching ---------------------------------------------------------
 
@@ -117,27 +131,60 @@ class Tracer:
     def enabled(self) -> bool:
         return self._enabled
 
+    @property
+    def active(self) -> bool:
+        """True when spans are worth building: the ring is collecting
+        OR an observability observer (metrics registry, flight
+        recorder) is installed. Emitters gate arg attachment on this,
+        not on `enabled`, so live metrics see the plan/prediction keys
+        even when nobody is recording a full trace."""
+        return self._enabled or bool(self._observers)
+
     def enable(self) -> None:
         self._enabled = True
 
     def disable(self) -> None:
         self._enabled = False
 
+    # -- observers (the always-on observability seam) ----------------------
+
+    def add_observer(self, fn) -> None:
+        """Register a callable fed every emitted event (idempotent)."""
+        with self._mu:
+            if fn not in self._observers:
+                self._observers = self._observers + (fn,)
+
+    def remove_observer(self, fn) -> None:
+        with self._mu:
+            self._observers = tuple(o for o in self._observers if o is not fn)
+
+    def _observe(self, ev: dict) -> None:
+        for obs in self._observers:
+            try:
+                obs(ev)
+            except Exception:
+                # an observer bug must never take down the data plane;
+                # counted so a broken observer is visible, not silent
+                self.observer_errors += 1
+
     # -- emission ----------------------------------------------------------
 
     def span(self, name: str, cat: str = "call", track: str = "host",
              **args):
-        """Start a span context manager. Disabled tracing returns the
-        shared no-op before touching the arguments."""
-        if not self._enabled:
+        """Start a span context manager. An inactive tracer (ring off,
+        no observers) returns the shared no-op before touching the
+        arguments."""
+        if not (self._enabled or self._observers):
             return _NULL_SPAN
         return _LiveSpan(self, name, cat, track, args)
 
     def emit(self, name: str, cat: str, track: str, *, ts_ns: int,
              dur_ns: int, args: dict | None = None) -> None:
         """Record one already-measured span (the direct form used when
-        draining native rings or replaying recorded timings)."""
-        if not self._enabled:
+        draining native rings or replaying recorded timings). Observers
+        see every event at emission; the ring retains it only when
+        enabled."""
+        if not (self._enabled or self._observers):
             return
         ev = {
             "name": name,
@@ -147,6 +194,10 @@ class Tracer:
             "dur_ns": int(dur_ns),
             "args": dict(args or {}),
         }
+        if self._observers:
+            self._observe(ev)
+        if not self._enabled:
+            return
         with self._mu:
             if len(self._spans) >= self.capacity:
                 self._spans.popleft()
@@ -154,7 +205,13 @@ class Tracer:
             self._spans.append(ev)
 
     def extend(self, events: list[dict]) -> None:
-        """Bulk-append pre-shaped span events (ring discipline applies)."""
+        """Bulk-append pre-shaped span events (ring discipline applies;
+        observers see each event exactly as emit() would feed them)."""
+        if not (self._enabled or self._observers):
+            return
+        if self._observers:
+            for ev in events:
+                self._observe(ev)
         if not self._enabled:
             return
         with self._mu:
@@ -185,8 +242,19 @@ class Tracer:
 
     def to_trace(self, meta: dict | None = None) -> dict:
         """Package the current spans as a schema-versioned trace document
-        (the on-disk / exchange format every exporter consumes)."""
+        (the on-disk / exchange format every exporter consumes).
+        Observers exposing a `trace_meta()` hook (the metrics registry
+        snapshot + drift-sentinel report) contribute to the meta, so
+        every exported trace carries the live metrics next to its
+        spans."""
         m = {"drops": self.drops}
+        for obs in self._observers:
+            tm = getattr(obs, "trace_meta", None)
+            if tm is not None:
+                try:
+                    m.update(tm())
+                except Exception:
+                    self.observer_errors += 1
         if meta:
             m.update(meta)
         return {"schema": SCHEMA_VERSION, "meta": m, "spans": self.snapshot()}

@@ -614,3 +614,200 @@ def test_default_tier_links_reads_link_tiers(tmp_path):
     # and the COMMITTED model must carry the tier fit (bench --check's
     # hier cell depends on it; regenerated by bench.py --hier-gate)
     assert default_tier_links() is not None
+
+
+# ---------------------------------------------------------------------------
+# PR 13 satellites: model-cache staleness, residual hardening, and the
+# flight-recorder dump-on-error path
+# ---------------------------------------------------------------------------
+
+
+def _bump_mtime(p):
+    """Force a strictly larger mtime even on coarse filesystem clocks."""
+    import os
+
+    st = p.stat()
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+
+def test_default_link_cache_invalidates_on_refit_overwrite(tmp_path,
+                                                           monkeypatch):
+    """Satellite regression: the per-path cache used to never
+    invalidate, so a timing_model.json refit OVERWRITING an
+    already-cached model was ignored for the rest of the process. The
+    cache now freshness-checks the file's mtime (amortized: at most
+    one stat per _STAT_TTL_S — zeroed here so the overwrite is visible
+    immediately): an overwrite is re-read."""
+    from accl_tpu.telemetry import feedback
+    from accl_tpu.telemetry.feedback import (
+        default_compute_fit,
+        default_link,
+        default_tier_links,
+    )
+
+    monkeypatch.setattr(feedback, "_STAT_TTL_S", 0.0)
+
+    p = tmp_path / "timing_model.json"
+    p.write_text(json.dumps({"link": {"alpha_us": 100.0, "beta_gbps": 1.0}}))
+    l1 = default_link(p)
+    assert l1 is not None and l1.alpha == pytest.approx(100e-6)
+    assert default_tier_links(p) is None  # negative result, cached
+    assert default_compute_fit(p) is None
+
+    # a later refit overwrites the file (bench gates do exactly this
+    # for link_tiers / compute_fit; a live refitter will for the link)
+    p.write_text(json.dumps({
+        "link": {"alpha_us": 50.0, "beta_gbps": 2.0},
+        "link_tiers": {
+            "inner": {"alpha_us": 2.0, "beta_gbps": 4.0},
+            "outer": {"alpha_us": 400.0, "beta_gbps": 0.1},
+        },
+        "compute_fit": {"alpha_us": 10.0, "grad_gbps": 3.0},
+    }))
+    _bump_mtime(p)
+    l2 = default_link(p)
+    assert l2 is not None and l2.alpha == pytest.approx(50e-6)
+    assert l2.beta == pytest.approx(2e9)
+    tiers = default_tier_links(p)  # the stale None must not stick
+    assert tiers is not None and tiers.inner.alpha == pytest.approx(2e-6)
+    cf = default_compute_fit(p)
+    assert cf is not None and cf.rate == pytest.approx(3e9)
+
+
+def test_default_link_missing_file_then_created(tmp_path, monkeypatch):
+    """The negative result is cacheable (mtime None) without making a
+    model file that appears LATER invisible."""
+    from accl_tpu.telemetry import feedback
+    from accl_tpu.telemetry.feedback import default_link
+
+    monkeypatch.setattr(feedback, "_STAT_TTL_S", 0.0)
+
+    p = tmp_path / "timing_model.json"
+    assert default_link(p) is None
+    assert default_link(p) is None  # served from the cached miss
+    p.write_text(json.dumps({"link": {"alpha_us": 7.0, "beta_gbps": 1.0}}))
+    link = default_link(p)
+    assert link is not None and link.alpha == pytest.approx(7e-6)
+
+
+def test_residual_machinery_tolerates_empty_and_partial_traces():
+    """Satellite hardening: empty and partially-populated traces (no
+    spans with predicted_s, zero measured duration, malformed args)
+    yield well-typed empty summaries, never exceptions."""
+    from accl_tpu.telemetry import residual_rows, residual_summary
+    from accl_tpu.telemetry.export import measured_seconds
+    from accl_tpu.telemetry.feedback import residual_report
+
+    empty = {"schema": telemetry.SCHEMA_VERSION, "spans": []}
+    assert residual_rows(empty) == []
+    assert residual_rows({}) == []
+    assert residual_summary([]) == {
+        "rows": 0, "median_rel_err": None, "per_op_median_rel_err": {}}
+
+    partial = {"spans": [
+        {"name": "allreduce"},                       # no args, no dur_ns
+        {"cat": "call", "args": {"predicted_s": 0.1}},   # no measurement
+        {"name": "x", "track": "t", "ts_ns": 0, "dur_ns": 0,
+         "args": {"predicted_s": 0.1}},              # zero measured
+        {"name": "y", "track": "t", "ts_ns": 0, "dur_ns": 1000,
+         "args": {"predicted_s": "bogus"}},          # malformed prediction
+        {"name": "z", "track": "t", "ts_ns": 0, "dur_ns": 1000,
+         "args": None},                              # null args
+        "not-a-span",                                # wrong type entirely
+    ]}
+    assert residual_rows(partial) == []
+    assert measured_seconds({"args": {"measured_s": "fast"}}) == 0.0
+    rep = residual_report(partial)
+    assert rep["span_residuals"]["rows"] == 0
+    assert rep["span_residuals"]["median_rel_err"] is None
+    assert "error" in rep["calibration"]  # <2 calibratable spans, typed
+
+    # a trace with ONE real row still summarizes (the partial entries
+    # contribute nothing; they must not poison the good span)
+    partial["spans"].append(
+        {"name": "allreduce", "track": "emu/r0", "ts_ns": 0,
+         "dur_ns": 1_000_000, "args": {"predicted_s": 2e-3}})
+    rows = residual_rows(partial)
+    assert len(rows) == 1
+    s = residual_summary(rows)
+    assert s["rows"] == 1 and s["median_rel_err"] == pytest.approx(1.0)
+
+
+def test_flight_recorder_dump_on_native_fault(fault_env, monkeypatch):
+    """Satellite: a collective wedged by ACCL_RT_FAULT_DELAY_TAIL_MS
+    (delayed tail -> RECEIVE_TIMEOUT) must leave a self-contained
+    post-mortem in the flight recorder — the dumped ring contains the
+    failing span (the recv, by op name and count) with its sticky
+    retcode — without host tracing (ACCL_TELEMETRY) ever having been
+    enabled, and the artifact file lands when ACCL_FLIGHT_DIR is set."""
+    import pathlib
+    import tempfile
+
+    from accl_tpu.telemetry import recorder as trec
+
+    fault_env(ACCL_RT_TRACE=1, ACCL_RT_FAULT_DELAY_TAIL_MS=700)
+    tr = telemetry.get_tracer()
+    assert not tr.enabled  # full tracing stays OFF: the recorder alone
+    assert trec.armed()    # the always-on default
+    with tempfile.TemporaryDirectory() as td:
+        monkeypatch.setenv("ACCL_FLIGHT_DIR", td)
+        trec.get_recorder().clear()
+        rx_buf = 256
+        count = (3 * rx_buf) // 4
+        m1 = RNG.standard_normal(count).astype(np.float32)
+        w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=rx_buf)
+        try:
+            def body(rank, i):
+                import time
+
+                if i == 1:
+                    rank.send(m1.copy(), count, dst=0, tag=5)
+                    time.sleep(1.0)
+                    return None
+                rank.call(CallOptions(scenario=Operation.config,
+                                      function=int(CfgFunc.set_timeout),
+                                      count=300))
+                buf = np.zeros(count, np.float32)
+                h = rank.start(CallOptions(scenario=Operation.recv,
+                                           count=count, root_src_dst=1,
+                                           tag=5, data_type=F32), res=buf)
+                with pytest.raises(ACCLError, match="RECEIVE_TIMEOUT"):
+                    rank.wait(h)
+                return None
+
+            w.run(body)
+            # the dump-on-error must NOT have consumed the device trace
+            # ring: the wedged span is still drainable afterwards
+            native_spans, _ = w.ranks[0].trace_read()
+        finally:
+            w.close()
+        doc = trec.last_error_trace()
+        assert doc is not None
+        assert doc["meta"]["flight_recorder"] is True
+        assert "recv" in doc["meta"]["reason"]
+        errs = [s for s in doc["spans"] if s["cat"] == "error"]
+        assert len(errs) >= 1
+        failing = errs[-1]
+        assert failing["name"] == "recv"
+        assert failing["args"]["count"] == count
+        assert failing["args"]["rank"] == 0
+        assert failing["args"]["retcode"] & int(
+            ErrorCode.RECEIVE_TIMEOUT_ERROR)
+        # self-contained: schema-valid, metrics + sentinel in the meta
+        pytest.importorskip("jsonschema")
+        telemetry.validate_trace(doc)
+        assert "metrics" in doc["meta"] and "drift_sentinel" in doc["meta"]
+        # the error marker also fed the live metrics registry
+        snap = doc["meta"]["metrics"]
+        errs_counter = snap["counters"].get("accl_errors_total", [])
+        assert any(row["labels"].get("op") == "recv"
+                   for row in errs_counter)
+        # the opt-in artifact file is the same document
+        on_disk = json.loads(pathlib.Path(
+            td, "flight_last_error.json").read_text())
+        assert on_disk["meta"]["reason"] == doc["meta"]["reason"]
+        # and the native ring still carries the wedged span
+        recvs = [s for s in native_spans
+                 if s["opcode"] == int(Operation.recv)]
+        assert len(recvs) == 1
+        assert recvs[0]["retcode"] & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)

@@ -11,6 +11,16 @@ Thin client of accl_tpu.telemetry: takes a SPAN v1 trace document
                         rank/executor)
   --residuals           print the predicted-vs-measured residual table
                         and the default-vs-refit calibration summary
+  --metrics             replay the trace through the streaming metrics
+                        registry + drift sentinel (the SAME span ->
+                        metrics rule the live observer runs,
+                        telemetry.metrics.replay_trace) and print the
+                        Prometheus exposition, the sentinel verdict,
+                        and the straggler report; cross-checks the
+                        replayed call counts against a metrics
+                        snapshot embedded in the trace meta when one
+                        is present (--window sizes the replay
+                        sentinel)
   --selftest            run the full contract against the COMMITTED
                         golden trace (accl_log/golden_trace.json):
                         schema validation, Chrome conversion structure,
@@ -34,6 +44,11 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
 GOLDEN = REPO / "accl_log" / "golden_trace.json"
+
+# sentinel window for the golden trace's drift segment (16 stable +
+# 12 shifted alltoall spans): small enough that the shifted tail owns
+# the rolling median, the regression the selftest pins
+GOLDEN_SENTINEL_WINDOW = 16
 
 
 def make_golden() -> dict:
@@ -125,13 +140,57 @@ def make_golden() -> dict:
                              "d_passes": 2, "d_parks": 1,
                              "d_seek_hit": 2, "d_seek_miss": 1}})
                 t0 += dur + 50_000
-    return {"schema": SCHEMA_VERSION,
-            "meta": {"golden": True, "drops": 0,
-                     "default_link": default,
-                     "tier_true_links": {
-                         t: {"alpha_us": a * 1e6, "beta_gbps": bb / 1e9}
-                         for t, (a, bb) in tier_true.items()}},
-            "spans": spans}
+    # drift-sentinel segment (op "alltoall", used by no other golden
+    # span): ACCURATE predictions in the stable regime — rank 3 runs a
+    # deliberate 1.5x slow (the straggler the per-rank attribution must
+    # name) — then a 4x regime shift under the SAME stale prediction.
+    # No coef_* keys: these spans demo the band-leave verdict and must
+    # not contaminate the calibration-invariant sample set above.
+    at_true, at_count = 3e-3, 8192
+    jit = (0.97, 1.0, 1.03)
+    t0 = 80_000_000
+    at_spans = []
+    for wave in range(4):  # stable regime: 4 waves x 4 ranks
+        for rank in range(4):
+            meas = at_true * (1.5 if rank == 3 else 1.0) \
+                * jit[(wave + rank) % len(jit)]
+            at_spans.append((rank, meas, "stable"))
+    for wave in range(3):  # regime shift: 3 waves x 4 ranks, 4x slower
+        for rank in range(4):
+            meas = at_true * 4.0 * jit[(wave + rank) % len(jit)]
+            at_spans.append((rank, meas, "shifted"))
+    for rank, meas, regime in at_spans:
+        dur = int(meas * 1e9)
+        spans.append({
+            "name": "alltoall", "cat": "native",
+            "track": f"emu/r{rank}", "ts_ns": t0, "dur_ns": dur,
+            "args": {"op": "alltoall", "count": at_count,
+                     "bytes": at_count * 4, "world": 4, "rank": rank,
+                     "retcode": 0, "detail": 0, "measured_s": meas,
+                     "predicted_s": at_true, "regime": regime,
+                     "d_passes": 1, "d_parks": 0,
+                     "d_seek_hit": 1, "d_seek_miss": 0}})
+        t0 += dur + 25_000
+    meta = {"golden": True, "drops": 0,
+            "default_link": default,
+            "sentinel_window": GOLDEN_SENTINEL_WINDOW,
+            "tier_true_links": {
+                t: {"alpha_us": a * 1e6, "beta_gbps": bb / 1e9}
+                for t, (a, bb) in tier_true.items()}}
+    # embed the metrics snapshot + sentinel report the always-on layer
+    # would serve for exactly these spans (Tracer.to_trace's posture),
+    # so --selftest covers the meta keys every exported trace now ships
+    from accl_tpu.telemetry.metrics import (
+        DriftSentinel,
+        MetricsObserver,
+        MetricsRegistry,
+        replay_trace,
+    )
+
+    obs = replay_trace({"spans": spans}, MetricsObserver(
+        MetricsRegistry(), DriftSentinel(window=GOLDEN_SENTINEL_WINDOW)))
+    meta.update(obs.trace_meta())
+    return {"schema": SCHEMA_VERSION, "meta": meta, "spans": spans}
 
 
 def cmd_validate(trace: dict) -> None:
@@ -150,13 +209,62 @@ def cmd_chrome(trace: dict, out: str) -> None:
     print(f"wrote {out} ({len(chrome['traceEvents'])} events)")
 
 
+def cmd_metrics(trace: dict, window: int) -> int:
+    """Replay a trace through the metrics registry + drift sentinel
+    and print what the always-on layer would be serving live."""
+    from accl_tpu.telemetry.metrics import (
+        DriftSentinel,
+        MetricsObserver,
+        MetricsRegistry,
+        replay_trace,
+    )
+
+    obs = replay_trace(trace, MetricsObserver(
+        MetricsRegistry(), DriftSentinel(window=window)))
+    text = obs.registry.expose_text()
+    print(text, end="")
+    rep = obs.sentinel.report()
+    flagged = rep["flagged"]
+    print(f"drift sentinel (window {window}): "
+          f"{len(rep['verdict'])} op(s), flagged={flagged or 'none'}")
+    for op, row in rep["verdict"].items():
+        band = (f" band<={row['band_hi']:.3f} "
+                f"{'OUT-OF-BAND' if not row['in_band'] else 'in band'}"
+                if row.get("armed") else " (unarmed)")
+        print(f"  {op:20s} n={row['n']:<4d} median rel err "
+              f"{row['median_rel_err']:.3f}{band}")
+    for w in rep["stragglers"]:
+        print(f"  straggler {w['op']}/{w['count']}: rank "
+              f"{w['straggler_rank']} at {w['skew']:.2f}x the "
+              f"median-of-ranks ({w['ranks']} ranks)")
+    embedded = trace.get("meta", {}).get("metrics")
+    if embedded is not None:
+        # the snapshot embedded at export time and this offline replay
+        # run the same rule: their call counts must agree, or the
+        # emitters and the replay path have drifted apart
+        def total(snap):
+            return sum(r["value"] for r in
+                       snap.get("counters", {}).get("accl_calls_total", []))
+
+        got = total(obs.registry.snapshot())
+        want = total(embedded)
+        if got != want:
+            print(f"FAIL: replayed call count {got:g} != embedded "
+                  f"snapshot {want:g}", file=sys.stderr)
+            return 1
+        print(f"embedded snapshot cross-check OK ({got:g} calls)")
+    return 0
+
+
 def cmd_residuals(trace: dict) -> None:
     from accl_tpu.telemetry import residual_report
 
     report = residual_report(trace)
     sr = report["span_residuals"]
+    med = sr["median_rel_err"]
     print(f"spans with predictions: {sr['rows']}  "
-          f"median |pred-meas|/meas: {sr['median_rel_err']:.3f}")
+          f"median |pred-meas|/meas: "
+          f"{'n/a' if med is None else f'{med:.3f}'}")
     for op, err in sr["per_op_median_rel_err"].items():
         print(f"  {op:20s} {err:.3f}")
     cal = report["calibration"]
@@ -226,11 +334,49 @@ def cmd_selftest() -> int:
             f"true {want / 1e9:.2f}"
     assert tiers.inner.beta > 10 * tiers.outer.beta, \
         "per-tier refit must keep the fast and slow links apart"
+    # the always-on observability meta keys: the committed golden must
+    # carry the metrics snapshot + sentinel report, the offline replay
+    # must reproduce them (same rule, no drift), and the sentinel must
+    # FLAG the embedded regime shift while attributing the deliberate
+    # rank-3 straggler — the sensing contract, pinned on committed data
+    from accl_tpu.telemetry.metrics import (
+        DriftSentinel,
+        MetricsObserver,
+        MetricsRegistry,
+        replay_trace,
+    )
+
+    assert "metrics" in trace["meta"] and "drift_sentinel" in \
+        trace["meta"], "golden meta must embed the observability keys"
+    win = int(trace["meta"]["sentinel_window"])
+    obs = replay_trace(trace, MetricsObserver(
+        MetricsRegistry(), DriftSentinel(window=win)))
+    def _calls(snap):
+        return sum(r["value"] for r in
+                   snap.get("counters", {}).get("accl_calls_total", []))
+    assert _calls(obs.registry.snapshot()) == \
+        _calls(trace["meta"]["metrics"]), \
+        "offline metrics replay diverged from the embedded snapshot"
+    flagged = obs.sentinel.flagged()
+    assert flagged == ["alltoall"], \
+        f"sentinel must flag exactly the shifted op, got {flagged}"
+    v = obs.sentinel.verdict()["alltoall"]
+    assert not v["in_band"] and v["median_rel_err"] > v["band_hi"]
+    embedded_flags = trace["meta"]["drift_sentinel"]["flagged"]
+    assert embedded_flags == ["alltoall"], \
+        "embedded sentinel report must carry the same verdict"
+    strag = [w for w in obs.sentinel.straggler_report()
+             if w["op"] == "alltoall"]
+    assert strag and strag[0]["straggler_rank"] == 3 and \
+        strag[0]["skew"] > 1.2, \
+        "per-rank attribution must name the deliberate rank-3 straggler"
     print(f"selftest OK: {len(trace['spans'])} golden spans, "
           f"{len(names)} tracks, refit median rel err {e_ref:.3f} < "
           f"default {e_def:.3f}; tier refit inner "
           f"{tiers.inner.beta / 1e9:.2f} GB/s / outer "
-          f"{tiers.outer.beta / 1e9:.3f} GB/s")
+          f"{tiers.outer.beta / 1e9:.3f} GB/s; sentinel flagged "
+          f"{flagged} (straggler r{strag[0]['straggler_rank']} at "
+          f"{strag[0]['skew']:.2f}x)")
     return 0
 
 
@@ -241,6 +387,10 @@ def main() -> int:
     ap.add_argument("--validate", action="store_true")
     ap.add_argument("--chrome", metavar="OUT")
     ap.add_argument("--residuals", action="store_true")
+    ap.add_argument("--metrics", action="store_true")
+    ap.add_argument("--window", type=int, default=GOLDEN_SENTINEL_WINDOW,
+                    help="drift-sentinel rolling window for --metrics "
+                         "replay (default %(default)s)")
     ap.add_argument("--selftest", action="store_true")
     ap.add_argument("--make-golden", action="store_true")
     args = ap.parse_args()
@@ -258,7 +408,8 @@ def main() -> int:
 
     trace = json.loads(pathlib.Path(args.trace).read_text())
     ran = False
-    if args.validate or not (args.chrome or args.residuals):
+    if args.validate or not (args.chrome or args.residuals
+                             or args.metrics):
         cmd_validate(trace)
         ran = True
     if args.chrome:
@@ -266,6 +417,11 @@ def main() -> int:
         ran = True
     if args.residuals:
         cmd_residuals(trace)
+        ran = True
+    if args.metrics:
+        rc = cmd_metrics(trace, args.window)
+        if rc:
+            return rc
         ran = True
     return 0 if ran else 2
 
