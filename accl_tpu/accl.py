@@ -15,6 +15,8 @@ collectives stay on-device.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -35,9 +37,10 @@ from .constants import (
     StreamFlags,
     TAG_ANY,
     TuningParams,
+    dtype_nbytes,
     to_numpy_dtype,
 )
-from .descriptor import CallOptions
+from .descriptor import CallOptions, normalize_live_ranks
 from .device.base import CCLOAddr
 from .errors import (
     DtypeMismatchError,
@@ -82,6 +85,11 @@ class ACCL:
         self.communicators: list[Communicator] = []
         self._initialized = False
         self._last_request: BaseRequest | None = None
+        # armed resilience manager (accl_tpu/resilience/): when set,
+        # every synchronous data-plane call is checked against its
+        # model-derived deadline post-completion (one perf_counter pair
+        # + a cached policy lookup; None = zero overhead)
+        self._resilience = None
         # placeholder rank buffers backing the buffer-less stream forms
         # (reference send/recv/copy overloads that take only a dataType,
         # accl.hpp:190,278,349): one per (count, dtype), reused
@@ -316,6 +324,13 @@ class ACCL:
         to_device: bool,
         run_async: bool,
     ):
+        # armed deadlines (resilience seam): time the synchronous call
+        # end to end so the manager can check it against its
+        # model-derived deadline after completion. async calls complete
+        # in wait() where no end-to-end wall time exists host-side.
+        mgr = self._resilience
+        t0 = (time.perf_counter()
+              if mgr is not None and not run_async else None)
         # tracer.span is the shared no-op when telemetry is off (one
         # predicate; the bench smoke path gates the disabled cost <1%)
         with get_tracer().span(opts.scenario.name, cat="call",
@@ -326,6 +341,11 @@ class ACCL:
                       int(opts.stream_flags))
             req = self.cclo.start(opts)
             ret = self._complete(req, sync_out, to_device, run_async)
+            if t0 is not None:
+                mgr.observe_call(opts.scenario, opts.count,
+                                 dtype_nbytes(opts.data_type)
+                                 if opts.data_type != DataType.none else 4,
+                                 time.perf_counter() - t0)
             if get_tracer().active:  # attach what the device resolved
                 sp.set(op=opts.scenario.name, count=opts.count,
                        retcode=req.retcode)
@@ -567,13 +587,61 @@ class ACCL:
     def allreduce(self, sendbuf, recvbuf, count, function, *,
                   from_device=False, to_device=False, run_async=False,
                   compress_dtype=None, comm=None,
-                  op0_stream=None, res_stream=None):
+                  op0_stream=None, res_stream=None,
+                  mode="all", live_ranks=None):
+        """`mode="live_subset"` is the CERTIFIED degraded form
+        (docs/resilience.md): `live_ranks` declares the
+        surviving-contributor set, every other rank's operand is masked
+        to exact zeros at the source inside the schedule, and the
+        semantic certifier proves the answer sums exactly the declared
+        survivors (the alltoallv drop-to-zeros posture generalized to
+        the reduction — a dead rank's stale buffer can never leak a
+        ghost contribution). SUM only, exact wire only. A full
+        survivor set normalizes to the ordinary allreduce bit-for-bit
+        (one compiled program, like the all-full alltoallv vector)."""
         opts = self._prepare(Operation.allreduce, sendbuf, None, recvbuf,
                              count, function=int(function),
                              compress_dtype=compress_dtype, comm=comm)
+        opts.live_ranks = self._live_subset(mode, live_ranks, function,
+                                            compress_dtype, comm)
         self._stream_opts(opts, op0_stream, res_stream)
         return self._execute(opts, [sendbuf], [recvbuf], from_device,
                              to_device, run_async)
+
+    def _live_subset(self, mode, live_ranks, function, compress_dtype,
+                     comm) -> tuple:
+        """Validate the degraded-mode arguments at the host seam (the
+        _prepare posture: a bad survivor set fails before anything
+        compiles or dispatches). Returns the normalized live_ranks
+        tuple for the descriptor — () for the ordinary collective."""
+        if mode not in ("all", "live_subset"):
+            raise ValueError(
+                f"allreduce mode must be 'all'|'live_subset', got {mode!r}")
+        if mode == "all":
+            if live_ranks is not None:
+                raise ValueError(
+                    "live_ranks requires mode='live_subset'")
+            return ()
+        if not live_ranks:
+            raise ValueError(
+                "mode='live_subset' needs a non-empty live_ranks set")
+        comm_size = (comm or self.communicators[0]).size
+        lr = normalize_live_ranks(live_ranks, comm_size)
+        if ReduceFunction(function) != ReduceFunction.SUM:
+            raise ValueError(
+                "live-subset allreduce is SUM-only: the zero mask is "
+                "the fold identity for SUM, nothing else is certified")
+        if compress_dtype is not None:
+            raise NotImplementedError(
+                "live-subset allreduce is exact-wire only")
+        if lr == tuple(range(comm_size)):
+            # every rank lives: the ordinary allreduce, shared program
+            return ()
+        if not getattr(self.cclo, "supports_live_subset", False):
+            raise NotImplementedError(
+                f"{type(self.cclo).__name__} has no masked live-subset "
+                "ring; degraded allreduce is XLA-schedule-tier only")
+        return lr
 
     def reduce_scatter(self, sendbuf, recvbuf, count, function, *,
                        from_device=False, to_device=False, run_async=False,
@@ -929,12 +997,29 @@ class ACCL:
                                      "supports_quantized_wire", False))
         return tuning
 
+    def arm_resilience(self, manager) -> None:
+        """Arm per-call deadlines on this facade
+        (resilience.ResilienceManager with a DeadlinePolicy): every
+        synchronous data-plane call is checked against its
+        model-derived deadline after completion — a miss produces the
+        structured DeadlineMissed verdict (flight-recorder post-mortem
+        attached) on the manager, it never fails the completed call.
+        Disarm with ``arm_resilience(None)``; disarmed cost is one
+        attribute check per call (the no-fault control run is pinned
+        bit-for-bit identical with the seam armed)."""
+        self._resilience = manager
+
     def soft_reset(self):
         """reset_periph config call (reference soft_reset, accl.cpp:57-69):
         drains parked/pending call state and compiled-schedule caches but
         leaves the device configured (unlike deinit, which also clears
         CFGRDY)."""
         self._config_call(CfgFunc.reset_periph, 0)
+        # the compiled-schedule caches are gone: an armed resilience
+        # manager must re-exempt every shape's next (recompiling)
+        # dispatch, or the compile time reads as a deadline miss
+        if self._resilience is not None:
+            self._resilience.reset_warmup()
 
     def get_comm_group(self, comm: Communicator | None = None) -> list[Rank]:
         """Round-trip the communicator's rank table from exchange memory
@@ -1046,10 +1131,13 @@ class SequenceRecorder:
         return self._record(opts, [sendbuf], [recvbuf])
 
     def allreduce(self, sendbuf, recvbuf, count, function, *,
-                  compress_dtype=None, op0_stream=None, res_stream=None):
+                  compress_dtype=None, op0_stream=None, res_stream=None,
+                  mode="all", live_ranks=None):
         opts = self._prep(Operation.allreduce, sendbuf, None, recvbuf, count,
                           function=int(function),
                           compress_dtype=compress_dtype)
+        opts.live_ranks = self._accl._live_subset(
+            mode, live_ranks, int(function), compress_dtype, self._comm)
         self._accl._stream_opts(opts, op0_stream, res_stream)
         return self._record(opts, [sendbuf], [recvbuf])
 

@@ -949,7 +949,17 @@ def collective_spec(options: Any, world: int) -> list[IMap | None] | None:
         full = _merge_all(atom(rr) for rr in range(world))
         return [[red(full)] if r == root else None for r in range(world)]
     if op == Operation.allreduce:
-        full = _merge_all(atom(rr) for rr in range(world))
+        # degraded live-subset mode (allreduce(mode="live_subset")): the
+        # descriptor DECLARES the surviving-contributor set, and the
+        # spec demands exactly those ranks' atoms — no more (a dead
+        # rank's stale partial folded in is a foreign atom, ACCL501),
+        # no fewer (a dropped survivor is ACCL502). Every rank's output
+        # still carries the (survivor) sum: dead ranks relay the ring
+        # but contribute masked zeros. Empty live_ranks = every rank
+        # contributes, the ordinary collective.
+        live = tuple(getattr(options, "live_ranks", ()) or ())
+        contributors = live if live else tuple(range(world))
+        full = _merge_all(atom(rr) for rr in contributors)
         return [[red(full)] for _ in range(world)]
     if op == Operation.reduce_scatter:
         return [[red(_merge_all(atom(rr, r * count)

@@ -25,6 +25,20 @@ from .constants import (
 DESCRIPTOR_WORDS = 15
 
 
+def normalize_live_ranks(live_ranks, world: int) -> tuple[int, ...]:
+    """The ONE validation of a degraded live-subset survivor set
+    (shared by the facade seam and plan selection, so the two can never
+    drift): sorted, duplicate-free, every member inside the world.
+    Returns the normalized tuple; callers decide what a full set means
+    (the facade folds it to the ordinary collective)."""
+    lr = tuple(sorted(int(r) for r in live_ranks))
+    if len(set(lr)) != len(lr):
+        raise ValueError(f"duplicate ranks in live_ranks {live_ranks}")
+    if any(not 0 <= r < world for r in lr):
+        raise ValueError(f"live_ranks {lr} outside world of {world}")
+    return lr
+
+
 @dataclasses.dataclass
 class CallOptions:
     """Host-side form of a call descriptor (reference CCLO::Options,
@@ -62,6 +76,18 @@ class CallOptions:
     # variable-length vector), so it MUST ride signature(): two calls
     # differing only in capacities compile different programs.
     peer_counts: tuple[int, ...] = ()
+    # Degraded live-subset allreduce (accl_tpu/resilience/): the
+    # DECLARED surviving-contributor set of an
+    # `allreduce(mode="live_subset")`. Non-members' operands are masked
+    # to exact zeros at the source inside the schedule — the alltoallv
+    # drop-to-zeros posture generalized — so the semantic certifier can
+    # prove exactly which ranks' data is in the answer
+    # (semantics.collective_spec declares the survivor sum, ACCL501
+    # fires on any ghost contribution). Empty = every rank contributes
+    # (the ordinary collective). A TPU-path extra like peer_counts, and
+    # like it MUST ride signature(): two calls differing only in the
+    # survivor set compile different programs.
+    live_ranks: tuple[int, ...] = ()
 
     def to_words(self) -> list[int]:
         """Serialize into the 15-word call stream layout (accl_hls.h:134-198):
@@ -131,6 +157,7 @@ class CallOptions:
             self.op0_stream_id,
             self.res_stream_id,
             tuple(self.peer_counts),
+            tuple(self.live_ranks),
         )
 
 

@@ -121,6 +121,8 @@ def load_native():
             ctypes.c_void_p, ctypes.POINTER(NativeSpan), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_uint64),
         ]
+        lib.accl_rt_kill.argtypes = [ctypes.c_void_p]
+        lib.accl_rt_flush_rx.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -195,6 +197,44 @@ class EmuRank:
         if self._rt:
             self._lib.accl_rt_destroy(self._rt)
             self._rt = None
+
+    def kill(self):
+        """Permanently wedge this rank (accl_rt_kill — the programmatic
+        ACCL_RT_FAULT_KILL_RANK): in-flight and future calls complete
+        with a sticky RECEIVE_TIMEOUT retcode (a final trace-ring span
+        when tracing is armed) and the rank's wire goes dark in both
+        directions. The fault-injection primitive of the self-healing
+        soak (bench --fault-gate, tests/test_resilience.py)."""
+        if self._rt:
+            self._lib.accl_rt_kill(self._rt)
+
+    def flush_rx(self, settle_s: float = 0.05):
+        """Reconfiguration fence (accl_rt_flush_rx): drop stale landed
+        frames of the old membership's aborted collectives and advance
+        the per-peer seqn past them. Call QUIESCENT (no live calls on
+        this rank, survivor threads joined) between excluding a dead
+        rank and the first call on the recovery communicator — the
+        seqn-ordered streamed matching would otherwise deliver old-
+        world frames into the new world's first recv as data.
+
+        The fence runs TWICE around a `settle_s` pause: quiescence
+        means no peer is *sending* (their calls terminated before this
+        rank's threads joined — sends happen synchronously inside
+        calls), but a final frame may still be crossing the receive
+        path (the rx thread mid-read of a socket buffer). Such a
+        straggler lands with a seqn at-or-past the first flush's
+        advance and would read as new-world data; the settle window
+        lets it land and the second flush drops it. A frame delayed
+        longer than `settle_s` after every sender terminated would
+        need a transport that buffers outside both endpoints — not a
+        property of the in-process/loopback POEs."""
+        if self._rt:
+            import time
+
+            self._lib.accl_rt_flush_rx(self._rt)
+            if settle_s > 0:
+                time.sleep(settle_s)
+                self._lib.accl_rt_flush_rx(self._rt)
 
     def __del__(self):
         try:

@@ -54,6 +54,12 @@ class TPUDevice(CCLODevice):
     # whose alltoall is not the flat exchange the crossover was
     # calibrated for — DCNDevice's two-tier composition — opt out)
     auto_alltoall_wire = True
+    # the degraded live-subset allreduce (source-masked ring,
+    # schedules.allreduce_ring_schedule live_ranks=) is an XLA-tier
+    # schedule like alltoallv: the native emulator's ring knows nothing
+    # about a declared survivor set (its degraded path is membership
+    # change — a recovery sub-communicator over the survivors)
+    supports_live_subset = True
 
     def __init__(self, mesh, axis_name: str = "ccl",
                  hier_topology: tuple[int, int] | None = None):
@@ -378,6 +384,9 @@ class TPUDevice(CCLODevice):
             # alltoallv: the static per-peer capacity vector rides the
             # descriptor into the Plan (frozen, cache-keyed)
             peer_counts=options.peer_counts,
+            # degraded live-subset allreduce: the declared survivor set
+            # rides the descriptor into the Plan the same way
+            live_ranks=options.live_ranks,
         )
         # stream ids ride dedicated descriptor bytes (word 8), so the tag
         # stays available for matching

@@ -1,0 +1,64 @@
+"""Self-healing collectives: predicted deadlines, rank-death detection,
+and certified live reconfiguration (docs/resilience.md).
+
+Three pieces, each reusing an existing proof or measurement surface
+instead of growing a parallel one:
+
+  - ``deadline``: per-call deadlines DERIVED from ``timing.predict``
+    under the calibrated link plus the drift sentinel's residual
+    tolerance band — the fixed ``RECEIVE_TIMEOUT`` posture replaced by
+    the model the framework already trusts for selection.  A miss is a
+    structured :class:`DeadlineMissed` verdict with the flight-recorder
+    post-mortem attached and per-rank straggler attribution naming the
+    suspect.
+
+  - ``manager``: :class:`ResilienceManager` runs the
+    detect -> exclude -> re-synthesize -> re-certify -> hot-swap loop —
+    a retry/backoff budget distinguishes transient stragglers from dead
+    peers; the recovery schedule over the surviving P-1 world comes
+    from the committed synthesized library or the ring constructors and
+    is re-proven through the EXISTING semantics + modelcheck stack
+    before install (an uncertified recovery plan is a loud
+    :class:`UncertifiedRecoveryError`, never a silent degrade).
+
+  - the certified degraded mode rides the facade:
+    ``ACCL.allreduce(mode="live_subset", live_ranks=...)`` declares the
+    surviving-contributor set in the descriptor, the schedule masks
+    non-members to exact zeros at the source, and the semantic
+    certifier proves exactly which ranks' data is in the answer (the
+    ACCL501-proven alltoallv drop-to-zeros posture generalized to the
+    reduction).
+
+Measured end to end by ``bench.py --fault-gate`` (CI): a mid-stream
+rank death on the native emulated world recovers within the bounded
+retry+reconfigure budget with zero wrong answers, and the armed-
+deadline control shows <3% overhead over unarmed waits.
+"""
+
+from .deadline import (  # noqa: F401
+    DEFAULT_DEADLINE_FLOOR_S,
+    DEFAULT_UNARMED_REFERENCE,
+    DeadlineMissed,
+    DeadlineMissedError,
+    DeadlinePolicy,
+    NativeDeadlineGuard,
+)
+from .manager import (  # noqa: F401
+    RecoveryPlan,
+    ResilienceManager,
+    RetryBudget,
+    UncertifiedRecoveryError,
+)
+
+__all__ = [
+    "DEFAULT_DEADLINE_FLOOR_S",
+    "DEFAULT_UNARMED_REFERENCE",
+    "DeadlineMissed",
+    "DeadlineMissedError",
+    "DeadlinePolicy",
+    "NativeDeadlineGuard",
+    "RecoveryPlan",
+    "ResilienceManager",
+    "RetryBudget",
+    "UncertifiedRecoveryError",
+]

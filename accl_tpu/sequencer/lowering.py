@@ -417,6 +417,11 @@ class ScheduleCompiler:
                     # kernels live
                     and (not eth_active or compressed_domain)
                     and mosaic_ok
+                    # the degraded live-subset mode lowers through the lax
+                    # ring, where the source mask is part of the traced
+                    # body the certifier lifts (the VMEM kernel has no
+                    # masked variant)
+                    and not plan.live_ranks
                 ):
                     from ..ops.ring_allreduce import (
                         NUM_RING_SLOTS,
@@ -470,6 +475,11 @@ class ScheduleCompiler:
                         # — only cost-model-striped plans have a twin)
                         serialize=(self.overlap_serialize
                                    and plan.stripes > 1),
+                        # degraded live-subset mode: the declared
+                        # survivor set masks non-members' operands to
+                        # zeros at the source (None = every rank
+                        # contributes, the ordinary ring)
+                        live_ranks=(plan.live_ranks or None),
                         **common,
                     )
             n_in = 1

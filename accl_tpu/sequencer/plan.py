@@ -118,6 +118,15 @@ class Plan:
     # Frozen, so two alltoallv calls with different capacity vectors
     # can never share a compiled program or a timing estimate.
     peer_counts: tuple[int, ...] = ()
+    # Degraded live-subset allreduce (the descriptor's live_ranks): the
+    # declared surviving-contributor set. Non-empty only on
+    # EAGER_RING_RS_AG plans selected for `allreduce(mode=
+    # "live_subset")` — the schedule masks every non-member's operand
+    # to exact zeros at the source before the ordinary ring runs, so
+    # the answer provably sums exactly the survivors. Frozen and
+    # cache-keyed like peer_counts: two survivor sets can never share
+    # a compiled program.
+    live_ranks: tuple[int, ...] = ()
 
 
 def is_rendezvous(
@@ -179,6 +188,7 @@ def select_algorithm(
     overlap_link=None,
     overlap_compute=None,
     tiered_synth_ok: bool = True,
+    live_ranks: tuple[int, ...] = (),
 ) -> Plan:
     """Resolve scenario + message + communicator into a Plan.
 
@@ -238,6 +248,30 @@ def select_algorithm(
         return Plan(proto, Algorithm.NONE, count, 1)
     if world_size == 1 and scenario != Operation.barrier:
         return Plan(proto, Algorithm.NONE, count, 1)
+
+    # Degraded live-subset allreduce (accl_tpu/resilience/): a declared
+    # surviving-contributor set pins the plan to the source-masked eager
+    # ring — the one schedule family the certifier proves against the
+    # survivor spec — BEFORE any performance window (hier / synthesized
+    # / overlap / the rendezvous composition): degraded mode is the
+    # certified-correctness path, and those windows were all calibrated
+    # for the full-contributor collective. A full survivor set IS the
+    # ordinary allreduce and falls through (the facade normalizes it to
+    # () so the compiled program is shared, like the all-full alltoallv
+    # vector).
+    if scenario == Operation.allreduce and live_ranks:
+        from ..descriptor import normalize_live_ranks
+
+        lr = normalize_live_ranks(live_ranks, world_size)
+        if lr != tuple(range(world_size)):
+            if compression != CompressionFlags.NO_COMPRESSION:
+                raise ValueError(
+                    "live-subset allreduce is exact-wire only: the "
+                    "certified degraded mode does not compose with "
+                    "compression lanes")
+            base = eager_plan(Algorithm.EAGER_RING_RS_AG,
+                              world_align=world_size)
+            return dataclasses.replace(base, live_ranks=lr)
 
     # Striped two-tier allreduce (sequencer/hierarchical.py): reachable
     # ONLY inside the HIER_ALLREDUCE_MIN_COUNT register window on a

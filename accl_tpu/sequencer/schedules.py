@@ -455,7 +455,8 @@ def _reduce_scatter_ring_quant(x, *, func, axis, world, wire, ring=None):
 
 
 def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int,
-                            ring=None, serialize: bool = False):
+                            ring=None, serialize: bool = False,
+                            live_ranks=None):
     """Segmented ring allreduce (.c:1888-2071): per segment, a ring
     reduce-scatter over world-size chunks followed by a ring allgather.
     Segments bound scratch footprint and pipeline across the loop.
@@ -466,8 +467,24 @@ def allreduce_ring_schedule(x, *, func, axis, world, wire, seg_count: int,
     stripe-overlapped plan, bitwise-identical to the unserialized form
     (barriers change scheduling freedom, never values), kept reachable
     for A/B measurement exactly like the pallas ring's
-    ACCL_PALLAS_RING_SERIALIZE baseline."""
+    ACCL_PALLAS_RING_SERIALIZE baseline.
+
+    live_ranks (the degraded live-subset mode, Plan.live_ranks): a
+    declared surviving-contributor set. Every NON-member's operand is
+    masked to exact zeros HERE, at the source, before any wire hop —
+    the alltoallv capacity-drop posture generalized to the reduction —
+    so the ring's folds provably accumulate exactly the survivors'
+    data and the semantic certifier can match the output against the
+    declared survivor sum (a dead rank's buffer can never leak a ghost
+    contribution into the answer). Every rank, dead or alive, still
+    relays its ring position: the wire pattern is the ordinary ring,
+    only the contribution set shrinks. SUM-class folds only (a zero
+    mask is the fold identity for SUM; the facade enforces this)."""
     count = x.shape[-1]
+    if live_ranks is not None:
+        me = lax.axis_index(axis)
+        is_live = jnp.isin(me, jnp.asarray(tuple(live_ranks), jnp.int32))
+        x = jnp.where(is_live, x, jnp.zeros_like(x))
 
     def one_segment(seg):
         padded = _pad_to_multiple(seg, world)

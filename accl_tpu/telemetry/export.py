@@ -113,6 +113,13 @@ EVENT_SCHEMA: dict = {
                             "d_seek_hit": {"type": "integer"},
                             "d_seek_miss": {"type": "integer"},
                             "compute_bytes": {"type": "integer"},
+                            # the deadline-miss marker (resilience
+                            # host-side verdicts, recorder
+                            # .on_deadline_miss): a cat "error" span
+                            # with no sticky retcode carries these
+                            "deadline_missed": {"type": "boolean"},
+                            "deadline_s": {"type": "number"},
+                            "suspect_rank": {"type": "integer"},
                         },
                         "additionalProperties": True,
                     },
@@ -204,6 +211,13 @@ def residual_rows(trace: dict) -> list[dict]:
             # an async span closed at dispatch: its duration is the
             # host seam, not the collective the prediction models —
             # comparing them would corrupt the residual table
+            continue
+        if sp.get("cat") == "error":
+            # dump-on-error markers (sticky retcodes, deadline misses)
+            # carry the failing call's predicted/elapsed pair as
+            # DIAGNOSTIC detail — a wedged wait's elapsed time is not a
+            # measurement of the collective, and one miss would skew
+            # every residual median (and any band armed from it)
             continue
         meas = measured_seconds(sp)
         if meas <= 0:

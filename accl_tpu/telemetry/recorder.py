@@ -184,5 +184,42 @@ def on_sticky_retcode(function_name: str, retcode: int, *,
         f"sticky retcode 0x{int(retcode):x} from {function_name}")
 
 
+def on_deadline_miss(op: str, *, rank: int | None = None,
+                     count: int | None = None,
+                     predicted_s: float | None = None,
+                     deadline_s: float | None = None,
+                     elapsed_s: float | None = None,
+                     suspect_rank: int | None = None,
+                     retcode: int = 0) -> dict[str, Any] | None:
+    """Host-side dump-on-error twin of ``on_sticky_retcode``: a missed
+    model-derived deadline (resilience.DeadlinePolicy's verdict) is an
+    error event even when NO sticky native retcode exists — a silent
+    hang inside the old fixed-timeout tolerance window used to leave no
+    artifact at all.  Emits the marker span through the tracer (cat
+    "error", ``deadline_missed: true`` — the metrics error counter sees
+    it) and freezes the rings into the retained post-mortem.  No-op
+    unless the recorder is armed; never raises."""
+    if not _armed:
+        return None
+    args: dict[str, Any] = {"deadline_missed": True,
+                            "retcode": int(retcode)}
+    if rank is not None:
+        args["rank"] = int(rank)
+    if count is not None:
+        args["count"] = int(count)
+    if predicted_s is not None:
+        args["predicted_s"] = float(predicted_s)
+    if deadline_s is not None:
+        args["deadline_s"] = float(deadline_s)
+    if elapsed_s is not None:
+        args["measured_s"] = float(elapsed_s)
+    if suspect_rank is not None:
+        args["suspect_rank"] = int(suspect_rank)
+    get_tracer().emit(
+        op, "error", "errors" if rank is None else f"emu/r{rank}",
+        ts_ns=time.perf_counter_ns(), dur_ns=0, args=args)
+    return _recorder.freeze_error(f"deadline missed on {op}")
+
+
 def last_error_trace() -> dict[str, Any] | None:
     return _recorder.last_error_trace()

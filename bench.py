@@ -25,6 +25,7 @@ the calibrate_from_trace residual improvement — docs/observability.md).
 """
 
 import json
+import math
 import os
 import pathlib
 import sys
@@ -1621,6 +1622,478 @@ def _obs_gate_main():
         sys.exit(1)
 
 
+# the fault-gate contract (bench.py --fault-gate): a mid-stream rank
+# death on the native world must be detected through MODEL-DERIVED
+# deadlines (never a fixed timeout), survived within the bounded
+# retry+reconfigure budget with ZERO wrong answers (every recovery plan
+# re-certified through semantics + modelcheck before install; every
+# completed dispatch bitwise vs its oracle), and the ARMED deadline
+# seam's measured per-dispatch bookkeeping must cost <
+# FAULT_OVERHEAD_BUDGET of the per-dispatch median on the no-fault
+# control (the obs-gate's per-event-cost methodology), with zero false
+# misses and bitwise answers; the A/B wall delta is reported alongside.
+FAULT_GATE_WORLD = 4
+# 256 KiB fp32 per rank: dispatches run in the ms regime where a
+# deadline is a meaningful per-call bound, and the guard's per-wait
+# bookkeeping (one cached lookup + a perf_counter pair) sits far under
+# the 3% control budget instead of fighting scheduler noise at the
+# latency floor
+FAULT_GATE_COUNT = 65536
+FAULT_OVERHEAD_BUDGET = 0.03
+FAULT_RETRY_BUDGET = 1  # transient-straggler retries before exclusion
+FAULT_CONTROL_ROUNDS = 16
+FAULT_HEALTHY_DISPATCHES = 3  # completed pre-kill (the env lever's N)
+FAULT_RECOVERY_ROUNDS = 6
+
+
+def _fault_dispatch_round(world_obj, xs, count, guard=None, comm_addr=0,
+                          skip=(), iters=1):
+    """`iters` lockstep allreduce dispatches across the world: every
+    rank starts, then completes through the armed guard (deadline-
+    bounded) or a plain wait. Returns (wall_s_per_dispatch, last
+    results|None per rank); a rank in `skip` does nothing (the dead
+    rank after exclusion). iters > 1 amortizes the per-round thread
+    spawn out of the per-dispatch number (the overhead-gate
+    measurement must compare WAIT paths, not harness noise)."""
+    from accl_tpu import ReduceFunction
+    from accl_tpu.constants import Operation
+    from accl_tpu.descriptor import CallOptions
+
+    def body(rank, i):
+        if i in skip:
+            return None
+        out = np.zeros(count, np.float32)
+        for _k in range(iters):
+            h = rank.start(CallOptions(
+                scenario=Operation.allreduce, count=count,
+                function=int(ReduceFunction.SUM), data_type=3,
+                comm_addr=comm_addr), op0=xs[i].copy(), res=out)
+            if guard is not None:
+                guard.wait(rank, h, "allreduce", count)
+            else:
+                rank.wait(h)
+        return out
+
+    t0 = time.perf_counter()
+    results = world_obj.run(body)
+    return (time.perf_counter() - t0) / iters, results
+
+
+def _fault_gate_main():
+    """bench.py --fault-gate: the self-healing loop's measured claims
+    (ISSUE 14 acceptance), CI-gated:
+
+      1. NO-FAULT CONTROL with armed deadlines: interleaved lockstep
+         allreduce rounds on the 4-rank native TCP world, plain waits
+         vs NativeDeadlineGuard waits (deadlines derived from THIS
+         world's calibrated link + its measured residual band) — zero
+         false misses, every answer bitwise vs the oracle, and the
+         armed seam's measured per-wait bookkeeping under 3% of the
+         per-dispatch median (the A/B wall delta is reported
+         unvarnished but not gated: µs-scale bookkeeping under
+         ms-scale dispatches on a throttled host measures scheduler
+         luck, not the code path — the obs gate's methodology).
+
+      2. SOAK WITH INJECTED RANK DEATH: a fresh world armed with
+         ACCL_RT_FAULT_KILL_RANK kills the victim mid-stream after
+         FAULT_HEALTHY_DISPATCHES completed calls. Survivors must
+         detect through derived deadlines within the bounded
+         retry budget (every wedged attempt costs one deadline, never
+         a fixed timeout), attribute the suspect by silence, exclude,
+         re-plan over the survivor world and RE-CERTIFY through the
+         existing semantics + modelcheck stack (an uncertified plan is
+         never installed), fence the stale channel state
+         (accl_rt_flush_rx), and produce post-recovery answers on the
+         survivor communicator that match the numpy oracle over
+         survivors BITWISE.
+
+      3. CERTIFIED DEGRADED MODE on the XLA mesh: allreduce(mode=
+         "live_subset") over the same survivor set matches the
+         survivor oracle bitwise and its lifted schedule certifies
+         clean against the declared-survivor spec (zero wrong answers
+         is certifier-enforced, not asserted).
+
+      4. FLAT-VS-RECONFIGURED CROSSOVER: staying on the dead world
+         pays one derived deadline per dispatch forever; the measured
+         reconfiguration cost amortizes after
+         ceil(reconfig_s / (deadline_s - t_recovered_s)) dispatches —
+         gated finite (a recovered dispatch must beat the deadline).
+
+    stdout: ONE JSON line {metric, value = recovery wall seconds, ...}."""
+    import jax
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.constants import ACCLError, Operation
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.device.emu_device import EmuWorld
+    from accl_tpu.resilience import (
+        DeadlineMissedError,
+        DeadlinePolicy,
+        NativeDeadlineGuard,
+        ResilienceManager,
+        RetryBudget,
+    )
+    from accl_tpu.telemetry import calibrate_from_trace
+    from accl_tpu.telemetry import native as tnative
+    from accl_tpu.telemetry import recorder as flight
+    from accl_tpu.telemetry.tracer import SCHEMA_VERSION
+
+    world = FAULT_GATE_WORLD
+    count = FAULT_GATE_COUNT
+    victim = world - 2  # an interior rank: both ring neighbors survive
+    rng = np.random.default_rng(14)
+    xs = rng.integers(-32, 32, size=(world, count)).astype(np.float32)
+    oracle = xs.sum(0)
+    saved = {k: os.environ.get(k) for k in
+             ("ACCL_RT_TRACE", "ACCL_RT_FAULT_KILL_RANK",
+              "ACCL_RT_FAULT_KILL_AFTER")}
+    os.environ["ACCL_RT_TRACE"] = "1"
+    os.environ.pop("ACCL_RT_FAULT_KILL_RANK", None)
+    os.environ.pop("ACCL_RT_FAULT_KILL_AFTER", None)
+    wkw = dict(max_eager=tnative.DEFAULT_MAX_EAGER,
+               rx_buf_bytes=tnative.DEFAULT_RX_BUF)
+    try:
+        # -- calibrate: the link AND its honest residual band from THIS
+        # world's warm spans (the deadline is derived end to end)
+        wa = EmuWorld(world, transport="tcp", **wkw)
+        try:
+            _obs_sweep(wa, (count * 4,), 2)  # cold TCP sessions
+            for r in wa.ranks:
+                r.trace_read()
+            _obs_sweep(wa, (count * 4,), 6)
+            warm = _obs_drain_events(wa, link=None)
+            link = calibrate_from_trace(
+                {"schema": SCHEMA_VERSION, "spans": warm})
+            _obs_sweep(wa, (count * 4,), 6)
+            ref_events = _obs_drain_events(wa, link)
+            residuals = [
+                abs(ev["args"]["predicted_s"] - ev["args"]["measured_s"])
+                / ev["args"]["measured_s"]
+                for ev in ref_events
+                if ev["args"].get("predicted_s")
+                and ev["args"].get("measured_s", 0) > 0]
+            policy = DeadlinePolicy(link, world=world,
+                                    rx_buf_bytes=tnative.DEFAULT_RX_BUF,
+                                    max_eager_size=tnative.DEFAULT_MAX_EAGER)
+            ref = policy.arm_from_residuals("allreduce", residuals)
+            deadline_s = policy.deadline_s("allreduce", count)
+            print(f"  link: alpha {link.alpha * 1e6:.0f} us, beta "
+                  f"{link.beta / 1e9:.2f} GB/s; residual ref "
+                  f"{ref:.3f} over {len(residuals)} spans -> deadline "
+                  f"{deadline_s * 1e3:.1f} ms (predicted "
+                  f"{policy.predict_s('allreduce', count) * 1e3:.1f} ms)",
+                  file=sys.stderr)
+
+            # -- leg 1: armed vs unarmed control, interleaved ---------
+            # the control guard reports into its own manager, so the
+            # zero-false-misses claim below is a MEASUREMENT (a late
+            # success records a verdict there), not a fresh counter
+            mgr_probe = ResilienceManager(world, policy=policy)
+            guard = NativeDeadlineGuard(policy, manager=mgr_probe)
+            for r in wa.ranks:
+                guard.arm(r, "allreduce", count)
+            t_plain, t_armed = [], []
+            for _ in range(FAULT_CONTROL_ROUNDS):
+                s, res = _fault_dispatch_round(wa, xs, count, iters=8)
+                t_plain.append(s)
+                for out in res:
+                    assert np.array_equal(out, oracle), \
+                        "control (plain) answer wrong"
+                s, res = _fault_dispatch_round(wa, xs, count,
+                                               guard=guard, iters=8)
+                t_armed.append(s)
+                for out in res:
+                    assert np.array_equal(out, oracle), \
+                        "control (armed) answer wrong"
+            # The GATE measures the armed seam's deterministic per-wait
+            # bookkeeping (one cached policy lookup + a perf_counter
+            # pair + the deadline comparison) against the per-dispatch
+            # median — the obs-gate's methodology for a cost that is
+            # µs-scale under ms-scale dispatches: the A/B wall delta on
+            # a throttled CI host is scheduler noise either way (it
+            # measures the machine, not the code path) and is REPORTED
+            # unvarnished below, not gated.
+            reps = 20_000
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _p, _dl = policy.predict_and_deadline("allreduce", count)
+                _s = time.perf_counter()
+                _ok = (time.perf_counter() - _s) <= _dl
+            seam_s = (time.perf_counter() - t0) / reps
+            per_dispatch = float(np.median(t_plain))
+            overhead = seam_s / max(per_dispatch, 1e-9)
+            wall_delta = (float(np.median(t_armed))
+                          / max(per_dispatch, 1e-9)) - 1.0
+            print(f"  control: armed seam {seam_s * 1e9:.0f} ns/dispatch"
+                  f" = {overhead * 100:.3f}% of the "
+                  f"{per_dispatch * 1e3:.2f} ms/dispatch median; A/B "
+                  f"wall delta {wall_delta * 100:+.2f}% over "
+                  f"{FAULT_CONTROL_ROUNDS} interleaved rounds "
+                  f"(reported, not gated — host noise); "
+                  f"{len(mgr_probe.misses)} misses", file=sys.stderr)
+        finally:
+            wa.close()
+
+        # -- leg 2: the soak with an injected mid-stream death --------
+        os.environ["ACCL_RT_FAULT_KILL_RANK"] = str(victim)
+        os.environ["ACCL_RT_FAULT_KILL_AFTER"] = str(
+            FAULT_HEALTHY_DISPATCHES)
+        wb = EmuWorld(world, transport="tcp", **wkw)
+        os.environ.pop("ACCL_RT_FAULT_KILL_RANK", None)
+        os.environ.pop("ACCL_RT_FAULT_KILL_AFTER", None)
+        try:
+            budget = RetryBudget(max_retries=FAULT_RETRY_BUDGET,
+                                 backoff_base_s=0.02)
+            mgr = ResilienceManager(world, policy=policy, budget=budget)
+            guard = NativeDeadlineGuard(policy)
+            for r in wb.ranks:
+                guard.arm(r, "allreduce", count)
+            for _k in range(FAULT_HEALTHY_DISPATCHES):
+                _s, res = _fault_dispatch_round(wb, xs, count,
+                                                guard=guard)
+                for out in res:
+                    assert np.array_equal(out, oracle), \
+                        "pre-kill answer wrong"
+            assert not mgr.misses
+
+            # the victim's next call dies mid-stream (the env lever);
+            # survivors wedge and must detect within the retry budget,
+            # each attempt one lockstep phase (threads joined so the
+            # stale-frame window stays inside peers' live calls)
+            t_kill = time.perf_counter()
+            attempts = 0
+            action = None
+            while action != "exclude":
+                def attempt(rank, i):
+                    if i == victim:
+                        if attempts == 0:
+                            try:  # the dying call itself
+                                out = np.zeros(count, np.float32)
+                                rank.allreduce(xs[i].copy(), out, count,
+                                               ReduceFunction.SUM)
+                            except ACCLError:
+                                pass
+                        return None
+                    out = np.zeros(count, np.float32)
+                    h = rank.start(CallOptions(
+                        scenario=Operation.allreduce, count=count,
+                        function=int(ReduceFunction.SUM), data_type=3),
+                        op0=xs[i].copy(), res=out)
+                    try:
+                        guard.wait(rank, h, "allreduce", count)
+                        return ("ok", out)
+                    except DeadlineMissedError as e:
+                        return ("miss", e.miss)
+
+                verdicts = wb.run(attempt)
+                reporters = [i for i, v in enumerate(verdicts)
+                             if v is not None and v[0] == "miss"]
+                if sorted(reporters) != sorted(
+                        r for r in range(world) if r != victim):
+                    print(f"FAIL: attempt {attempts}: survivors "
+                          f"{reporters} missed, expected all of "
+                          f"{[r for r in range(world) if r != victim]}",
+                          file=sys.stderr)
+                    sys.exit(1)
+                suspect = mgr.attribute_silent(reporters)
+                assert suspect == victim, \
+                    f"attribution named {suspect}, victim is {victim}"
+                import dataclasses as _dc
+
+                rep = _dc.replace(verdicts[reporters[0]][1],
+                                  suspect_rank=suspect,
+                                  attribution="silent")
+                action = mgr.record_miss(rep)
+                attempts += 1
+                if action == "retry":
+                    time.sleep(mgr.retry_delay_s(suspect))
+            detect_s = time.perf_counter() - t_kill
+            # bounded-time detection: each attempt pays ONE derived
+            # deadline (+ the guard's slack + backoff), never a fixed
+            # constant — the budget is a function of the model
+            detect_budget = attempts * (
+                deadline_s * NativeDeadlineGuard.HOST_WAIT_SLACK
+                + budget.delay_s(attempts) + 1.0)
+            print(f"  death detected in {attempts} attempts / "
+                  f"{detect_s:.2f} s (budget {detect_budget:.2f} s); "
+                  f"suspect r{victim} by silence; "
+                  f"{len(mgr.misses)} verdicts, post-mortem "
+                  f"{'present' if flight.last_error_trace() else 'MISSING'}",
+                  file=sys.stderr)
+
+            survivors = mgr.exclude(victim)
+            t_replan0 = time.perf_counter()
+            rplan = mgr.replan(Operation.allreduce, count=count)
+            mgr.install(rplan)
+            for g in survivors:
+                wb.ranks[g].flush_rx()  # the reconfiguration fence
+            replan_s = time.perf_counter() - t_replan0
+            assert rplan.certificate["diagnostics"] == 0
+
+            # survivor communicator + post-recovery soak, bitwise
+            from accl_tpu.communicator import Communicator, Rank
+            from accl_tpu.device.base import CCLOAddr
+
+            addr = int(CCLOAddr.DYNAMIC_BASE)
+            comm = Communicator(
+                [Rank(device_index=g, session_id=g) for g in survivors],
+                0, addr)
+            surv_oracle = xs[list(survivors)].sum(0)
+            t_comm0 = time.perf_counter()
+            for g in survivors:
+                wb.ranks[g].write_communicator(comm)
+                guard.arm(wb.ranks[g], "allreduce", count)
+            comm_s = time.perf_counter() - t_comm0
+            t_rec = []
+            for _k in range(FAULT_RECOVERY_ROUNDS):
+                s, res = _fault_dispatch_round(
+                    wb, xs, count, guard=guard, comm_addr=addr,
+                    skip=(victim,))
+                t_rec.append(s)
+                for i, out in enumerate(res):
+                    if i == victim:
+                        continue
+                    if not np.array_equal(out, surv_oracle):
+                        print(f"FAIL: post-recovery answer wrong on "
+                              f"r{i}", file=sys.stderr)
+                        sys.exit(1)
+            t_rec_med = float(np.median(t_rec))
+            recovery_s = detect_s + replan_s + comm_s + t_rec[0]
+            print(f"  recovery: replan+certify+install+fence "
+                  f"{replan_s:.2f} s ({rplan.source}"
+                  f"{' ' + rplan.synth_key if rplan.synth_key else ''}),"
+                  f" comm setup {comm_s * 1e3:.1f} ms, first good "
+                  f"dispatch {t_rec[0] * 1e3:.1f} ms -> total "
+                  f"{recovery_s:.2f} s; steady post-recovery "
+                  f"{t_rec_med * 1e3:.2f} ms/dispatch", file=sys.stderr)
+        finally:
+            wb.close()
+
+        # -- leg 3: certified degraded mode on the XLA mesh -----------
+        from jax.sharding import Mesh
+
+        from accl_tpu import ACCL
+        from accl_tpu.analysis import semantics
+        from accl_tpu.constants import DataType, TuningParams
+        from accl_tpu.sequencer.plan import select_algorithm
+
+        devs = jax.devices()
+        if len(devs) < world:
+            print(f"FAIL: degraded-mode leg needs {world} devices, have "
+                  f"{len(devs)} (set XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+            sys.exit(1)
+        accl = ACCL(Mesh(np.array(devs[:world]), ("ccl",)))
+        n_deg = 4096
+        deg_data = rng.integers(-32, 32,
+                                size=(world, n_deg)).astype(np.float32)
+        a = accl.create_buffer(n_deg, np.float32, deg_data)
+        b = accl.create_buffer(n_deg, np.float32)
+        accl.allreduce(a, b, n_deg, ReduceFunction.SUM,
+                       mode="live_subset", live_ranks=survivors)
+        deg_want = deg_data[list(survivors)].sum(0)
+        degraded_ok = bool(np.array_equal(
+            np.asarray(b.host), np.tile(deg_want, (world, 1))))
+        deg_opts = CallOptions(
+            scenario=Operation.allreduce, count=n_deg,
+            function=int(ReduceFunction.SUM),
+            data_type=DataType.float32, live_ranks=survivors)
+        deg_plan = select_algorithm(
+            Operation.allreduce, n_deg, 4, world,
+            max_eager_size=1024, eager_rx_buf_size=1024,
+            tuning=TuningParams.default(), live_ranks=survivors)
+        deg_diags = semantics.certify_call(deg_opts, deg_plan, world)
+        print(f"  degraded live_subset{tuple(survivors)}: bitwise "
+              f"{'ok' if degraded_ok else 'WRONG'}, certifier "
+              f"{'clean' if not deg_diags else [str(d) for d in deg_diags]}",
+              file=sys.stderr)
+
+        # -- leg 4: flat-vs-reconfigured crossover --------------------
+        # staying on the dead world pays one derived deadline (plus the
+        # guard's failure handling) per dispatch, forever; the measured
+        # one-time reconfiguration cost amortizes after:
+        reconfig_s = replan_s + comm_s
+        per_dispatch_saving = deadline_s - t_rec_med
+        crossover = (math.ceil(reconfig_s / per_dispatch_saving)
+                     if per_dispatch_saving > 0 else None)
+        print(f"  crossover: wedged {deadline_s * 1e3:.1f} ms vs "
+              f"recovered {t_rec_med * 1e3:.2f} ms per dispatch; "
+              f"reconfig {reconfig_s:.2f} s amortizes after "
+              f"{crossover} dispatches", file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    print(json.dumps({
+        "metric": "fault gate: mid-stream rank death detected by "
+                  f"model-derived deadlines and recovered (w{world} "
+                  "native TCP; certified replan + survivor "
+                  "communicator + certified degraded mode)",
+        "value": round(recovery_s, 3),
+        "unit": "s to first post-recovery dispatch",
+        "platform": "cpu-emulator",
+        "deadline_ms": round(deadline_s * 1e3, 2),
+        "predicted_ms": round(
+            policy.predict_s("allreduce", count) * 1e3, 3),
+        "residual_reference": round(ref, 4),
+        "detect_attempts": attempts,
+        "detect_s": round(detect_s, 3),
+        "detect_budget_s": round(detect_budget, 3),
+        "replan_s": round(replan_s, 3),
+        "replan_source": rplan.source,
+        "certificate": rplan.certificate,
+        "survivors": list(survivors),
+        "post_recovery_dispatch_ms": round(t_rec_med * 1e3, 3),
+        "armed_overhead_pct": round(overhead * 100, 4),
+        "armed_overhead_budget_pct": FAULT_OVERHEAD_BUDGET * 100,
+        "armed_seam_ns_per_dispatch": round(seam_s * 1e9),
+        "control_wall_delta_pct": round(wall_delta * 100, 2),
+        "control_misses": len(mgr_probe.misses),
+        "degraded_bitwise_ok": degraded_ok,
+        "degraded_certifier_diags": len(deg_diags),
+        "flat_vs_reconfigured_crossover_dispatches": crossover,
+    }))
+    if overhead >= FAULT_OVERHEAD_BUDGET:
+        print(f"FAIL: the armed deadline seam costs "
+              f"{overhead * 100:.2f}% of the per-dispatch median "
+              f"(budget {FAULT_OVERHEAD_BUDGET * 100:.0f}%)",
+              file=sys.stderr)
+        sys.exit(1)
+    if mgr_probe.misses:
+        print(f"FAIL: {len(mgr_probe.misses)} false deadline misses on "
+              "the no-fault control — a band that flags healthy "
+              "dispatches would make every verdict untrustworthy",
+              file=sys.stderr)
+        sys.exit(1)
+    if attempts != FAULT_RETRY_BUDGET + 1:
+        print(f"FAIL: detection took {attempts} attempts, the budget "
+              f"bounds it at {FAULT_RETRY_BUDGET + 1}", file=sys.stderr)
+        sys.exit(1)
+    if detect_s > detect_budget:
+        print(f"FAIL: detection took {detect_s:.2f} s, over the "
+              f"deadline-derived budget {detect_budget:.2f} s",
+              file=sys.stderr)
+        sys.exit(1)
+    if flight.last_error_trace() is None:
+        print("FAIL: no flight-recorder post-mortem was frozen for the "
+              "deadline misses", file=sys.stderr)
+        sys.exit(1)
+    if not degraded_ok or deg_diags:
+        print("FAIL: certified degraded mode wrong or uncertified "
+              f"(bitwise={degraded_ok}, diags="
+              f"{[str(d) for d in deg_diags]})", file=sys.stderr)
+        sys.exit(1)
+    if crossover is None:
+        print("FAIL: a recovered dispatch does not beat the wedged "
+              "deadline — reconfiguration would never amortize",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def _hier_run_composed(locals_, outers, pods, inner, nbytes, iters,
                        stripes=1, check=None):
     """Drive the composed two-tier allreduce on the native emulated
@@ -3038,6 +3511,8 @@ if __name__ == "__main__":
         _trace_main()
     elif "--obs-gate" in sys.argv:
         _obs_gate_main()
+    elif "--fault-gate" in sys.argv:
+        _fault_gate_main()
     elif "--hier-gate" in sys.argv:
         _hier_gate_main()
     elif "--check" in sys.argv or "--write-baseline" in sys.argv:

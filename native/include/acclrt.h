@@ -80,6 +80,24 @@ uint64_t accl_rt_duration_ns(accl_rt_t *rt, int64_t handle);
 /* Drop a completed call's bookkeeping (after reading retcode/duration). */
 void accl_rt_release(accl_rt_t *rt, int64_t handle);
 
+/* Permanently wedge the rank — the programmatic form of
+ * ACCL_RT_FAULT_KILL_RANK (fault injection for the self-healing soak):
+ * every in-flight and future call completes with a sticky
+ * RECEIVE_TIMEOUT retcode (recorded as a final trace-ring span when
+ * tracing is armed) and the wire goes dark in both directions; peers
+ * observe a dead host's silence. Irreversible for the runtime's
+ * lifetime; idempotent. */
+void accl_rt_kill(accl_rt_t *rt);
+
+/* Reconfiguration fence: drop every landed-but-unconsumed eager frame
+ * (advancing the per-peer inbound seqn past it) and clear the stale
+ * rendezvous queues. Call on every survivor, QUIESCENT (no live calls,
+ * peer deliveries settled), between excluding a dead rank and the
+ * recovery communicator's first call: the seqn-ordered streamed
+ * matching would otherwise deliver the old membership's aborted-
+ * collective frames into the new membership's first recv as data. */
+void accl_rt_flush_rx(accl_rt_t *rt);
+
 /* Exchange-memory MMIO (byte-addressed words, 8 KB). */
 uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr);
 void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value);

@@ -384,6 +384,9 @@ struct Call {
   void *op0, *op1, *res;
   bool started = false;  // has executed at least one pass (holds its
                          // communicator's in-flight serialization slot)
+  // counted once against the ACCL_RT_FAULT_KILL_AFTER budget (a
+  // NOT_READY requeue must not burn the countdown twice)
+  bool started_counted = false;
   uint32_t current_step = 0;  // resumption point across NOT_READY requeues
   // resolved communicator persists across requeues like current_step
   bool comm_resolved = false;
@@ -685,6 +688,29 @@ struct accl_rt {
   // One-shot by design: the fault arms once per runtime.
   int fault_delay_tail_ms = 0;
   bool fault_drop_tail = false;
+  //   ACCL_RT_FAULT_KILL_RANK=R       rank R wedges PERMANENTLY (not the
+  //     one-shot tail levers above): after ACCL_RT_FAULT_KILL_AFTER=N
+  //     completed data-plane calls (default 0 — the very next call dies)
+  //     every in-flight and future call on the rank completes with a
+  //     sticky RECEIVE_TIMEOUT retcode — recorded as a FINAL trace-ring
+  //     span, so the host flight recorder fires on the death — and the
+  //     wire goes dark in both directions: outbound frames are dropped
+  //     before the transport, inbound frames are read off the socket
+  //     (framing preserved for the peer's tx path) and discarded. Peers
+  //     observe exactly what a dead host produces: silence, surfacing as
+  //     their own recv deadlines. accl_rt_kill() is the programmatic
+  //     form (the fault-gate soak kills a rank mid-stream).
+  std::atomic<bool> killed{false};
+  int kill_after_calls = -1;  // sequencer-thread only; -1 = unarmed
+
+  void wedge() {
+    killed.store(true, std::memory_order_release);
+    // wake everything that could be parked so in-flight calls reach
+    // the kill check (and die with their sticky span) promptly
+    rx_event();
+    call_cv.notify_all();
+    rndzv_cv.notify_all();
+  }
   // ACCL_RT_WAN_ALPHA_US / ACCL_RT_WAN_GBPS: WAN shaper for the socket
   // transports — every outbound frame pays alpha + bytes/beta on its
   // per-destination link (inside tx_mu, so frames to one peer
@@ -812,6 +838,9 @@ struct accl_rt {
   bool local_deliver(const MsgHeader &h, const uint8_t *payload,
                      size_t plen) {
     if (stop.load()) return false;
+    // dead host semantics for the in-process POE: frames into a wedged
+    // rank are swallowed (never landed, never blocking the sender)
+    if (killed.load(std::memory_order_relaxed)) return true;
     switch (h.msg_type) {
       case MSG_EGR_DATA: {
         {
@@ -884,6 +913,12 @@ struct accl_rt {
                  uint64_t bytes, uint64_t vaddr, const void *payload,
                  size_t payload_len, uint32_t host = 0,
                  uint64_t msg_bytes = 0, uint64_t msg_off = 0) {
+    // a wedged rank's wire is dark: outbound frames vanish before the
+    // transport (bring-up hellos stay exempt so a pre-armed kill can
+    // never wedge a PEER's creation barrier)
+    if (killed.load(std::memory_order_relaxed) && mt != MSG_HELLO &&
+        mt != MSG_HELLO_ACK)
+      return true;
     MsgHeader h{};
     h.magic = MSG_MAGIC;
     h.msg_type = mt;
@@ -1045,6 +1080,7 @@ struct accl_rt {
         case MSG_EGR_DATA: {
           size_t plen = (size_t)h.bytes;
           if ((ssize_t)(sizeof h + plen) != n) continue;  // truncated
+          if (killed.load(std::memory_order_relaxed)) break;  // dead host
           payload.assign(pkt.data() + sizeof h, pkt.data() + sizeof h + plen);
           if (!land_eager(h, std::move(payload), /*allow_grow=*/true))
             return;
@@ -1125,6 +1161,14 @@ struct accl_rt {
       size_t plen = 0;
       if (h.msg_type == MSG_EGR_DATA || h.msg_type == MSG_RNDZV_WRITE)
         plen = (size_t)h.bytes;
+      if (killed.load(std::memory_order_relaxed)) {
+        // wedged rank: payload bytes are read off the socket (the
+        // peer's tx framing must not block on a dead consumer) and
+        // discarded — nothing lands, nothing completes
+        payload.resize(plen);
+        if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
+        continue;
+      }
       // Direct placement: a registered landing whose message this
       // segment continues takes the payload straight off the socket
       // into the final buffer — no slot, no staging copy. Eligible only
@@ -1531,6 +1575,42 @@ struct accl_rt {
   // grown ring back to the configured size once fully drained so one
   // burst does not permanently retain payload memory (all slots idle
   // implies the index is empty).
+  // Reconfiguration fence (accl_rt_flush_rx): drop every landed-but-
+  // unconsumed eager frame and advance the per-peer inbound seqn past
+  // it, then clear the stale rendezvous queues. After a membership
+  // change, frames of the OLD world's aborted collectives may sit in
+  // the ring (per-op progress re-arms deadlines, so one survivor's
+  // wedged call can outlive another's final send) — and the seqn-
+  // ordered streamed matching would deliver them into the NEW world's
+  // first recv as data. Caller contract: quiescent — no live calls on
+  // this rank and peers' in-flight deliveries settled (the recovery
+  // driver joins/barriers its survivors first); an in-flight frame
+  // arriving after the fence carries a seqn below the advanced
+  // inbound_seq and is dropped by land_eager's late-duplicate check.
+  void flush_rx() {
+    {
+      std::lock_guard<std::mutex> g(rx_mu);
+      for (size_t i = 0; i < rx_slots.size(); i++) {
+        RxSlot &s = rx_slots[i];
+        if (s.status != RxSlot::VALID) continue;
+        uint32_t src = s.src;
+        if ((int32_t)(s.seqn + 1 - inbound_seq[src]) > 0)
+          inbound_seq[src] = s.seqn + 1;
+        rx_index.erase(rx_key(src, s.seqn));
+        src_valid_count[src]--;
+        release_slot_locked(i);  // may compact: the loop bound re-reads
+      }
+      rx_drain_srcs.clear();
+      rx_cv.notify_all();
+    }
+    {
+      std::lock_guard<std::mutex> g(rndzv_mu);
+      addr_q.clear();
+      done_q.clear();
+      rndzv_cv.notify_all();
+    }
+  }
+
   void release_slot_locked(size_t i) {
     RxSlot &s = rx_slots[i];
     s.status = RxSlot::IDLE;
@@ -2461,6 +2541,15 @@ struct accl_rt {
   // arithconfig.hpp:102-119): cast operands to fp16 scratch, run the
   // whole collective at half wire width, cast the result back.
   uint32_t execute(Call &c) {
+    // A wedged rank (accl_rt_kill / ACCL_RT_FAULT_KILL_RANK): every
+    // call — in-flight retries included — terminates NOW with the
+    // sticky RECEIVE_TIMEOUT word. The terminal path below records the
+    // span, so the death leaves a final sticky-retcode record in the
+    // trace ring for the host flight recorder to fire on.
+    if (killed.load(std::memory_order_acquire)) {
+      if (c.cstate) revoke_call_postings(c);
+      return RECEIVE_TIMEOUT_ERROR;
+    }
     // The firmware caches the communicator addressed by desc word 2 per
     // call (ccl_offload_control.c:2317-2372); malformed tables or calls
     // from a non-member rank fail descriptor decode. The resolved view
@@ -2799,6 +2888,17 @@ struct accl_rt {
       }
       if (getenv("ACCL_RT_DEBUG") && c.desc[0] != SC_RECV)
         fprintf(stderr, "[r%u] exec scenario=%u count=%u\n", rank, c.desc[0], c.desc[1]);
+      // ACCL_RT_FAULT_KILL_RANK countdown: after N completed data-plane
+      // calls the rank wedges permanently (config/nop are host plumbing
+      // and never count — the soak kills mid data stream)
+      if (kill_after_calls >= 0 && !killed.load(std::memory_order_relaxed) &&
+          c.desc[0] != SC_CONFIG && c.desc[0] != SC_NOP && !c.started_counted) {
+        c.started_counted = true;
+        if (kill_after_calls == 0)
+          wedge();
+        else
+          kill_after_calls--;
+      }
       uint64_t ev0 = rx_events.load(std::memory_order_acquire);
       stat_passes++;
       uint32_t rc = execute(c);
@@ -2893,6 +2993,13 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     rt->fault_delay_tail_ms = atoi(s);
   if (const char *s = getenv("ACCL_RT_FAULT_DROP_TAIL"))
     rt->fault_drop_tail = atoi(s) != 0;
+  if (const char *s = getenv("ACCL_RT_FAULT_KILL_RANK")) {
+    if ((uint32_t)atoi(s) == rank) {
+      rt->kill_after_calls = 0;
+      if (const char *a = getenv("ACCL_RT_FAULT_KILL_AFTER"))
+        rt->kill_after_calls = atoi(a) < 0 ? 0 : atoi(a);
+    }
+  }
   if (const char *s = getenv("ACCL_RT_WAN_ALPHA_US"))
     rt->wan_alpha_us = (uint32_t)atoi(s);
   if (const char *s = getenv("ACCL_RT_WAN_GBPS")) {
@@ -3198,6 +3305,15 @@ void accl_rt_release(accl_rt_t *rt, int64_t handle) {
 }
 
 uint32_t accl_rt_read(accl_rt_t *rt, uint32_t addr) { return rt->rd(addr); }
+
+// Permanently wedge a rank (see the ACCL_RT_FAULT_KILL_RANK lever): the
+// programmatic kill the fault-gate soak fires mid-stream. Idempotent.
+void accl_rt_kill(accl_rt_t *rt) { rt->wedge(); }
+
+// Reconfiguration fence (see accl_rt::flush_rx): drain stale frames of
+// the old membership's aborted collectives before the recovery
+// communicator's first call. Quiescent caller contract.
+void accl_rt_flush_rx(accl_rt_t *rt) { rt->flush_rx(); }
 
 // Cumulative sequencer counters (execute passes, event-counter parks,
 // nanoseconds parked, rx-seek hits/misses): the always-on form of the

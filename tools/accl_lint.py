@@ -134,6 +134,10 @@ def _step_from_dict(d: dict) -> CallOptions:
         data_type=data_type,
         compress_dtype=compress_dtype,
         compression_flags=comp_flags,
+        # "live_ranks": the declared surviving-contributor set of a
+        # degraded live-subset allreduce (the certifier's spec demands
+        # exactly these ranks' contributions — docs/resilience.md)
+        live_ranks=tuple(int(r) for r in d.get("live_ranks", ())),
     )
 
 
@@ -145,6 +149,7 @@ def _default_plan(opts: CallOptions, world: int):
         eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
         tuning=TuningParams.default(DEFAULT_MAX_RENDEZVOUS_SIZE),
         compress_dtype=opts.compress_dtype,
+        live_ranks=opts.live_ranks,
     )
 
 
@@ -394,6 +399,18 @@ def run_schedules(deep: bool = False, sample: int = 0,
             configs.append((world, Operation.allreduce, 0, count,
                             "olap", olap_tuning, DataType.none,
                             ("olap", stripes)))
+        # degraded live-subset allreduce cells (accl_tpu/resilience/,
+        # docs/resilience.md): the source-masked ring selected through
+        # live_ranks — the certifier must prove the answer sums EXACTLY
+        # the declared survivor set (all-but-one and a half-world set;
+        # deduplicated — at world 2 the two coincide)
+        for count in (16, 8192):
+            for lr in sorted({
+                    tuple(r for r in range(world) if r != world - 1),
+                    tuple(range(max(world // 2, 1)))}):
+                configs.append((world, Operation.allreduce, 0, count,
+                                "live", tunings["default"], DataType.none,
+                                ("live", lr)))
     # hierarchical two-tier cells (sequencer/hierarchical.py): the
     # striped composition selected through the register window for
     # every (inner, outer) factoring, several stripe depths, and the
@@ -442,6 +459,8 @@ def run_schedules(deep: bool = False, sample: int = 0,
             else None
         synth_tier = (extra[1] if extra is not None
                       and extra[0] == "synth_tier" else None)
+        live = extra[1] if extra is not None and extra[0] == "live" \
+            else None
         from accl_tpu.constants import CompressionFlags
 
         rsd = root if scen != Operation.send \
@@ -454,7 +473,7 @@ def run_schedules(deep: bool = False, sample: int = 0,
             function=int(ReduceFunction.SUM),
             data_type=DataType.float32,
             compress_dtype=wire, compression_flags=comp_flags,
-            peer_counts=a2av or ())
+            peer_counts=a2av or (), live_ranks=live or ())
         hier_kw: dict = {}
         if hier is not None or synth_tier is not None:
             from accl_tpu.sequencer.timing import LinkParams, TierLinks
@@ -497,7 +516,12 @@ def run_schedules(deep: bool = False, sample: int = 0,
             max_eager_size=DEFAULT_MAX_EAGER_SIZE,
             eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE,
             tuning=tuning, compress_dtype=wire,
-            peer_counts=a2av or (), **hier_kw, **olap_kw)
+            peer_counts=a2av or (), live_ranks=live or (),
+            **hier_kw, **olap_kw)
+        if live is not None:
+            assert plan.algorithm.name == "EAGER_RING_RS_AG" \
+                and plan.live_ranks == live, \
+                f"live-subset config did not select the masked ring: {plan}"
         if olap is not None:
             import dataclasses as _dc
 
