@@ -38,6 +38,28 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+# Field names of the versioned accl_rt_get_stats2 counter surface, in
+# native index order (native/include/acclrt.h accl_rt_stat2). The first
+# five are the classic sequencer counters; the rest are the reliability
+# sublayer's wire-health counters (CRC/dup drops, selective-retransmit
+# ack/nack traffic, seeded fault-injection tallies, cumulative ns of
+# CRC+ack bookkeeping). The native return value may exceed
+# len(STATS2_FIELDS) on a newer library — unknown trailing counters are
+# ignored, never misnamed.
+STATS2_FIELDS = (
+    "passes", "parks", "park_ns", "seek_hit", "seek_miss",
+    "tx_frames", "rx_frames", "crc_drops", "dup_drops",
+    "retx_sent", "retx_miss", "nack_sent", "nack_rx",
+    "ack_sent", "ack_rx", "rndzv_drops",
+    "inj_loss", "inj_corrupt", "inj_dup", "inj_reorder", "rely_ns",
+)
+
+# (The repair-activity subset the resilience escalation policy reads —
+# lossy-link vs dead-rank classification — is single-sourced as
+# telemetry.export.WIRE_FAULT_KEYS, next to the exporter that renders
+# these counters.)
+
+
 class NativeSpan(ctypes.Structure):
     """ctypes mirror of accl_rt_span_t (native/include/acclrt.h): one
     record of the device-resident trace ring per completed call."""
@@ -113,6 +135,11 @@ def load_native():
                                       ctypes.c_uint32]
         lib.accl_rt_get_stats.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(ctypes.c_uint64)]
+        lib.accl_rt_get_stats2.restype = ctypes.c_size_t
+        lib.accl_rt_get_stats2.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_size_t,
+        ]
         lib.accl_rt_dump_rxbufs.restype = ctypes.c_size_t
         lib.accl_rt_dump_rxbufs.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                             ctypes.c_size_t]
@@ -260,6 +287,23 @@ class EmuRank:
         self._lib.accl_rt_get_stats(self._rt, buf)
         return {"passes": buf[0], "parks": buf[1], "park_ns": buf[2],
                 "seek_hit": buf[3], "seek_miss": buf[4]}
+
+    def wire_stats(self) -> dict:
+        """Full versioned counter surface (accl_rt_get_stats2): the
+        sequencer counters PLUS the reliability sublayer's wire-health
+        counters — frames tx/rx, CRC-corrupt and duplicate drops,
+        selective-retransmit ack/nack traffic, the seeded fault model's
+        injection tallies, and the cumulative CRC+ack bookkeeping ns.
+        Diff two snapshots to judge one phase of a run; the resilience
+        manager consumes exactly that delta to tell a lossy link from a
+        dark one (docs/resilience.md escalation policy)."""
+        cap = len(STATS2_FIELDS)
+        buf = (ctypes.c_uint64 * cap)()
+        n = min(int(self._lib.accl_rt_get_stats2(self._rt, buf, cap)), cap)
+        # schema-stable: every known field present (zero when the
+        # library predates it), unknown trailing counters ignored
+        return {name: int(buf[i]) if i < n else 0
+                for i, name in enumerate(STATS2_FIELDS)}
 
     def trace_read(self, chunk: int = 4096) -> tuple[list[dict], int]:
         """Drain this rank's device-resident trace ring (ACCL_RT_TRACE=1;

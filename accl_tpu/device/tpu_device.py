@@ -904,6 +904,17 @@ class TPUDevice(CCLODevice):
                         f"tag {tag} seq {seq} count {opts.count}")
         return "\n".join(lines)
 
+    def wire_stats(self) -> dict:
+        """The stats2 counter surface mirrored onto the XLA tier
+        (EmuRank.wire_stats's schema, every field zero): XLA owns this
+        backend's data plane — there is no native wire, so there are no
+        native wire faults to count — but consumers (telemetry wire-
+        health export, the resilience manager's lossy-vs-dark
+        classifier) read one stable dict shape across device kinds."""
+        from .emu_device import STATS2_FIELDS
+
+        return {name: 0 for name in STATS2_FIELDS}
+
     # -- config calls (ACCL_CONFIG switch, .c:2416-2452) -------------------
 
     def _config(self, options: CallOptions) -> BaseRequest:

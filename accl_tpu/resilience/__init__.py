@@ -29,10 +29,20 @@ instead of growing a parallel one:
     ACCL501-proven alltoallv drop-to-zeros posture generalized to the
     reduction).
 
-Measured end to end by ``bench.py --fault-gate`` (CI): a mid-stream
-rank death on the native emulated world recovers within the bounded
-retry+reconfigure budget with zero wrong answers, and the armed-
-deadline control shows <3% overhead over unarmed waits.
+Below the loop sits the transport's reliability sublayer (CRC32C
+frames + selective retransmit, ``native/src/runtime.cpp``): transient
+wire faults are repaired in microseconds at the transport, and the
+manager's escalation policy (``assess_miss`` over per-rank wire-health
+deltas) tells a LOSSY link — frames arriving-but-damaged, a structured
+:class:`IntegrityFault`, no reconfiguration — from a genuinely DARK
+one, which alone walks the retry→exclude→replan path.
+
+Measured end to end by ``bench.py --fault-gate`` and ``--chaos-gate``
+(CI): a mid-stream rank death on the native emulated world recovers
+within the bounded retry+reconfigure budget with zero wrong answers
+(armed-deadline control <3% overhead over unarmed waits), and the
+seeded loss/corrupt/dup/reorder soak stays bitwise with zero false
+dead-rank escalations under <3% no-fault CRC+ack overhead.
 """
 
 from .deadline import (  # noqa: F401
@@ -44,6 +54,7 @@ from .deadline import (  # noqa: F401
     NativeDeadlineGuard,
 )
 from .manager import (  # noqa: F401
+    IntegrityFault,
     RecoveryPlan,
     ResilienceManager,
     RetryBudget,
@@ -56,6 +67,7 @@ __all__ = [
     "DeadlineMissed",
     "DeadlineMissedError",
     "DeadlinePolicy",
+    "IntegrityFault",
     "NativeDeadlineGuard",
     "RecoveryPlan",
     "ResilienceManager",

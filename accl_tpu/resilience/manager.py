@@ -50,6 +50,57 @@ from ..descriptor import CallOptions
 from .deadline import DeadlineMissed, DeadlinePolicy
 
 
+@dataclasses.dataclass(frozen=True)
+class IntegrityFault:
+    """Structured verdict for a LOSSY link: the suspect's frames are
+    arriving-but-damaged (the observers' wire-health counters show CRC
+    drops / retransmits / nack round-trips climbing), so the transport's
+    reliability sublayer is absorbing the fault below this layer — the
+    wrong response is a ~1 s certified reconfiguration.  Raised (as a
+    recorded verdict, like :class:`DeadlineMissed`) instead of consuming
+    the dead-rank retry budget; only a genuinely dark wire escalates to
+    the exclude→replan path (docs/resilience.md escalation policy)."""
+
+    op: str
+    count: int
+    suspect_rank: int | None
+    crc_drops: int = 0
+    dup_drops: int = 0
+    retransmits: int = 0
+    retx_misses: int = 0
+    nack_round_trips: int = 0
+    elapsed_s: float = 0.0
+    post_mortem: dict | None = None
+
+    def verdict(self) -> dict[str, Any]:
+        """JSON-ready rendering (the chaos-gate artifact / logs)."""
+        out: dict[str, Any] = {
+            "kind": "integrity_fault",
+            "op": self.op,
+            "count": self.count,
+            "crc_drops": self.crc_drops,
+            "dup_drops": self.dup_drops,
+            "retransmits": self.retransmits,
+            "retx_misses": self.retx_misses,
+            "nack_round_trips": self.nack_round_trips,
+            "elapsed_s": self.elapsed_s,
+        }
+        if self.suspect_rank is not None:
+            out["suspect_rank"] = self.suspect_rank
+        out["post_mortem_spans"] = (len(self.post_mortem.get("spans", []))
+                                    if self.post_mortem else 0)
+        return out
+
+    def __str__(self) -> str:
+        sus = (f" suspect r{self.suspect_rank};"
+               if self.suspect_rank is not None else "")
+        return (f"IntegrityFault: {self.op} count={self.count};{sus} "
+                f"lossy link absorbed below the resilience layer "
+                f"(crc_drops={self.crc_drops} dup_drops={self.dup_drops} "
+                f"retransmits={self.retransmits} "
+                f"nack_rtt={self.nack_round_trips}) — no reconfiguration")
+
+
 class UncertifiedRecoveryError(RuntimeError):
     """A candidate recovery plan failed re-certification — refusing to
     install it is the whole point (loud failure, never a silent
@@ -111,7 +162,8 @@ class ResilienceManager:
                  budget: RetryBudget | None = None,
                  rx_buf_bytes: int = 4096,
                  max_eager_size: int = 4096,
-                 tuning: TuningParams | None = None):
+                 tuning: TuningParams | None = None,
+                 integrity_budget: int = 3):
         self.world = int(world)
         self.policy = policy
         self.budget = budget if budget is not None else RetryBudget()
@@ -124,6 +176,20 @@ class ResilienceManager:
         self._misses: list[DeadlineMissed] = []
         self._current: RecoveryPlan | None = None
         self._generation = 0
+        # wire-health evidence (the stats2 surface): last snapshot per
+        # OBSERVER rank + the lossy-link verdicts that never became
+        # reconfigurations. integrity_budget bounds how many CONSECUTIVE
+        # lossy verdicts one suspect may bank before assess_miss stops
+        # crediting the transport and walks the dead-rank budget anyway:
+        # the wire deltas are world-global, so a rank that dies while
+        # OTHER links are lossy would otherwise classify lossy forever —
+        # a livelock with no path to the certified reconfiguration.
+        # Reset by note_recovery (a suspect whose retries succeed was a
+        # genuinely lossy link doing its job).
+        self.integrity_budget = int(integrity_budget)
+        self._wire_snapshots: dict[int, dict] = {}
+        self._integrity_faults: list[IntegrityFault] = []
+        self._integrity_streak: dict[int | None, int] = {}
         # facade shapes whose first (possibly compiling) call has been
         # seen — observe_call's warm-up exemption
         self._warmed_shapes: set[tuple] = set()
@@ -166,6 +232,87 @@ class ResilienceManager:
             self._attempts[key] = n
             return "retry" if n <= self.budget.max_retries else "exclude"
 
+    # -- escalation policy: lossy link vs dead rank ------------------------
+
+    @property
+    def integrity_faults(self) -> tuple[IntegrityFault, ...]:
+        with self._mu:
+            return tuple(self._integrity_faults)
+
+    def observe_wire_health(self, rank: int, stats: dict) -> dict:
+        """Feed one OBSERVER rank's wire-health counter snapshot
+        (``EmuRank.wire_stats()`` / ``TPUDevice.wire_stats()``; the
+        telemetry ``wire_health_report`` rows carry the same dicts) and
+        return the delta since that rank's previous snapshot.  The
+        deltas are the escalation policy's evidence: survivors watching
+        a LOSSY suspect show repair activity (CRC drops, retransmits,
+        nack round-trips) climbing; survivors watching a DEAD one show
+        silence."""
+        with self._mu:
+            prev = self._wire_snapshots.get(rank, {})
+            delta = {k: int(v) - int(prev.get(k, 0))
+                     for k, v in stats.items()
+                     if isinstance(v, (int, float))}
+            self._wire_snapshots[rank] = dict(stats)
+        return delta
+
+    @staticmethod
+    def classify_wire_delta(delta: dict | None) -> str:
+        """``"lossy"`` when the delta window shows fault-REPAIR activity
+        (the transport is absorbing damage: any of
+        ``telemetry.export.WIRE_FAULT_KEYS`` moved), else ``"dark"`` —
+        frames are not arriving damaged, they are not arriving at all,
+        which is what a dead rank's silence looks like to a survivor."""
+        from ..telemetry.export import WIRE_FAULT_KEYS
+
+        if not delta:
+            return "dark"
+        return ("lossy"
+                if any(int(delta.get(k, 0)) > 0 for k in WIRE_FAULT_KEYS)
+                else "dark")
+
+    def assess_miss(self, miss: DeadlineMissed,
+                    wire_delta: dict | None = None) -> str:
+        """The escalation decision for one deadline miss, wire-health
+        aware (docs/resilience.md decision tree): a LOSSY delta raises
+        a structured :class:`IntegrityFault` (flight-recorder
+        post-mortem carried over from the miss) and returns
+        ``"integrity"`` — the transport's retransmit budget is doing
+        its job, the dead-rank retry budget is NOT consumed and no
+        reconfiguration is recommended; a DARK delta falls through to
+        :meth:`record_miss`'s retry/exclude budget.
+
+        The lossy credit is BOUNDED per suspect (``integrity_budget``
+        consecutive verdicts, reset by :meth:`note_recovery`): wire
+        deltas are world-global evidence, so a rank that dies while
+        other links are lossy would otherwise bank IntegrityFaults
+        forever and the certified reconfiguration would never be
+        reached — past the budget the miss walks the dead-rank
+        retry/exclude path even under a lossy classification."""
+        if self.classify_wire_delta(wire_delta) == "lossy":
+            with self._mu:
+                streak = self._integrity_streak.get(
+                    miss.suspect_rank, 0) + 1
+                self._integrity_streak[miss.suspect_rank] = streak
+            if streak > self.integrity_budget:
+                return self.record_miss(miss)
+            d = wire_delta or {}
+            fault = IntegrityFault(
+                op=miss.op, count=miss.count,
+                suspect_rank=miss.suspect_rank,
+                crc_drops=int(d.get("crc_drops", 0)),
+                dup_drops=int(d.get("dup_drops", 0)),
+                retransmits=int(d.get("retx_sent", 0)),
+                retx_misses=int(d.get("retx_miss", 0)),
+                nack_round_trips=int(d.get("nack_rx", 0)),
+                elapsed_s=miss.elapsed_s,
+                post_mortem=miss.post_mortem)
+            with self._mu:
+                self._integrity_faults.append(fault)
+                self._misses.append(miss)
+            return "integrity"
+        return self.record_miss(miss)
+
     def retry_delay_s(self, suspect_rank: int | None = None) -> float:
         with self._mu:
             return self.budget.delay_s(
@@ -174,9 +321,12 @@ class ResilienceManager:
     def note_recovery(self, suspect_rank: int | None = None) -> None:
         """A retry SUCCEEDED: the suspect was a transient straggler,
         not a corpse — its budget resets (the sentinel, not the
-        recovery loop, owns chronic slowness)."""
+        recovery loop, owns chronic slowness), and so does its
+        lossy-credit streak (a lossy link that keeps recovering is the
+        transport doing its job, not a masked death)."""
         with self._mu:
             self._attempts.pop(suspect_rank, None)
+            self._integrity_streak.pop(suspect_rank, None)
 
     def reset_warmup(self) -> None:
         """Forget the facade warm-up exemptions — call when compiled

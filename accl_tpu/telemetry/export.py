@@ -64,6 +64,29 @@ EVENT_SCHEMA: dict = {
                         "stragglers": {"type": "array"},
                     },
                 },
+                # per-rank wire-health counter snapshot (the stats2
+                # surface: CRC/dup drops, selective-retransmit ack/nack
+                # traffic, fault-injection tallies) — the escalation
+                # policy's evidence for lossy-link vs dead-rank. Typed
+                # so a drifted counter rendering fails validation.
+                "wire_health": {
+                    "type": "object",
+                    "required": ["per_rank", "totals"],
+                    "properties": {
+                        "per_rank": {
+                            "type": "object",
+                            "additionalProperties": {
+                                "type": "object",
+                                "additionalProperties": {
+                                    "type": "integer"},
+                            },
+                        },
+                        "totals": {
+                            "type": "object",
+                            "additionalProperties": {"type": "integer"},
+                        },
+                    },
+                },
             },
         },
         "spans": {
@@ -264,6 +287,51 @@ def residual_summary(rows: list[dict]) -> dict:
             op: median(errs) for op, errs in sorted(by_op.items())
         },
     }
+
+
+# The wire-health counters of the stats2 surface that describe FAULT
+# REPAIR activity — damage actually observed and absorbed (corrupt
+# frames dropped, duplicates deduped, frames actually resent).  This is
+# the resilience manager's lossy-vs-dark evidence, and deliberately
+# EXCLUDES the nack/ack traffic counters: a survivor nacks a dead
+# rank's silence (and a stalled healthy peer) too, so "someone is
+# waiting" counters climb in BOTH cases and cannot distinguish them.
+# Kept here — next to the export that renders them — so the exporter
+# and the consumer read one list.
+WIRE_FAULT_KEYS = (
+    "crc_drops", "dup_drops", "retx_sent", "retx_miss",
+)
+
+
+def wire_health_report(stats_by_rank: dict) -> dict:
+    """Normalize per-rank wire-health snapshots (EmuRank.wire_stats /
+    TPUDevice.wire_stats dicts keyed by rank) into the trace-meta
+    `wire_health` shape: string-keyed per-rank rows plus a totals row.
+    Non-integer values and unknown keys pass through int-coerced /
+    verbatim so a newer native counter never breaks an older exporter;
+    an empty input yields the well-typed empty report."""
+    per_rank: dict = {}
+    totals: dict = {}
+    for rank in sorted(stats_by_rank):
+        row = {}
+        for k, v in (stats_by_rank[rank] or {}).items():
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                continue
+            row[str(k)] = iv
+            totals[str(k)] = totals.get(str(k), 0) + iv
+        per_rank[str(rank)] = row
+    return {"per_rank": per_rank, "totals": totals}
+
+
+def wire_health_rows(stats_by_rank: dict) -> list[dict]:
+    """Flat per-rank rows (rank + every counter) for table rendering —
+    the accl_trace/bench printers' shape."""
+    rep = wire_health_report(stats_by_rank)
+    return [{"rank": rank, **row}
+            for rank, row in sorted(rep["per_rank"].items(),
+                                    key=lambda kv: int(kv[0]))]
 
 
 def write_trace(path, trace: dict) -> None:

@@ -2094,6 +2094,423 @@ def _fault_gate_main():
         sys.exit(1)
 
 
+# the chaos-gate contract (bench.py --chaos-gate): under a seeded
+# loss/corrupt/dup/reorder mix the transport's reliability sublayer
+# (CRC32C frames + selective retransmit, runtime.cpp) must absorb every
+# transient wire fault BELOW the resilience layer — every collective
+# answer bitwise, repair counters strictly positive, and ZERO false
+# dead-rank escalations (any deadline miss must classify LOSSY ->
+# IntegrityFault via the wire-health evidence, never reach the
+# exclude->replan path) — while the no-fault CRC+ack bookkeeping stays
+# under CHAOS_OVERHEAD_BUDGET of the per-dispatch median (the obs/fault
+# gates' per-event-cost methodology; the rely-on vs rely-off A/B wall
+# delta is reported unvarnished, not gated).  A genuinely dark wire
+# (kill-rank) must still classify DARK, so the certified
+# reconfiguration stays reachable for real deaths.
+CHAOS_GATE_WORLD = 4
+CHAOS_GATE_COUNT = 65536  # 256 KiB fp32: the fault gate's ms regime
+CHAOS_LOSS_PCT = 1.0
+CHAOS_CORRUPT_PCT = 0.5
+CHAOS_DUP_PCT = 0.5
+CHAOS_REORDER_PCT = 0.5
+CHAOS_SEED = 1009
+CHAOS_ROUNDS = 10
+CHAOS_ITERS = 3  # dispatches per soak round (amortize thread spawn)
+CHAOS_MISS_BUDGET = 6  # lossy-classified re-runs before giving up
+CHAOS_CONTROL_ROUNDS = 10
+CHAOS_OVERHEAD_BUDGET = 0.03
+
+
+def _chaos_wire_totals(world_obj):
+    """Sum every live rank's stats2 counter surface."""
+    agg = {}
+    for r in world_obj.ranks:
+        if r is None:
+            continue
+        for k, v in r.wire_stats().items():
+            agg[k] = agg.get(k, 0) + v
+    return agg
+
+
+def _chaos_gate_main():
+    """bench.py --chaos-gate: the reliable-wire claims (CI, after
+    --fault-gate):
+
+      1. SEEDED CHAOS SOAK on the 4-rank native TCP world
+         (ACCL_RT_FAULT_{LOSS,CORRUPT,DUP,REORDER}_PCT at 1/0.5/0.5/0.5
+         + ACCL_RT_FAULT_SEED): lockstep allreduce rounds under armed
+         model-derived deadlines. Every answer must be BITWISE vs the
+         oracle; the repair counters (retransmits, CRC drops, dup
+         drops) must be strictly positive (the faults provably fired
+         AND were provably absorbed); and zero rounds may escalate to
+         exclusion — a deadline miss under injected loss must classify
+         LOSSY through the wire-health deltas (ResilienceManager
+         .assess_miss -> IntegrityFault) and retry on the same
+         membership, because a ~1 s certified reconfiguration is the
+         wrong answer to a lost frame.
+
+      2. NO-FAULT OVERHEAD: on a clean world the CRC+ack bookkeeping
+         (the native rely_ns counter: CRC32C at both ends + health-tick
+         work, summed across ranks) per lockstep dispatch must stay
+         under 3% of the per-dispatch median. The rely-off A/B wall
+         delta is reported unvarnished, not gated (host scheduler
+         noise — the fault gate's posture).
+
+      3. DARK-WIRE CONTROL: a killed rank's silence must classify DARK
+         (no repair-activity delta on the survivors), so assess_miss
+         falls through to the retry/exclude budget — the chaos policy
+         cannot mask a real death.
+
+    stdout: ONE JSON line {metric, value = soak dispatches, ...}."""
+    from accl_tpu.constants import Operation
+    from accl_tpu.descriptor import CallOptions
+    from accl_tpu.device.emu_device import EmuWorld
+    from accl_tpu.resilience import (
+        DeadlineMissedError,
+        DeadlinePolicy,
+        NativeDeadlineGuard,
+        ResilienceManager,
+        RetryBudget,
+    )
+    from accl_tpu import ReduceFunction
+    from accl_tpu.telemetry import calibrate_from_trace, wire_health_report
+    from accl_tpu.telemetry import native as tnative
+    from accl_tpu.telemetry.tracer import SCHEMA_VERSION
+
+    world = CHAOS_GATE_WORLD
+    count = CHAOS_GATE_COUNT
+    rng = np.random.default_rng(29)
+    xs = rng.integers(-32, 32, size=(world, count)).astype(np.float32)
+    oracle = xs.sum(0)
+    chaos_env = {
+        "ACCL_RT_FAULT_LOSS_PCT": str(CHAOS_LOSS_PCT),
+        "ACCL_RT_FAULT_CORRUPT_PCT": str(CHAOS_CORRUPT_PCT),
+        "ACCL_RT_FAULT_DUP_PCT": str(CHAOS_DUP_PCT),
+        "ACCL_RT_FAULT_REORDER_PCT": str(CHAOS_REORDER_PCT),
+        "ACCL_RT_FAULT_SEED": str(CHAOS_SEED),
+    }
+    managed = ["ACCL_RT_TRACE", "ACCL_RT_RELY", "ACCL_RT_FAULT_KILL_RANK",
+               "ACCL_RT_FAULT_KILL_AFTER", *chaos_env]
+    saved = {k: os.environ.get(k) for k in managed}
+    for k in managed:
+        os.environ.pop(k, None)
+    os.environ["ACCL_RT_TRACE"] = "1"
+    wkw = dict(max_eager=tnative.DEFAULT_MAX_EAGER,
+               rx_buf_bytes=tnative.DEFAULT_RX_BUF)
+    try:
+        # -- calibrate link + residual band on a clean world ----------
+        wa = EmuWorld(world, transport="tcp", **wkw)
+        try:
+            _obs_sweep(wa, (count * 4,), 2)  # cold TCP sessions
+            for r in wa.ranks:
+                r.trace_read()
+            _obs_sweep(wa, (count * 4,), 6)
+            warm = _obs_drain_events(wa, link=None)
+            link = calibrate_from_trace(
+                {"schema": SCHEMA_VERSION, "spans": warm})
+            _obs_sweep(wa, (count * 4,), 6)
+            ref_events = _obs_drain_events(wa, link)
+            residuals = [
+                abs(ev["args"]["predicted_s"] - ev["args"]["measured_s"])
+                / ev["args"]["measured_s"]
+                for ev in ref_events
+                if ev["args"].get("predicted_s")
+                and ev["args"].get("measured_s", 0) > 0]
+            policy = DeadlinePolicy(link, world=world,
+                                    rx_buf_bytes=tnative.DEFAULT_RX_BUF,
+                                    max_eager_size=tnative.DEFAULT_MAX_EAGER)
+            ref = policy.arm_from_residuals("allreduce", residuals)
+            deadline_s = policy.deadline_s("allreduce", count)
+            print(f"  link: alpha {link.alpha * 1e6:.0f} us, beta "
+                  f"{link.beta / 1e9:.2f} GB/s; residual ref {ref:.3f} "
+                  f"-> deadline {deadline_s * 1e3:.1f} ms", file=sys.stderr)
+
+            # -- leg 2a: no-fault control (rely ON, the default) ------
+            t_ctrl = []
+            s0 = _chaos_wire_totals(wa)
+            for _ in range(CHAOS_CONTROL_ROUNDS):
+                s, res = _fault_dispatch_round(wa, xs, count,
+                                               iters=CHAOS_ITERS)
+                t_ctrl.append(s)
+                for out in res:
+                    assert np.array_equal(out, oracle), \
+                        "control (rely on) answer wrong"
+            s1 = _chaos_wire_totals(wa)
+            ctrl_dispatches = CHAOS_CONTROL_ROUNDS * CHAOS_ITERS
+            # per-RANK bookkeeping per dispatch: rely_ns sums every
+            # rank's CRC+ack work, but the ranks run concurrently — the
+            # cost a lockstep dispatch's critical path pays is one
+            # rank's share (the obs/fault gates' per-event-cost
+            # methodology; the whole-world sum is reported too)
+            rely_total_s = ((s1["rely_ns"] - s0["rely_ns"]) / 1e9
+                            / ctrl_dispatches)
+            rely_s_per_dispatch = rely_total_s / world
+            per_dispatch = float(np.median(t_ctrl))
+            overhead = rely_s_per_dispatch / max(per_dispatch, 1e-9)
+            print(f"  no-fault CRC+ack bookkeeping "
+                  f"{rely_s_per_dispatch * 1e6:.1f} us/rank/dispatch = "
+                  f"{overhead * 100:.3f}% of the "
+                  f"{per_dispatch * 1e3:.2f} ms/dispatch median "
+                  f"(world total {rely_total_s * 1e6:.1f} us)",
+                  file=sys.stderr)
+        finally:
+            wa.close()
+
+        # -- leg 2b: rely-off A/B (reported, not gated) ---------------
+        os.environ["ACCL_RT_RELY"] = "0"
+        wb = EmuWorld(world, transport="tcp", **wkw)
+        os.environ.pop("ACCL_RT_RELY", None)
+        try:
+            t_off = []
+            for _ in range(CHAOS_CONTROL_ROUNDS):
+                s, res = _fault_dispatch_round(wb, xs, count,
+                                               iters=CHAOS_ITERS)
+                t_off.append(s)
+                for out in res:
+                    assert np.array_equal(out, oracle), \
+                        "control (rely off) answer wrong"
+            wall_delta = per_dispatch / max(float(np.median(t_off)),
+                                            1e-9) - 1.0
+            print(f"  A/B wall delta rely-on vs rely-off "
+                  f"{wall_delta * 100:+.2f}% (reported, not gated — "
+                  "host noise)", file=sys.stderr)
+        finally:
+            wb.close()
+
+        # -- leg 1: the seeded chaos soak -----------------------------
+        for k, v in chaos_env.items():
+            os.environ[k] = v
+        wc = EmuWorld(world, transport="tcp", **wkw)
+        for k in chaos_env:
+            os.environ.pop(k, None)
+        try:
+            mgr = ResilienceManager(
+                world, policy=policy,
+                budget=RetryBudget(max_retries=1, backoff_base_s=0.02))
+            guard = NativeDeadlineGuard(policy)
+            for r in wc.ranks:
+                guard.arm(r, "allreduce", count)
+                mgr.observe_wire_health(r.rank, r.wire_stats())
+
+            def soak_attempt(rank, i):
+                out = np.zeros(count, np.float32)
+                h = rank.start(CallOptions(
+                    scenario=Operation.allreduce, count=count,
+                    function=int(ReduceFunction.SUM), data_type=3),
+                    op0=xs[i].copy(), res=out)
+                try:
+                    guard.wait(rank, h, "allreduce", count)
+                    return ("ok", out)
+                except DeadlineMissedError as e:
+                    return ("miss", e.miss)
+
+            soak_ok = 0
+            lossy_misses = 0
+            excludes = 0
+            rounds_run = 0
+            while soak_ok < CHAOS_ROUNDS * CHAOS_ITERS:
+                rounds_run += 1
+                verdicts = wc.run(soak_attempt)
+                misses = [v[1] for v in verdicts if v[0] == "miss"]
+                if misses:
+                    # the decision tree: wire-health deltas say LOSSY
+                    # (repair activity climbing), so this is an
+                    # IntegrityFault retry on the SAME membership —
+                    # an exclusion here is a FALSE dead-rank verdict
+                    deltas = [mgr.observe_wire_health(r.rank,
+                                                      r.wire_stats())
+                              for r in wc.ranks]
+                    action = mgr.assess_miss(
+                        misses[0],
+                        {k: sum(d.get(k, 0) for d in deltas)
+                         for k in deltas[0]})
+                    if action != "integrity":
+                        excludes += 1
+                        break
+                    lossy_misses += 1
+                    if lossy_misses > CHAOS_MISS_BUDGET:
+                        break
+                    continue
+                for out_pair in verdicts:
+                    if not np.array_equal(out_pair[1], oracle):
+                        print("FAIL: chaos soak answer not bitwise",
+                              file=sys.stderr)
+                        sys.exit(1)
+                soak_ok += 1  # one lockstep dispatch per run()
+                # a round that completes resets the lossy-credit streak
+                # and the retry budget — the note_recovery contract
+                mgr.note_recovery(None)
+            totals = _chaos_wire_totals(wc)
+            health = wire_health_report(
+                {r.rank: r.wire_stats() for r in wc.ranks})
+            print(f"  soak: {rounds_run} rounds, {lossy_misses} lossy-"
+                  f"classified misses, {excludes} exclusions; injected "
+                  f"loss/corrupt/dup/reorder = {totals['inj_loss']}/"
+                  f"{totals['inj_corrupt']}/{totals['inj_dup']}/"
+                  f"{totals['inj_reorder']}; repaired: retx "
+                  f"{totals['retx_sent']}, crc drops "
+                  f"{totals['crc_drops']}, dup drops "
+                  f"{totals['dup_drops']}, nack rtt {totals['nack_rx']}",
+                  file=sys.stderr)
+        finally:
+            wc.close()
+
+        # -- leg 3: dark-wire control (a real death stays a death) ----
+        victim = world - 2
+        os.environ["ACCL_RT_FAULT_KILL_RANK"] = str(victim)
+        os.environ["ACCL_RT_FAULT_KILL_AFTER"] = "2"
+        wd = EmuWorld(world, transport="tcp", **wkw)
+        os.environ.pop("ACCL_RT_FAULT_KILL_RANK", None)
+        os.environ.pop("ACCL_RT_FAULT_KILL_AFTER", None)
+        try:
+            mgr2 = ResilienceManager(world, policy=policy)
+            guard2 = NativeDeadlineGuard(policy)
+            for r in wd.ranks:
+                guard2.arm(r, "allreduce", count)
+            _s, res = _fault_dispatch_round(wd, xs, count, guard=guard2,
+                                            iters=2)
+            for out in res:
+                assert np.array_equal(out, oracle), "pre-kill wrong"
+            for r in wd.ranks:
+                if r.rank != victim:
+                    mgr2.observe_wire_health(r.rank, r.wire_stats())
+
+            def dark_attempt(rank, i):
+                if i == victim:
+                    try:
+                        out = np.zeros(count, np.float32)
+                        rank.allreduce(xs[i].copy(), out, count,
+                                       ReduceFunction.SUM)
+                    except Exception:
+                        pass
+                    return None
+                out = np.zeros(count, np.float32)
+                h = rank.start(CallOptions(
+                    scenario=Operation.allreduce, count=count,
+                    function=int(ReduceFunction.SUM), data_type=3),
+                    op0=xs[i].copy(), res=out)
+                try:
+                    guard2.wait(rank, h, "allreduce", count)
+                    return ("ok", out)
+                except DeadlineMissedError as e:
+                    return ("miss", e.miss)
+
+            verdicts = wd.run(dark_attempt)
+            dark_misses = [v[1] for v in verdicts
+                           if v is not None and v[0] == "miss"]
+            deltas = [mgr2.observe_wire_health(r.rank, r.wire_stats())
+                      for r in wd.ranks if r.rank != victim]
+            dark_delta = {k: sum(d.get(k, 0) for d in deltas)
+                          for k in deltas[0]}
+            dark_class = ResilienceManager.classify_wire_delta(dark_delta)
+            # gate the bounded escalation OUTCOME, not one window's
+            # bit-exact classification: a scheduler stall among healthy
+            # survivors can leak a spurious retransmit/dup into the
+            # kill window (a lossy-looking delta), but the integrity
+            # budget must bound that credit — within budget+1
+            # assessments the action walks the retry/exclude path,
+            # because re-observing a dead wire yields a frozen,
+            # repair-free delta
+            dark_action = "none"
+            dark_assessments = 0
+            if dark_misses:
+                for _ in range(mgr2.integrity_budget + 1):
+                    dark_assessments += 1
+                    dark_action = mgr2.assess_miss(dark_misses[0],
+                                                   dark_delta)
+                    if dark_action != "integrity":
+                        break
+                    deltas = [mgr2.observe_wire_health(r.rank,
+                                                       r.wire_stats())
+                              for r in wd.ranks if r.rank != victim]
+                    dark_delta = {k: sum(d.get(k, 0) for d in deltas)
+                                  for k in deltas[0]}
+            print(f"  dark-wire control: {len(dark_misses)} survivor "
+                  f"misses, first window classified {dark_class!r}, "
+                  f"assess -> {dark_action!r} after {dark_assessments} "
+                  "assessment(s) (the retry/exclude budget, not "
+                  "unbounded IntegrityFault)", file=sys.stderr)
+        finally:
+            wd.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    print(json.dumps({
+        "metric": "chaos gate: seeded loss/corrupt/dup/reorder absorbed "
+                  f"at the transport (w{world} native TCP; bitwise "
+                  "answers, zero dead-rank escalations, CRC+ack "
+                  "overhead gated)",
+        "value": soak_ok,
+        "unit": "bitwise lockstep dispatches under chaos",
+        "platform": "cpu-emulator",
+        "fault_mix_pct": {"loss": CHAOS_LOSS_PCT,
+                          "corrupt": CHAOS_CORRUPT_PCT,
+                          "dup": CHAOS_DUP_PCT,
+                          "reorder": CHAOS_REORDER_PCT,
+                          "seed": CHAOS_SEED},
+        "injected": {k: totals[k] for k in
+                     ("inj_loss", "inj_corrupt", "inj_dup",
+                      "inj_reorder")},
+        "repaired": {k: totals[k] for k in
+                     ("retx_sent", "retx_miss", "crc_drops",
+                      "dup_drops", "nack_sent", "nack_rx")},
+        "wire_health_totals": health["totals"],
+        "lossy_classified_misses": lossy_misses,
+        "integrity_faults": len(mgr.integrity_faults),
+        "false_dead_rank_escalations": excludes,
+        "rely_us_per_rank_dispatch": round(rely_s_per_dispatch * 1e6, 2),
+        "rely_us_world_total_dispatch": round(rely_total_s * 1e6, 2),
+        "rely_overhead_pct": round(overhead * 100, 4),
+        "rely_overhead_budget_pct": CHAOS_OVERHEAD_BUDGET * 100,
+        "rely_off_wall_delta_pct": round(wall_delta * 100, 2),
+        "deadline_ms": round(deadline_s * 1e3, 2),
+        "dark_wire_first_window_class": dark_class,
+        "dark_wire_action": dark_action,
+        "dark_wire_assessments": dark_assessments,
+        "dark_survivor_misses": len(dark_misses),
+    }))
+    fails = []
+    if soak_ok < CHAOS_ROUNDS * CHAOS_ITERS:
+        fails.append(f"soak completed only {soak_ok} bitwise dispatches "
+                     f"(wanted {CHAOS_ROUNDS * CHAOS_ITERS}; "
+                     f"{lossy_misses} lossy misses, {excludes} "
+                     "exclusions)")
+    if excludes:
+        fails.append(f"{excludes} FALSE dead-rank escalations under "
+                     "injected loss below the threshold — a lost frame "
+                     "must never cost a certified reconfiguration")
+    if not (totals["inj_loss"] > 0 and totals["inj_corrupt"] > 0
+            and totals["inj_dup"] > 0):
+        fails.append(f"fault model did not fire across the soak "
+                     f"(loss/corrupt/dup = {totals['inj_loss']}/"
+                     f"{totals['inj_corrupt']}/{totals['inj_dup']})")
+    if not (totals["retx_sent"] > 0 and totals["crc_drops"] > 0
+            and totals["dup_drops"] > 0):
+        fails.append("repair counters not strictly positive (retx "
+                     f"{totals['retx_sent']}, crc {totals['crc_drops']}, "
+                     f"dup {totals['dup_drops']})")
+    if overhead >= CHAOS_OVERHEAD_BUDGET:
+        fails.append(f"no-fault CRC+ack bookkeeping costs "
+                     f"{overhead * 100:.2f}% of the per-dispatch median "
+                     f"(budget {CHAOS_OVERHEAD_BUDGET * 100:.0f}%)")
+    if not dark_misses:
+        fails.append("dark-wire control produced no survivor deadline "
+                     "misses — the kill lever did not bite")
+    if dark_action not in ("retry", "exclude"):
+        fails.append(f"a killed rank never reached the retry/exclude "
+                     f"budget (action {dark_action!r} after "
+                     f"{dark_assessments} assessments) — the chaos "
+                     "policy must never mask a real death")
+    if fails:
+        for f in fails:
+            print(f"FAIL: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def _hier_run_composed(locals_, outers, pods, inner, nbytes, iters,
                        stripes=1, check=None):
     """Drive the composed two-tier allreduce on the native emulated
@@ -3513,6 +3930,8 @@ if __name__ == "__main__":
         _obs_gate_main()
     elif "--fault-gate" in sys.argv:
         _fault_gate_main()
+    elif "--chaos-gate" in sys.argv:
+        _chaos_gate_main()
     elif "--hier-gate" in sys.argv:
         _hier_gate_main()
     elif "--check" in sys.argv or "--write-baseline" in sys.argv:

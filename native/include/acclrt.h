@@ -105,6 +105,49 @@ void accl_rt_write(accl_rt_t *rt, uint32_t addr, uint32_t value);
    seek_miss} — live profiling access to the ACCL_RT_STATS counters */
 void accl_rt_get_stats(accl_rt_t *rt, uint64_t out[5]);
 
+/* Versioned counter surface: indices into accl_rt_get_stats2's output.
+ * The first five mirror accl_rt_get_stats (kept ABI-stable); the rest
+ * are the reliability sublayer's wire-health counters — frame volumes,
+ * integrity/duplicate drops, the selective-retransmit ack/nack
+ * traffic, and the seeded chaos fault model's injection tallies
+ * (ACCL_RT_FAULT_{LOSS,CORRUPT,DUP,REORDER}_PCT + ACCL_RT_FAULT_SEED).
+ * rely_ns is the cumulative nanoseconds spent computing/verifying
+ * frame CRC32C on the DATA-PATH threads (sender frame_out, the rx
+ * landing paths). It deliberately excludes the background health
+ * tick's own scan (off every dispatch's critical path) and the
+ * retransmit-buffer serialize copy; the chaos gate divides rely_ns by
+ * dispatches for its <3% per-dispatch CRC budget and reports the
+ * all-in rely-on vs rely-off wall delta alongside, unvarnished. */
+enum accl_rt_stat2 {
+  ACCL_RT_STAT2_PASSES = 0,
+  ACCL_RT_STAT2_PARKS = 1,
+  ACCL_RT_STAT2_PARK_NS = 2,
+  ACCL_RT_STAT2_SEEK_HIT = 3,
+  ACCL_RT_STAT2_SEEK_MISS = 4,
+  ACCL_RT_STAT2_TX_FRAMES = 5,   /* eager data frames sent */
+  ACCL_RT_STAT2_RX_FRAMES = 6,   /* eager data frames received (pre-CRC) */
+  ACCL_RT_STAT2_CRC_DROPS = 7,   /* corrupt frames counted + dropped */
+  ACCL_RT_STAT2_DUP_DROPS = 8,   /* late/duplicate seqns dropped */
+  ACCL_RT_STAT2_RETX_SENT = 9,   /* frames resent on a peer's NACK */
+  ACCL_RT_STAT2_RETX_MISS = 10,  /* NACKed frames already evicted */
+  ACCL_RT_STAT2_NACK_SENT = 11,
+  ACCL_RT_STAT2_NACK_RX = 12,
+  ACCL_RT_STAT2_ACK_SENT = 13,
+  ACCL_RT_STAT2_ACK_RX = 14,
+  ACCL_RT_STAT2_RNDZV_DROPS = 15, /* unposted/revoked one-sided writes */
+  ACCL_RT_STAT2_INJ_LOSS = 16,
+  ACCL_RT_STAT2_INJ_CORRUPT = 17,
+  ACCL_RT_STAT2_INJ_DUP = 18,
+  ACCL_RT_STAT2_INJ_REORDER = 19,
+  ACCL_RT_STAT2_RELY_NS = 20,
+  ACCL_RT_STATS2_COUNT = 21,
+};
+
+/* Fill out[0..min(cap, ACCL_RT_STATS2_COUNT)) and return the total
+ * number of counters this build exposes (callers detect growth by the
+ * return value; accl_rt_get_stats keeps the old 5-word ABI). */
+size_t accl_rt_get_stats2(accl_rt_t *rt, uint64_t *out, size_t cap);
+
 /* Eager-rx-ring snapshot (dump_eager_rx_buffers analog): NUL-terminated
  * report into out (truncated at cap); returns the untruncated length. */
 size_t accl_rt_dump_rxbufs(accl_rt_t *rt, char *out, size_t cap);

@@ -309,6 +309,223 @@ def test_udp_ceiling_split_fuzz(seed):
             np.testing.assert_allclose(out, expect, rtol=0)
 
 
+# ---------------------------------------------------------------------------
+# Reliable wire: CRC32C integrity + selective retransmit under the
+# seeded ACCL_RT_FAULT_{LOSS,CORRUPT,DUP,REORDER}_PCT chaos model
+# (runtime.cpp reliability sublayer). The transport must absorb every
+# injected transient BELOW the resilience layer: answers bitwise vs the
+# no-fault oracle, repair counters strictly positive, and NO call ever
+# surfacing a timeout (zero reconfigurations: nothing for the recovery
+# loop to even see).
+# ---------------------------------------------------------------------------
+
+
+def _wire_totals(world_obj):
+    agg: dict = {}
+    for r in world_obj.ranks:
+        if r is None:
+            continue
+        for k, v in r.wire_stats().items():
+            agg[k] = agg.get(k, 0) + v
+    return agg
+
+
+CHAOS_SEEDS = 30
+
+
+@pytest.mark.parametrize("seed", range(CHAOS_SEEDS))
+def test_chaos_fuzz_transport_absorbs_seeded_faults(fault_env, seed):
+    """30-seed chaos fuzz: random seeded loss/corrupt/dup/reorder rates
+    over a p2p frame storm (rx-buf-sized segments, so the fault model
+    gets hundreds of draws) plus collective dispatches. Every answer
+    must be BITWISE vs the no-fault oracle (integer payloads), the
+    retransmit counters strictly positive (the faults provably fired
+    and were provably repaired), and zero calls may surface an error —
+    the transport absorbs the chaos below the resilience layer, so no
+    retry budget is consumed and no reconfiguration can trigger."""
+    rng = np.random.default_rng(4200 + seed)
+    # floors keep expected injection counts high enough that the
+    # strictly-positive counter assertions are deterministic in
+    # practice (hundreds of frames * >=1.5% loss)
+    loss = 1.5 + float(rng.uniform(0, 1.5))
+    corrupt = 1.0 + float(rng.uniform(0, 1.0))
+    dup = 0.5 + float(rng.uniform(0, 1.0))
+    reorder = float(rng.uniform(0, 1.5))
+    transport = "local" if seed % 3 else "tcp"
+    world = int(rng.choice([2, 4]))
+    op = str(rng.choice(["allreduce", "allgather", "alltoall"]))
+    fault_env(ACCL_RT_FAULT_LOSS_PCT=loss, ACCL_RT_FAULT_CORRUPT_PCT=corrupt,
+              ACCL_RT_FAULT_DUP_PCT=dup, ACCL_RT_FAULT_REORDER_PCT=reorder,
+              ACCL_RT_FAULT_SEED=1000 + seed)
+    p2p_count = 12288  # 48 KB -> 192 rx-buf frames per directed link
+    coll_count = int(rng.integers(1000, 4000))
+    p2p = rng.integers(-64, 64, size=(world, p2p_count)).astype(np.float32)
+    xs = rng.integers(-32, 32, size=(world, coll_count * (
+        world if op == "alltoall" else 1))).astype(np.float32)
+    w = EmuWorld(world, max_eager=1 << 20, rx_buf_bytes=256,
+                 transport=transport)
+    try:
+        def body(rank, i):
+            # phase 1: p2p frame storm around the ring (many small
+            # frames -> many fault-model draws)
+            nxt, prv = (i + 1) % world, (i - 1) % world
+            sh = rank.start(CallOptions(
+                scenario=Operation.send, count=p2p_count,
+                root_src_dst=nxt, tag=0x6100, data_type=F32),
+                op0=p2p[i].copy())
+            rb = np.zeros(p2p_count, np.float32)
+            rh = rank.start(CallOptions(
+                scenario=Operation.recv, count=p2p_count,
+                root_src_dst=prv, tag=0x6100, data_type=F32), res=rb)
+            rank.wait(sh)
+            rank.wait(rh)
+            # phase 2: collective dispatches
+            if op == "allreduce":
+                out = np.zeros(coll_count, np.float32)
+                for _ in range(3):
+                    rank.allreduce(xs[i].copy(), out, coll_count,
+                                   ReduceFunction.SUM)
+            else:
+                out = np.zeros(coll_count * world, np.float32)
+                for _ in range(3):
+                    if op == "allgather":
+                        rank.allgather(xs[i].copy(), out, coll_count)
+                    else:
+                        rank.alltoall(xs[i].copy(), out, coll_count)
+            return rb, out
+
+        res = w.run(body)
+        agg = _wire_totals(w)
+    finally:
+        w.close()
+    for i, (rb, out) in enumerate(res):
+        np.testing.assert_array_equal(
+            rb, p2p[(i - 1) % world],
+            err_msg=f"seed {seed}: p2p payload not bitwise")
+        if op == "allreduce":
+            want = xs.sum(0)
+        elif op == "allgather":
+            want = xs.ravel()
+        else:
+            want = xs.reshape(world, world, coll_count)[:, i, :].ravel()
+        np.testing.assert_array_equal(
+            out, want, err_msg=f"seed {seed}: {op} not bitwise")
+    # the faults provably fired ...
+    assert agg["inj_loss"] > 0, f"seed {seed}: no loss drawn ({agg})"
+    # ... and were provably repaired at the transport
+    assert agg["retx_sent"] > 0, \
+        f"seed {seed}: lost frames never retransmitted ({agg})"
+    if agg["inj_corrupt"]:
+        assert agg["crc_drops"] > 0, \
+            f"seed {seed}: corrupt frames not caught by CRC ({agg})"
+    if agg["inj_dup"]:
+        assert agg["dup_drops"] > 0, \
+            f"seed {seed}: duplicate frames not deduped ({agg})"
+
+
+def test_stats2_versioned_counter_surface():
+    """accl_rt_get_stats2 keeps the classic 5 sequencer counters as its
+    prefix (the ABI-stable accl_rt_get_stats view), carries the wire
+    counters behind them, and EmuRank.wire_stats renders every known
+    field; TPUDevice's mirror carries the same schema."""
+    from accl_tpu.device.emu_device import STATS2_FIELDS
+    from accl_tpu.telemetry.export import WIRE_FAULT_KEYS
+
+    w = EmuWorld(2, transport="local")
+    try:
+        def body(rank, i):
+            out = np.zeros(64, np.float32)
+            rank.allreduce(np.ones(64, np.float32), out, 64,
+                           ReduceFunction.SUM)
+
+        w.run(body)
+        ws = w.ranks[0].wire_stats()
+        seq = w.ranks[0].sequencer_stats()
+    finally:
+        w.close()
+    assert tuple(ws) == STATS2_FIELDS
+    assert set(seq) == set(STATS2_FIELDS[:5])
+    assert set(WIRE_FAULT_KEYS) < set(STATS2_FIELDS)
+    assert ws["passes"] > 0 and ws["tx_frames"] > 0
+    assert all(isinstance(v, int) for v in ws.values())
+
+
+def test_corrupt_frames_counted_dropped_and_repaired(fault_env):
+    """A heavy corrupt rate: every flipped frame must be caught by the
+    CRC (counted, dropped, never landed) and repaired by the nack
+    path — the payload arrives bitwise anyway."""
+    fault_env(ACCL_RT_FAULT_CORRUPT_PCT=40, ACCL_RT_FAULT_SEED=5)
+    msg = RNG.integers(-100, 100, size=8192).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=256, transport="local")
+    try:
+        def body(rank, i):
+            if i == 1:
+                rank.send(msg.copy(), len(msg), dst=0, tag=9)
+                return None
+            out = np.zeros(len(msg), np.float32)
+            rank.recv(out, len(msg), src=1, tag=9)
+            return out
+
+        res = w.run(body)
+        agg = _wire_totals(w)
+    finally:
+        w.close()
+    np.testing.assert_array_equal(res[0], msg)
+    assert agg["inj_corrupt"] > 0
+    assert agg["crc_drops"] >= agg["inj_corrupt"] > 0
+    assert agg["retx_sent"] > 0
+
+
+def test_duplicate_frames_land_idempotently(fault_env):
+    """100% dup: every data frame is delivered twice; the dedup path
+    must drop every second copy and the message must assemble exactly
+    once."""
+    fault_env(ACCL_RT_FAULT_DUP_PCT=100, ACCL_RT_FAULT_SEED=6)
+    msg = RNG.integers(-100, 100, size=4096).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=256, transport="local")
+    try:
+        def body(rank, i):
+            if i == 1:
+                rank.send(msg.copy(), len(msg), dst=0, tag=2)
+                return None
+            out = np.zeros(len(msg), np.float32)
+            rank.recv(out, len(msg), src=1, tag=2)
+            return out
+
+        res = w.run(body)
+        agg = _wire_totals(w)
+    finally:
+        w.close()
+    np.testing.assert_array_equal(res[0], msg)
+    assert agg["inj_dup"] > 0
+    assert agg["dup_drops"] >= agg["inj_dup"]
+
+
+def test_rely_off_is_the_legacy_wire(fault_env):
+    """ACCL_RT_RELY=0: no CRC, no acks, no retransmit machinery — the
+    pre-reliability wire, still fully functional on a clean link (the
+    A/B baseline the chaos gate reports against)."""
+    fault_env(ACCL_RT_RELY=0)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=256, transport="local")
+    try:
+        def body(rank, i):
+            out = np.zeros(512, np.float32)
+            rank.allreduce(np.full(512, i + 1, np.float32), out, 512,
+                           ReduceFunction.SUM)
+            return out
+
+        res = w.run(body)
+        agg = _wire_totals(w)
+    finally:
+        w.close()
+    for out in res:
+        np.testing.assert_array_equal(out, np.full(512, 3, np.float32))
+    assert agg["tx_frames"] > 0  # volume still counted
+    for k in ("crc_drops", "retx_sent", "nack_sent", "ack_sent",
+              "rely_ns"):
+        assert agg[k] == 0, f"{k} active with rely off"
+
+
 if os.environ.get("ACCL_RT_FAULT_DELAY_TAIL_MS") or \
         os.environ.get("ACCL_RT_FAULT_DROP_TAIL"):  # pragma: no cover
     raise RuntimeError("fault levers must not leak into the environment")

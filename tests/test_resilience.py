@@ -793,3 +793,143 @@ def test_kill_fuzz_recovery_certified_and_bitwise(seed):
 if os.environ.get("ACCL_RT_FAULT_KILL_RANK") or \
         os.environ.get("ACCL_RT_FAULT_KILL_AFTER"):  # pragma: no cover
     raise RuntimeError("kill levers must not leak into the environment")
+
+
+# ---------------------------------------------------------------------------
+# escalation policy: lossy link vs dead rank (IntegrityFault)
+# ---------------------------------------------------------------------------
+
+
+def _miss(suspect=2):
+    return DeadlineMissed(op="allreduce", count=1024, predicted_s=0.01,
+                          deadline_s=0.05, elapsed_s=0.2,
+                          suspect_rank=suspect)
+
+
+def test_classify_wire_delta_lossy_vs_dark():
+    """The classifier keys on REPAIR activity (WIRE_FAULT_KEYS), never
+    on the nack/ack chatter: a survivor nacks a dead rank's silence
+    too, so 'someone is waiting' counters climb in both cases and must
+    not read as lossy."""
+    cls = ResilienceManager.classify_wire_delta
+    assert cls(None) == "dark"
+    assert cls({}) == "dark"
+    assert cls({"nack_sent": 40, "nack_rx": 12, "ack_sent": 3}) == "dark"
+    assert cls({"crc_drops": 1}) == "lossy"
+    assert cls({"retx_sent": 2, "nack_sent": 9}) == "lossy"
+    assert cls({"dup_drops": 1}) == "lossy"
+    assert cls({"retx_miss": 1}) == "lossy"
+    assert cls({"tx_frames": 500, "rx_frames": 480}) == "dark"
+
+
+def test_assess_miss_lossy_raises_integrity_not_budget():
+    """A lossy-classified miss records a structured IntegrityFault
+    (post-mortem carried over), returns "integrity", and does NOT
+    consume the dead-rank retry budget — the transport's retransmit
+    budget owns a lossy link; only a dark wire walks the
+    retry->exclude path to reconfiguration."""
+    mgr = ResilienceManager(4, budget=RetryBudget(max_retries=1))
+    lossy = {"crc_drops": 3, "dup_drops": 1, "retx_sent": 5,
+             "retx_miss": 0, "nack_rx": 7, "nack_sent": 9}
+    m = _miss()
+    assert mgr.assess_miss(m, lossy) == "integrity"
+    assert mgr.assess_miss(m, lossy) == "integrity"
+    faults = mgr.integrity_faults
+    assert len(faults) == 2
+    f = faults[0]
+    assert (f.op, f.count, f.suspect_rank) == ("allreduce", 1024, 2)
+    assert f.crc_drops == 3 and f.retransmits == 5
+    assert f.nack_round_trips == 7 and f.dup_drops == 1
+    v = f.verdict()
+    assert v["kind"] == "integrity_fault"
+    assert v["suspect_rank"] == 2 and v["retransmits"] == 5
+    assert "no reconfiguration" in str(f)
+    # lossy misses are recorded but consumed ZERO retry budget: the
+    # next DARK misses still get the full retry->exclude progression
+    assert len(mgr.misses) == 2
+    assert mgr.assess_miss(_miss(), None) == "retry"
+    assert mgr.assess_miss(_miss(), {"nack_sent": 3}) == "exclude"
+
+
+def test_assess_miss_dark_delegates_to_record_miss():
+    mgr = ResilienceManager(4, budget=RetryBudget(max_retries=2))
+    dark = {"nack_sent": 12, "ack_rx": 4}
+    assert mgr.assess_miss(_miss(), dark) == "retry"
+    assert mgr.assess_miss(_miss(), dark) == "retry"
+    assert mgr.assess_miss(_miss(), dark) == "exclude"
+    assert not mgr.integrity_faults
+
+
+def test_observe_wire_health_returns_deltas_per_observer():
+    """observe_wire_health diffs each OBSERVER rank's snapshot against
+    its previous one — the delta window assess_miss classifies."""
+    mgr = ResilienceManager(4)
+    d0 = mgr.observe_wire_health(0, {"crc_drops": 5, "retx_sent": 2})
+    assert d0 == {"crc_drops": 5, "retx_sent": 2}  # first delta = all
+    d1 = mgr.observe_wire_health(0, {"crc_drops": 5, "retx_sent": 6})
+    assert d1 == {"crc_drops": 0, "retx_sent": 4}
+    # per-rank streams are independent
+    assert mgr.observe_wire_health(1, {"crc_drops": 1}) == {"crc_drops": 1}
+    assert ResilienceManager.classify_wire_delta(d1) == "lossy"
+    assert ResilienceManager.classify_wire_delta(
+        mgr.observe_wire_health(0, {"crc_drops": 5, "retx_sent": 6})
+    ) == "dark"  # nothing moved since
+
+
+def test_integrity_fault_against_live_chaos_world():
+    """End to end on a real native world under seeded corruption: a
+    fabricated deadline miss assessed against the world's true wire
+    deltas classifies LOSSY (the counters climbed from genuine CRC
+    repairs), so the manager raises IntegrityFault instead of
+    recommending exclusion."""
+    os.environ["ACCL_RT_FAULT_CORRUPT_PCT"] = "30"
+    os.environ["ACCL_RT_FAULT_SEED"] = "3"
+    try:
+        w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=256,
+                     transport="local")
+    finally:
+        os.environ.pop("ACCL_RT_FAULT_CORRUPT_PCT", None)
+        os.environ.pop("ACCL_RT_FAULT_SEED", None)
+    try:
+        mgr = ResilienceManager(2)
+        for r in w.ranks:
+            mgr.observe_wire_health(r.rank, r.wire_stats())
+
+        def body(rank, i):
+            out = np.zeros(4096, np.float32)
+            rank.allreduce(np.full(4096, i + 1, np.float32), out, 4096,
+                           ReduceFunction.SUM)
+            return out
+
+        res = w.run(body)
+        deltas = [mgr.observe_wire_health(r.rank, r.wire_stats())
+                  for r in w.ranks]
+    finally:
+        w.close()
+    for out in res:
+        np.testing.assert_array_equal(out, np.full(4096, 3, np.float32))
+    total = {k: sum(d.get(k, 0) for d in deltas) for k in deltas[0]}
+    assert total["crc_drops"] > 0  # the chaos fired
+    assert mgr.assess_miss(_miss(suspect=1), total) == "integrity"
+    assert mgr.integrity_faults[0].crc_drops == total["crc_drops"]
+
+
+def test_integrity_budget_bounds_the_lossy_credit():
+    """The lossy credit is bounded per suspect: wire deltas are
+    world-global evidence, so a rank that dies while OTHER links are
+    lossy would classify lossy forever — past integrity_budget
+    consecutive verdicts the miss walks the dead-rank retry/exclude
+    path anyway, and note_recovery resets the streak (a lossy link
+    that keeps recovering is the transport doing its job)."""
+    mgr = ResilienceManager(4, budget=RetryBudget(max_retries=1),
+                            integrity_budget=2)
+    lossy = {"crc_drops": 1}
+    assert mgr.assess_miss(_miss(), lossy) == "integrity"
+    assert mgr.assess_miss(_miss(), lossy) == "integrity"
+    assert mgr.assess_miss(_miss(), lossy) == "retry"    # credit spent
+    assert mgr.assess_miss(_miss(), lossy) == "exclude"  # a real death
+    assert len(mgr.integrity_faults) == 2
+    mgr2 = ResilienceManager(4, integrity_budget=1)
+    assert mgr2.assess_miss(_miss(), lossy) == "integrity"
+    mgr2.note_recovery(2)  # the retry succeeded: transport did its job
+    assert mgr2.assess_miss(_miss(), lossy) == "integrity"

@@ -811,3 +811,73 @@ def test_flight_recorder_dump_on_native_fault(fault_env, monkeypatch):
                  if s["opcode"] == int(Operation.recv)]
         assert len(recvs) == 1
         assert recvs[0]["retcode"] & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+
+
+# ---------------------------------------------------------------------------
+# wire-health export (the reliable-wire counters through telemetry)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_health_report_normalizes_and_totals():
+    """wire_health_report turns per-rank stats2 dicts into the typed
+    trace-meta shape: string rank keys, int-coerced counters, a totals
+    row summing every rank; junk values are skipped, empty input yields
+    the well-typed empty report."""
+    rep = telemetry.wire_health_report({
+        1: {"crc_drops": 2, "retx_sent": 3, "junk": "nan"},
+        0: {"crc_drops": 1, "retx_sent": 0, "tx_frames": 7.0},
+    })
+    assert list(rep["per_rank"]) == ["0", "1"]
+    assert rep["per_rank"]["1"] == {"crc_drops": 2, "retx_sent": 3}
+    assert rep["totals"] == {"crc_drops": 3, "retx_sent": 3,
+                             "tx_frames": 7}
+    assert telemetry.wire_health_report({}) == {"per_rank": {},
+                                                "totals": {}}
+    rows = telemetry.wire_health_rows({1: {"a": 1}, 0: {"a": 2}})
+    assert rows == [{"rank": "0", "a": 2}, {"rank": "1", "a": 1}]
+
+
+def test_wire_health_meta_is_schema_typed():
+    """A trace embedding meta.wire_health validates; a malformed one
+    (totals missing) fails — the counter rendering cannot drift
+    silently."""
+    jsonschema = pytest.importorskip("jsonschema")
+    trace = {"schema": telemetry.SCHEMA_VERSION, "spans": [],
+             "meta": {"wire_health": telemetry.wire_health_report(
+                 {0: {"crc_drops": 1}})}}
+    telemetry.validate_trace(trace)
+    bad = {"schema": telemetry.SCHEMA_VERSION, "spans": [],
+           "meta": {"wire_health": {"per_rank": {}}}}
+    with pytest.raises(jsonschema.ValidationError):
+        telemetry.validate_trace(bad)
+    bad2 = {"schema": telemetry.SCHEMA_VERSION, "spans": [],
+            "meta": {"wire_health": {"per_rank": {"0": {"x": "y"}},
+                                     "totals": {}}}}
+    with pytest.raises(jsonschema.ValidationError):
+        telemetry.validate_trace(bad2)
+
+
+def test_wire_health_from_live_world_counters():
+    """End to end: a live native world's wire_stats render through the
+    report with every stats2 field present and the fault-repair keys
+    (WIRE_FAULT_KEYS) a strict subset — the exporter and the resilience
+    classifier read the same names."""
+    from accl_tpu.device.emu_device import STATS2_FIELDS
+
+    w = EmuWorld(2, transport="local")
+    try:
+        def body(rank, i):
+            out = np.zeros(256, np.float32)
+            rank.allreduce(np.ones(256, np.float32), out, 256,
+                           ReduceFunction.SUM)
+
+        w.run(body)
+        rep = telemetry.wire_health_report(
+            {r.rank: r.wire_stats() for r in w.ranks})
+    finally:
+        w.close()
+    for rank_row in rep["per_rank"].values():
+        assert tuple(rank_row) == STATS2_FIELDS
+    assert set(telemetry.WIRE_FAULT_KEYS) < set(rep["totals"])
+    assert rep["totals"]["tx_frames"] > 0
+    assert rep["totals"]["crc_drops"] == 0  # clean wire
