@@ -52,6 +52,10 @@ STATS2_FIELDS = (
     "retx_sent", "retx_miss", "nack_sent", "nack_rx",
     "ack_sent", "ack_rx", "rndzv_drops",
     "inj_loss", "inj_corrupt", "inj_dup", "inj_reorder", "rely_ns",
+    # vectored-wire transmit shape: syscalls issued for frame transmit
+    # and frames shipped inside a multi-frame writev/sendmmsg batch
+    # (tx_syscalls / tx_frames is the per-frame syscall ratio)
+    "tx_syscalls", "tx_batched",
 )
 
 # (The repair-activity subset the resilience escalation policy reads —

@@ -2115,6 +2115,7 @@ CHAOS_DUP_PCT = 0.5
 CHAOS_REORDER_PCT = 0.5
 CHAOS_SEED = 1009
 CHAOS_ROUNDS = 10
+CHAOS_UDP_ROUNDS = 5  # the datagram-POE soak leg (same seam, same seed)
 CHAOS_ITERS = 3  # dispatches per soak round (amortize thread spawn)
 CHAOS_MISS_BUDGET = 6  # lossy-classified re-runs before giving up
 CHAOS_CONTROL_ROUNDS = 10
@@ -2277,83 +2278,101 @@ def _chaos_gate_main():
         finally:
             wb.close()
 
-        # -- leg 1: the seeded chaos soak -----------------------------
-        for k, v in chaos_env.items():
-            os.environ[k] = v
-        wc = EmuWorld(world, transport="tcp", **wkw)
-        for k in chaos_env:
-            os.environ.pop(k, None)
-        try:
-            mgr = ResilienceManager(
-                world, policy=policy,
-                budget=RetryBudget(max_retries=1, backoff_base_s=0.02))
-            guard = NativeDeadlineGuard(policy)
-            for r in wc.ranks:
-                guard.arm(r, "allreduce", count)
-                mgr.observe_wire_health(r.rank, r.wire_stats())
+        # -- leg 1: the seeded chaos soak, once per POE ---------------
+        # the transports differ in everything below the seam (ordered
+        # stream vs standalone datagrams, writev vs sendmmsg) but the
+        # reliability sublayer above it is the same code — the soak must
+        # hold bitwise with zero exclusions on BOTH engines
+        def _soak_leg(transport_name, target_rounds):
+            for k, v in chaos_env.items():
+                os.environ[k] = v
+            wx = EmuWorld(world, transport=transport_name, **wkw)
+            for k in chaos_env:
+                os.environ.pop(k, None)
+            try:
+                mgr = ResilienceManager(
+                    world, policy=policy,
+                    budget=RetryBudget(max_retries=1, backoff_base_s=0.02))
+                guard = NativeDeadlineGuard(policy)
+                for r in wx.ranks:
+                    guard.arm(r, "allreduce", count)
+                    mgr.observe_wire_health(r.rank, r.wire_stats())
 
-            def soak_attempt(rank, i):
-                out = np.zeros(count, np.float32)
-                h = rank.start(CallOptions(
-                    scenario=Operation.allreduce, count=count,
-                    function=int(ReduceFunction.SUM), data_type=3),
-                    op0=xs[i].copy(), res=out)
-                try:
-                    guard.wait(rank, h, "allreduce", count)
-                    return ("ok", out)
-                except DeadlineMissedError as e:
-                    return ("miss", e.miss)
+                def soak_attempt(rank, i):
+                    out = np.zeros(count, np.float32)
+                    h = rank.start(CallOptions(
+                        scenario=Operation.allreduce, count=count,
+                        function=int(ReduceFunction.SUM), data_type=3),
+                        op0=xs[i].copy(), res=out)
+                    try:
+                        guard.wait(rank, h, "allreduce", count)
+                        return ("ok", out)
+                    except DeadlineMissedError as e:
+                        return ("miss", e.miss)
 
-            soak_ok = 0
-            lossy_misses = 0
-            excludes = 0
-            rounds_run = 0
-            while soak_ok < CHAOS_ROUNDS * CHAOS_ITERS:
-                rounds_run += 1
-                verdicts = wc.run(soak_attempt)
-                misses = [v[1] for v in verdicts if v[0] == "miss"]
-                if misses:
-                    # the decision tree: wire-health deltas say LOSSY
-                    # (repair activity climbing), so this is an
-                    # IntegrityFault retry on the SAME membership —
-                    # an exclusion here is a FALSE dead-rank verdict
-                    deltas = [mgr.observe_wire_health(r.rank,
-                                                      r.wire_stats())
-                              for r in wc.ranks]
-                    action = mgr.assess_miss(
-                        misses[0],
-                        {k: sum(d.get(k, 0) for d in deltas)
-                         for k in deltas[0]})
-                    if action != "integrity":
-                        excludes += 1
-                        break
-                    lossy_misses += 1
-                    if lossy_misses > CHAOS_MISS_BUDGET:
-                        break
-                    continue
-                for out_pair in verdicts:
-                    if not np.array_equal(out_pair[1], oracle):
-                        print("FAIL: chaos soak answer not bitwise",
-                              file=sys.stderr)
-                        sys.exit(1)
-                soak_ok += 1  # one lockstep dispatch per run()
-                # a round that completes resets the lossy-credit streak
-                # and the retry budget — the note_recovery contract
-                mgr.note_recovery(None)
-            totals = _chaos_wire_totals(wc)
-            health = wire_health_report(
-                {r.rank: r.wire_stats() for r in wc.ranks})
-            print(f"  soak: {rounds_run} rounds, {lossy_misses} lossy-"
-                  f"classified misses, {excludes} exclusions; injected "
-                  f"loss/corrupt/dup/reorder = {totals['inj_loss']}/"
-                  f"{totals['inj_corrupt']}/{totals['inj_dup']}/"
-                  f"{totals['inj_reorder']}; repaired: retx "
-                  f"{totals['retx_sent']}, crc drops "
-                  f"{totals['crc_drops']}, dup drops "
-                  f"{totals['dup_drops']}, nack rtt {totals['nack_rx']}",
-                  file=sys.stderr)
-        finally:
-            wc.close()
+                soak_ok = 0
+                lossy_misses = 0
+                excludes = 0
+                rounds_run = 0
+                while soak_ok < target_rounds * CHAOS_ITERS:
+                    rounds_run += 1
+                    verdicts = wx.run(soak_attempt)
+                    misses = [v[1] for v in verdicts if v[0] == "miss"]
+                    if misses:
+                        # the decision tree: wire-health deltas say LOSSY
+                        # (repair activity climbing), so this is an
+                        # IntegrityFault retry on the SAME membership —
+                        # an exclusion here is a FALSE dead-rank verdict
+                        deltas = [mgr.observe_wire_health(r.rank,
+                                                          r.wire_stats())
+                                  for r in wx.ranks]
+                        action = mgr.assess_miss(
+                            misses[0],
+                            {k: sum(d.get(k, 0) for d in deltas)
+                             for k in deltas[0]})
+                        if action != "integrity":
+                            excludes += 1
+                            break
+                        lossy_misses += 1
+                        if lossy_misses > CHAOS_MISS_BUDGET:
+                            break
+                        continue
+                    for out_pair in verdicts:
+                        if not np.array_equal(out_pair[1], oracle):
+                            print(f"FAIL: chaos soak ({transport_name}) "
+                                  "answer not bitwise", file=sys.stderr)
+                            sys.exit(1)
+                    soak_ok += 1  # one lockstep dispatch per run()
+                    # a completed round resets the lossy-credit streak
+                    # and the retry budget — the note_recovery contract
+                    mgr.note_recovery(None)
+                totals = _chaos_wire_totals(wx)
+                health = wire_health_report(
+                    {r.rank: r.wire_stats() for r in wx.ranks})
+                print(f"  soak [{transport_name}]: {rounds_run} rounds, "
+                      f"{lossy_misses} lossy-classified misses, "
+                      f"{excludes} exclusions; injected "
+                      f"loss/corrupt/dup/reorder = {totals['inj_loss']}/"
+                      f"{totals['inj_corrupt']}/{totals['inj_dup']}/"
+                      f"{totals['inj_reorder']}; repaired: retx "
+                      f"{totals['retx_sent']}, crc drops "
+                      f"{totals['crc_drops']}, dup drops "
+                      f"{totals['dup_drops']}, nack rtt "
+                      f"{totals['nack_rx']}", file=sys.stderr)
+                return {"ok": soak_ok, "lossy": lossy_misses,
+                        "excludes": excludes, "rounds": rounds_run,
+                        "totals": totals, "health": health,
+                        "integrity_faults": len(mgr.integrity_faults)}
+            finally:
+                wx.close()
+
+        tcp_soak = _soak_leg("tcp", CHAOS_ROUNDS)
+        udp_soak = _soak_leg("udp", CHAOS_UDP_ROUNDS)
+        soak_ok = tcp_soak["ok"]
+        lossy_misses = tcp_soak["lossy"]
+        excludes = tcp_soak["excludes"]
+        totals = tcp_soak["totals"]
+        health = tcp_soak["health"]
 
         # -- leg 3: dark-wire control (a real death stays a death) ----
         victim = world - 2
@@ -2441,10 +2460,10 @@ def _chaos_gate_main():
 
     print(json.dumps({
         "metric": "chaos gate: seeded loss/corrupt/dup/reorder absorbed "
-                  f"at the transport (w{world} native TCP; bitwise "
-                  "answers, zero dead-rank escalations, CRC+ack "
+                  f"at the transport (w{world} native TCP + UDP POEs; "
+                  "bitwise answers, zero dead-rank escalations, CRC+ack "
                   "overhead gated)",
-        "value": soak_ok,
+        "value": soak_ok + udp_soak["ok"],
         "unit": "bitwise lockstep dispatches under chaos",
         "platform": "cpu-emulator",
         "fault_mix_pct": {"loss": CHAOS_LOSS_PCT,
@@ -2460,8 +2479,17 @@ def _chaos_gate_main():
                       "dup_drops", "nack_sent", "nack_rx")},
         "wire_health_totals": health["totals"],
         "lossy_classified_misses": lossy_misses,
-        "integrity_faults": len(mgr.integrity_faults),
+        "integrity_faults": tcp_soak["integrity_faults"],
         "false_dead_rank_escalations": excludes,
+        "udp_soak": {
+            "bitwise_dispatches": udp_soak["ok"],
+            "lossy_classified_misses": udp_soak["lossy"],
+            "false_dead_rank_escalations": udp_soak["excludes"],
+            "injected": {k: udp_soak["totals"][k] for k in
+                         ("inj_loss", "inj_corrupt", "inj_dup",
+                          "inj_reorder")},
+            "repaired": {k: udp_soak["totals"][k] for k in
+                         ("retx_sent", "crc_drops", "dup_drops")}},
         "rely_us_per_rank_dispatch": round(rely_s_per_dispatch * 1e6, 2),
         "rely_us_world_total_dispatch": round(rely_total_s * 1e6, 2),
         "rely_overhead_pct": round(overhead * 100, 4),
@@ -2493,6 +2521,20 @@ def _chaos_gate_main():
         fails.append("repair counters not strictly positive (retx "
                      f"{totals['retx_sent']}, crc {totals['crc_drops']}, "
                      f"dup {totals['dup_drops']})")
+    if udp_soak["ok"] < CHAOS_UDP_ROUNDS * CHAOS_ITERS:
+        fails.append(f"UDP soak completed only {udp_soak['ok']} bitwise "
+                     f"dispatches (wanted {CHAOS_UDP_ROUNDS * CHAOS_ITERS}; "
+                     f"{udp_soak['lossy']} lossy misses, "
+                     f"{udp_soak['excludes']} exclusions)")
+    if udp_soak["excludes"]:
+        fails.append(f"{udp_soak['excludes']} FALSE dead-rank "
+                     "escalations on the UDP POE — the datagram engine "
+                     "must absorb chaos below the resilience layer too")
+    if not (udp_soak["totals"]["inj_loss"] > 0
+            and udp_soak["totals"]["retx_sent"] > 0):
+        fails.append("UDP soak faults did not provably fire+repair "
+                     f"(inj_loss {udp_soak['totals']['inj_loss']}, retx "
+                     f"{udp_soak['totals']['retx_sent']})")
     if overhead >= CHAOS_OVERHEAD_BUDGET:
         fails.append(f"no-fault CRC+ack bookkeeping costs "
                      f"{overhead * 100:.2f}% of the per-dispatch median "
@@ -2505,6 +2547,221 @@ def _chaos_gate_main():
                      f"budget (action {dark_action!r} after "
                      f"{dark_assessments} assessments) — the chaos "
                      "policy must never mask a real death")
+    if fails:
+        for f in fails:
+            print(f"FAIL: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
+# the wire-gate contract (bench.py --wire-gate): the vectored wire
+# (scatter-gather writev transmit, multi-frame batching, zero payload
+# coalescing copies — transport.cpp behind the POE seam) must BEAT the
+# legacy per-frame cost model (ACCL_RT_WIRE_LEGACY=1: one header send +
+# one payload send per frame, payload coalesced through a staging copy)
+# on the same 4-rank native TCP world, interleaved world creations and
+# medians so host drift cannot fake the win. Both legs run rely-off:
+# this is a pure transport A/B, no CRC/ack confound. Gated: >= 2x jumbo
+# (16 MiB) p2p throughput AND a measured small-message (4 KiB) RTT cut;
+# 1 MiB throughput is reported ungated. The stats2 counters must agree
+# with the story (vectored leg batched frames, legacy leg copied
+# payload bytes) so the gate cannot pass by measuring the wrong path.
+WIRE_GATE_WORLD = 4
+WIRE_GATE_TRIALS = 5
+WIRE_GATE_JUMBO_BYTES = 16 << 20
+WIRE_GATE_MID_BYTES = 1 << 20
+WIRE_GATE_SMALL_BYTES = 4096
+WIRE_GATE_JUMBO_REPS = 3
+WIRE_GATE_MID_REPS = 8
+WIRE_GATE_RTT_REPS = 200
+WIRE_GATE_JUMBO_SPEEDUP = 2.0  # ISSUE 16 acceptance: >= 2x at 16 MiB
+WIRE_GATE_RTT_FACTOR = 0.97    # vectored RTT must cut >= 3% off legacy
+
+
+def _wire_gate_trial(transport, legacy, check_payload=False):
+    """One world's worth of p2p measurements: 16 MiB + 1 MiB one-way
+    throughput (rank 0 -> 1, closed by a tiny ack so the sender's clock
+    spans the full drain) and the 4 KiB ping-pong RTT. Returns a dict of
+    medians-ready numbers plus the sender's transmit-shape counters."""
+    from accl_tpu.device.emu_device import EmuWorld
+
+    managed = {"ACCL_RT_RELY": "0"}
+    if legacy:
+        managed["ACCL_RT_WIRE_LEGACY"] = "1"
+    saved = {k: os.environ.get(k) for k in managed}
+    for k, v in managed.items():
+        os.environ[k] = v
+    try:
+        w = EmuWorld(WIRE_GATE_WORLD, transport=transport,
+                     max_eager=32 << 20, max_rndzv=64 << 20)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        out = {}
+
+        def thru_body(nbytes, reps, tag):
+            n = nbytes // 4
+            data = (np.arange(n, dtype=np.int64) * 2654435761
+                    % 2147483629).astype(np.int32)
+            ack = np.zeros(1, np.int32)
+
+            def body(rank, i):
+                if i == 0:
+                    rank.send(data, n, 1, tag=tag)  # warm the lane
+                    rank.recv(ack, 1, 1, tag=tag + 1)
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        rank.send(data, n, 1, tag=tag)
+                    rank.recv(ack, 1, 1, tag=tag + 1)
+                    return nbytes * reps / (time.perf_counter() - t0)
+                if i == 1:
+                    buf = np.zeros(n, np.int32)
+                    rank.recv(buf, n, 0, tag=tag)
+                    rank.send(ack, 1, 0, tag=tag + 1)
+                    for _ in range(reps):
+                        rank.recv(buf, n, 0, tag=tag)
+                    rank.send(ack, 1, 0, tag=tag + 1)
+                    if check_payload:
+                        assert np.array_equal(buf, data), \
+                            "wire-gate payload not bitwise"
+                return None
+
+            return w.run(body)[0]
+
+        out["jumbo_gbps"] = thru_body(WIRE_GATE_JUMBO_BYTES,
+                                      WIRE_GATE_JUMBO_REPS, 21) / 1e9
+        out["mid_gbps"] = thru_body(WIRE_GATE_MID_BYTES,
+                                    WIRE_GATE_MID_REPS, 31) / 1e9
+
+        n_small = WIRE_GATE_SMALL_BYTES // 4
+        small = np.arange(n_small, dtype=np.int32)
+
+        def rtt_body(rank, i):
+            buf = np.zeros(n_small, np.int32)
+            if i == 0:
+                rank.send(small, n_small, 1, tag=41)  # warm
+                rank.recv(buf, n_small, 1, tag=42)
+                t0 = time.perf_counter()
+                for _ in range(WIRE_GATE_RTT_REPS):
+                    rank.send(small, n_small, 1, tag=41)
+                    rank.recv(buf, n_small, 1, tag=42)
+                return (time.perf_counter() - t0) / WIRE_GATE_RTT_REPS
+            if i == 1:
+                for _ in range(WIRE_GATE_RTT_REPS + 1):
+                    rank.recv(buf, n_small, 0, tag=41)
+                    rank.send(buf, n_small, 0, tag=42)
+            return None
+
+        out["rtt_s"] = w.run(rtt_body)[0]
+        s = w.ranks[0].wire_stats()
+        out["tx_syscalls"] = s["tx_syscalls"]
+        out["tx_batched"] = s["tx_batched"]
+        out["tx_frames"] = s["tx_frames"]
+        return out
+    finally:
+        w.close()
+
+
+def _wire_gate_main():
+    """bench.py --wire-gate: the zero-copy vectored wire's measured
+    claims (ISSUE 16 acceptance), CI-gated. Interleaved legacy/vectored
+    world creations, medians over WIRE_GATE_TRIALS trials each:
+
+      1. JUMBO THROUGHPUT: 16 MiB eager p2p on the 4-rank native TCP
+         world must run >= 2x the legacy wire (per-frame syscalls +
+         coalescing copies vs one writev per ~hundreds of frames with
+         borrowed payload pointers).
+
+      2. LATENCY FLOOR: the 4 KiB ping-pong RTT median must come in
+         measurably under legacy (one vectored syscall per frame vs
+         legacy's header+payload send pair) — the cut is gated, the
+         magnitude reported.
+
+      3. SHAPE EVIDENCE: the vectored leg's stats2 counters must show
+         multi-frame batching (tx_batched > 0, tx_syscalls well under
+         tx_frames) and the legacy leg must show none — the gate fails
+         if either leg measured the wrong code path.
+
+    1 MiB throughput is reported unvarnished (mid-size frames amortize
+    the syscall tax less; the number tracks the trend, not a gate).
+    stdout: ONE JSON line {metric, value = jumbo speedup, ...}."""
+    legs = {"legacy": [], "vectored": []}
+    for trial in range(WIRE_GATE_TRIALS):
+        for name in ("legacy", "vectored"):  # interleaved: drift-proof
+            r = _wire_gate_trial("tcp", legacy=(name == "legacy"),
+                                 check_payload=(trial == 0))
+            legs[name].append(r)
+            print(f"  trial {trial} {name}: jumbo "
+                  f"{r['jumbo_gbps']:.2f} GB/s, 1MiB "
+                  f"{r['mid_gbps']:.2f} GB/s, rtt "
+                  f"{r['rtt_s'] * 1e6:.1f} us  (tx syscalls/frames "
+                  f"{r['tx_syscalls']}/{r['tx_frames']}, batched "
+                  f"{r['tx_batched']})", file=sys.stderr)
+
+    med = {name: {k: float(np.median([t[k] for t in ts]))
+                  for k in ("jumbo_gbps", "mid_gbps", "rtt_s")}
+           for name, ts in legs.items()}
+    speedup16 = med["vectored"]["jumbo_gbps"] / med["legacy"]["jumbo_gbps"]
+    speedup1 = med["vectored"]["mid_gbps"] / med["legacy"]["mid_gbps"]
+    rtt_ratio = med["vectored"]["rtt_s"] / med["legacy"]["rtt_s"]
+    vec_last = legs["vectored"][-1]
+    leg_last = legs["legacy"][-1]
+    print(f"  medians: jumbo {med['legacy']['jumbo_gbps']:.2f} -> "
+          f"{med['vectored']['jumbo_gbps']:.2f} GB/s ({speedup16:.2f}x), "
+          f"1MiB {med['legacy']['mid_gbps']:.2f} -> "
+          f"{med['vectored']['mid_gbps']:.2f} GB/s ({speedup1:.2f}x), "
+          f"rtt {med['legacy']['rtt_s'] * 1e6:.1f} -> "
+          f"{med['vectored']['rtt_s'] * 1e6:.1f} us "
+          f"({(1 - rtt_ratio) * 100:+.1f}% cut)", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "wire gate: zero-copy vectored transmit vs legacy "
+                  f"per-frame wire (w{WIRE_GATE_WORLD} native TCP p2p, "
+                  "interleaved medians; jumbo throughput + RTT floor "
+                  "gated, transmit shape cross-checked)",
+        "value": round(speedup16, 2),
+        "unit": "x jumbo (16 MiB) throughput vs legacy wire",
+        "platform": "cpu-emulator",
+        "trials": WIRE_GATE_TRIALS,
+        "jumbo_gbps": {k: round(m["jumbo_gbps"], 3)
+                       for k, m in med.items()},
+        "mid_gbps": {k: round(m["mid_gbps"], 3) for k, m in med.items()},
+        "rtt_us": {k: round(m["rtt_s"] * 1e6, 1) for k, m in med.items()},
+        "jumbo_speedup": round(speedup16, 2),
+        "mid_speedup": round(speedup1, 2),
+        "rtt_cut_pct": round((1 - rtt_ratio) * 100, 2),
+        "jumbo_speedup_floor": WIRE_GATE_JUMBO_SPEEDUP,
+        "rtt_factor_ceiling": WIRE_GATE_RTT_FACTOR,
+        "tx_shape": {
+            "vectored": {k: vec_last[k] for k in
+                         ("tx_syscalls", "tx_batched", "tx_frames")},
+            "legacy": {k: leg_last[k] for k in
+                       ("tx_syscalls", "tx_batched", "tx_frames")}},
+    }))
+    fails = []
+    if speedup16 < WIRE_GATE_JUMBO_SPEEDUP:
+        fails.append(f"jumbo (16 MiB) speedup {speedup16:.2f}x under the "
+                     f"{WIRE_GATE_JUMBO_SPEEDUP}x floor "
+                     f"({med['legacy']['jumbo_gbps']:.2f} -> "
+                     f"{med['vectored']['jumbo_gbps']:.2f} GB/s)")
+    if rtt_ratio > WIRE_GATE_RTT_FACTOR:
+        fails.append(f"small-message RTT not cut: vectored/legacy = "
+                     f"{rtt_ratio:.3f} (ceiling {WIRE_GATE_RTT_FACTOR}; "
+                     f"{med['legacy']['rtt_s'] * 1e6:.1f} -> "
+                     f"{med['vectored']['rtt_s'] * 1e6:.1f} us)")
+    if not (vec_last["tx_batched"] > 0
+            and vec_last["tx_syscalls"] < vec_last["tx_frames"]):
+        fails.append("vectored leg shows no multi-frame batching "
+                     f"(syscalls {vec_last['tx_syscalls']}, frames "
+                     f"{vec_last['tx_frames']}, batched "
+                     f"{vec_last['tx_batched']}) — wrong code path?")
+    if leg_last["tx_batched"] != 0:
+        fails.append(f"legacy leg batched {leg_last['tx_batched']} "
+                     "frames — ACCL_RT_WIRE_LEGACY did not pin the "
+                     "baseline cost model")
     if fails:
         for f in fails:
             print(f"FAIL: {f}", file=sys.stderr)
@@ -3932,6 +4189,8 @@ if __name__ == "__main__":
         _fault_gate_main()
     elif "--chaos-gate" in sys.argv:
         _chaos_gate_main()
+    elif "--wire-gate" in sys.argv:
+        _wire_gate_main()
     elif "--hier-gate" in sys.argv:
         _hier_gate_main()
     elif "--check" in sys.argv or "--write-baseline" in sys.argv:

@@ -140,7 +140,14 @@ enum accl_rt_stat2 {
   ACCL_RT_STAT2_INJ_DUP = 18,
   ACCL_RT_STAT2_INJ_REORDER = 19,
   ACCL_RT_STAT2_RELY_NS = 20,
-  ACCL_RT_STATS2_COUNT = 21,
+  /* vectored-wire transmit shape (the zero-copy scatter-gather path):
+   * syscalls issued for frame transmit, and frames that shipped inside
+   * a multi-frame writev/sendmmsg batch. syscalls/tx_frames is the
+   * per-frame syscall ratio `bench --wire-gate` budgets; both stay 0
+   * on the in-process POE (no syscalls to count). */
+  ACCL_RT_STAT2_TX_SYSCALLS = 21,
+  ACCL_RT_STAT2_TX_BATCHED = 22,
+  ACCL_RT_STATS2_COUNT = 23,
 };
 
 /* Fill out[0..min(cap, ACCL_RT_STATS2_COUNT)) and return the total

@@ -1,0 +1,171 @@
+// accl-tpu native runtime: reliability sublayer — CRC32C frame
+// integrity (Castagnoli, the iSCSI/RDMA wire polynomial). Hardware
+// SSE4.2 crc32 instructions when the host has them (one-time cpuid
+// dispatch; ~an order of magnitude over the table walk — what keeps the
+// no-fault CRC cost inside the chaos gate's 3% per-dispatch budget),
+// byte-table fallback otherwise.
+
+#include "reliability.h"
+
+#include <cstring>
+#include <mutex>
+
+namespace acclw {
+namespace {
+
+constexpr uint32_t CRC32C_POLY = 0x82F63B78u;  // reflected Castagnoli
+
+uint32_t g_crc32c_table[256];
+
+void crc32c_table_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (CRC32C_POLY ^ (c >> 1)) : (c >> 1);
+    g_crc32c_table[i] = c;
+  }
+}
+
+uint32_t crc32c_sw(uint32_t crc, const uint8_t *p, size_t n) {
+  for (size_t i = 0; i < n; i++)
+    crc = g_crc32c_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+#if defined(__x86_64__)
+// The crc32 instruction has ~3-cycle latency at 1/cycle throughput, so
+// a single dependent chain runs at a third of the machine's rate —
+// and the frame CRC is the dominant term of the reliability
+// sublayer's no-fault budget. Standard remedy: run THREE independent
+// lanes over adjacent blocks and splice them with the GF(2)
+// "advance-over-N-zero-bytes" operator (CRC is linear: crc(A||B) =
+// shift_|B|(crc(A)) ^ crc(B)), precomputed as 4x256 tables for the two
+// block sizes. Measured ~2.5-3x over the single chain on the CI host —
+// what holds the chaos gate's 3% per-dispatch bound at jumbo frames.
+constexpr size_t CRC_LONG = 8192, CRC_SHORT = 256;  // powers of two
+uint32_t g_crc_zeros_long[4][256];
+uint32_t g_crc_zeros_short[4][256];
+
+// GF(2) 32x32 matrix applied to a 32-bit vector (mat[i] = image of
+// basis bit i).
+uint32_t gf2_times(const uint32_t *mat, uint32_t vec) {
+  uint32_t sum = 0;
+  while (vec) {
+    if (vec & 1) sum ^= *mat;
+    vec >>= 1;
+    mat++;
+  }
+  return sum;
+}
+
+void gf2_square(uint32_t *dst, const uint32_t *src) {
+  for (int i = 0; i < 32; i++) dst[i] = gf2_times(src, src[i]);
+}
+
+// Build the 4x256 table form of the operator advancing a (reflected)
+// CRC32C register over `len` zero bytes, len a power of two: the
+// one-zero-BIT operator squared log2(8*len) times.
+void crc32c_zeros(uint32_t zeros[4][256], size_t len) {
+  uint32_t a[32], b[32];
+  a[0] = CRC32C_POLY;
+  for (int i = 1; i < 32; i++) a[i] = 1u << (i - 1);
+  uint32_t *src = a, *dst = b;
+  int squarings = 3;  // 8 bits = one byte
+  for (size_t l = len; l > 1; l >>= 1) squarings++;
+  for (int k = 0; k < squarings; k++) {
+    gf2_square(dst, src);
+    uint32_t *t = src;
+    src = dst;
+    dst = t;
+  }
+  for (int j = 0; j < 4; j++)
+    for (uint32_t i = 0; i < 256; i++)
+      zeros[j][i] = gf2_times(src, i << (8 * j));
+}
+
+inline uint32_t crc32c_shift(const uint32_t zeros[4][256], uint32_t crc) {
+  return zeros[0][crc & 0xFF] ^ zeros[1][(crc >> 8) & 0xFF] ^
+         zeros[2][(crc >> 16) & 0xFF] ^ zeros[3][crc >> 24];
+}
+
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(uint32_t crc, const uint8_t *p, size_t n) {
+  uint64_t c0 = crc;
+  while (n >= 3 * CRC_LONG) {
+    uint64_t c1 = 0, c2 = 0;
+    const uint8_t *e = p + CRC_LONG;
+    do {
+      uint64_t v0, v1, v2;  // alignment-safe loads (UBSan-clean)
+      std::memcpy(&v0, p, 8);
+      std::memcpy(&v1, p + CRC_LONG, 8);
+      std::memcpy(&v2, p + 2 * CRC_LONG, 8);
+      c0 = __builtin_ia32_crc32di(c0, v0);
+      c1 = __builtin_ia32_crc32di(c1, v1);
+      c2 = __builtin_ia32_crc32di(c2, v2);
+      p += 8;
+    } while (p < e);
+    c0 = crc32c_shift(g_crc_zeros_long, (uint32_t)c0) ^ (uint32_t)c1;
+    c0 = crc32c_shift(g_crc_zeros_long, (uint32_t)c0) ^ (uint32_t)c2;
+    p += 2 * CRC_LONG;
+    n -= 3 * CRC_LONG;
+  }
+  while (n >= 3 * CRC_SHORT) {
+    uint64_t c1 = 0, c2 = 0;
+    const uint8_t *e = p + CRC_SHORT;
+    do {
+      uint64_t v0, v1, v2;
+      std::memcpy(&v0, p, 8);
+      std::memcpy(&v1, p + CRC_SHORT, 8);
+      std::memcpy(&v2, p + 2 * CRC_SHORT, 8);
+      c0 = __builtin_ia32_crc32di(c0, v0);
+      c1 = __builtin_ia32_crc32di(c1, v1);
+      c2 = __builtin_ia32_crc32di(c2, v2);
+      p += 8;
+    } while (p < e);
+    c0 = crc32c_shift(g_crc_zeros_short, (uint32_t)c0) ^ (uint32_t)c1;
+    c0 = crc32c_shift(g_crc_zeros_short, (uint32_t)c0) ^ (uint32_t)c2;
+    p += 2 * CRC_SHORT;
+    n -= 3 * CRC_SHORT;
+  }
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    c0 = __builtin_ia32_crc32di(c0, v);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = (uint32_t)c0;
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+  return c32;
+}
+#endif
+
+uint32_t (*g_crc32c_fn)(uint32_t, const uint8_t *, size_t) = crc32c_sw;
+std::once_flag g_crc32c_once;
+
+}  // namespace
+
+uint32_t crc32c(uint32_t crc, const void *p, size_t n) {
+  std::call_once(g_crc32c_once, [] {
+    crc32c_table_init();
+#if defined(__x86_64__)
+    if (__builtin_cpu_supports("sse4.2")) {
+      crc32c_zeros(g_crc_zeros_long, CRC_LONG);
+      crc32c_zeros(g_crc_zeros_short, CRC_SHORT);
+      g_crc32c_fn = crc32c_hw;
+    }
+#endif
+  });
+  return g_crc32c_fn(crc, (const uint8_t *)p, n);
+}
+
+// Whole-frame CRC: header with the crc field zeroed, then the payload.
+uint32_t frame_crc(const MsgHeader &h, const void *payload, size_t plen) {
+  MsgHeader tmp = h;
+  tmp.crc = 0;
+  uint32_t c = crc32c(0xFFFFFFFFu, &tmp, sizeof tmp);
+  if (plen) c = crc32c(c, payload, plen);
+  return c ^ 0xFFFFFFFFu;
+}
+
+}  // namespace acclw

@@ -1,0 +1,64 @@
+// accl-tpu native runtime: reliability sublayer internals — frame
+// integrity (CRC32C) and the retransmit-retention types the session's
+// selective-retransmit machinery keys its state on.
+//
+// SEAM RULE: this header is session-side. transport.cpp must NOT
+// include it (the POE seam carries already-built frames and knows
+// nothing about CRC or retransmit policy) — `make -C native seamcheck`
+// fails the build if it ever does.
+
+#ifndef ACCLRT_RELIABILITY_H
+#define ACCLRT_RELIABILITY_H
+
+#include <chrono>
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+
+#include "wire.h"
+
+namespace acclw {
+
+// CRC32C (Castagnoli, the iSCSI/RDMA wire polynomial). Hardware SSE4.2
+// dispatch on first use; byte-table fallback otherwise (see
+// reliability.cpp for the 3-lane GF(2)-spliced hot path).
+uint32_t crc32c(uint32_t crc, const void *p, size_t n);
+
+// Whole-frame CRC: header with the crc field zeroed, then the payload.
+uint32_t frame_crc(const MsgHeader &h, const void *payload, size_t plen);
+
+// ---------------------------------------------------------------------------
+// Retransmit retention: per-(peer, lane) bounded buffer of sent frames,
+// pinned BY REFERENCE (the FramePtr shares the serialized frame with the
+// in-flight TX batch — building a frame never copies payload twice).
+// GC'd by the peer's cumulative ACKs, evicted oldest-first at budget.
+// ---------------------------------------------------------------------------
+struct RetxFrame {
+  uint32_t seqn;
+  FramePtr bytes;  // serialized header+payload, shared with the TX path
+};
+struct RetxBuf {
+  std::deque<RetxFrame> q;  // ascending seqn
+  uint64_t bytes = 0;       // retained payload+header bytes (vs budget)
+};
+
+// REORDER injection: a frame the seeded chaos model holds back to swap
+// with the next one to its (dst, lane) — same shared serialized bytes.
+struct HeldFrame {
+  FramePtr bytes;
+  std::chrono::steady_clock::time_point since;
+};
+
+// Receiver-side NACK pacing state for one (peer, lane) seqn stream.
+// want = the head seqn a consumer is provably waiting on (recorded at
+// seek miss); NACKed with bounded exponential backoff.
+struct WantState {
+  bool active = false;
+  uint32_t seqn = 0;
+  uint32_t attempts = 0;
+  std::chrono::steady_clock::time_point next_nack{};
+};
+
+}  // namespace acclw
+
+#endif  // ACCLRT_RELIABILITY_H

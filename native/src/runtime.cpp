@@ -1,12 +1,17 @@
-// accl-tpu native runtime implementation.
+// accl-tpu native runtime: the SESSION translation unit.
 //
-// One instance per rank: a TCP full-mesh transport (the POE layer,
-// reference kernels/cclo/hls/eth_intf + dummy stacks), an eager rx-buffer
-// ring with (src, tag, seqn) seek matching (reference rxbuf_offload/*),
-// rendezvous address/completion matching with pending queues (reference
-// ccl_offload_control.c:142-408), and a single sequencer thread running
-// the call + retry queues round-robin with current_step resumption
+// One instance per rank: an eager rx-buffer ring with (src, tag, seqn)
+// seek matching (reference rxbuf_offload/*), rendezvous address/
+// completion matching with pending queues (reference
+// ccl_offload_control.c:142-408), the reliability sublayer's
+// retransmit/ack policy, and a single sequencer thread running the
+// call + retry queues round-robin with current_step resumption
 // (reference run(), ccl_offload_control.c:2308-2483).
+//
+// The wire itself lives BELOW the POE seam (src/transport.h): this TU
+// builds frames and hands them to a Poe (TCP mesh / UDP datagrams /
+// in-process registry) as scatter-gather views, and receives inbound
+// frames via PoeSink::on_frame. It never touches a socket.
 //
 // Collective algorithms mirror the firmware's selections exactly —
 // eager/rendezvous split, ring vs flat vs binary tree by tuning register —
@@ -14,14 +19,8 @@
 
 #include "../include/acclrt.h"
 
-#include <arpa/inet.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <poll.h>
-#include <sys/socket.h>
-#include <unistd.h>
-
 #include <atomic>
+#include <cassert>
 #include <chrono>
 #include <condition_variable>
 #include <cstddef>
@@ -38,6 +37,12 @@
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include "reliability.h"
+#include "transport.h"
+#include "wire.h"
+
+using namespace acclw;
 
 namespace {
 
@@ -86,61 +91,8 @@ enum Scenario : uint32_t {
   SC_BARRIER = 12, SC_ALLTOALL = 13, SC_NOP = 255,
 };
 
-// ---------------------------------------------------------------------------
-// Wire format: 64-byte header (eth_intf.h:94-151 analog) + payload
-// ---------------------------------------------------------------------------
-enum MsgType : uint32_t {
-  MSG_EGR_DATA = 0,    // eager segment into an rx slot
-  MSG_RNDZV_ADDR = 1,  // receiver -> sender address notification
-  MSG_RNDZV_WRITE = 2, // sender -> receiver one-sided write payload
-  MSG_HELLO = 3,       // datagram bring-up solicit (reply expected)
-  MSG_HELLO_ACK = 4,   // datagram bring-up reply (no further reply)
-  // reliability sublayer control frames (header-only; seqn is the
-  // REFERENCED data seqn, never a slot in the per-peer seqn stream):
-  MSG_ACK = 5,   // receiver -> sender: cumulative "everything below
-                 // seqn landed" — sender GCs its retransmit buffer
-  MSG_NACK = 6,  // receiver -> sender: "resend (src, seqn)" — the
-                 // selective-retransmit request a gap or CRC drop arms
-};
-
-struct MsgHeader {
-  uint32_t magic;
-  uint32_t msg_type;
-  uint32_t src;
-  uint32_t dst;
-  uint32_t tag;
-  uint32_t seqn;
-  // CRC32C over the whole frame (header with this field zeroed +
-  // payload), set on every frame when the reliability sublayer is on
-  // (ACCL_RT_RELY, default 1; the field was dead pad before — the
-  // offload engine owning integrity below the host, README.md:6). A
-  // mismatch is counted and the frame DROPPED, never landed: corrupt
-  // data cannot reach a reduce lane; the seqn gap it leaves is
-  // repaired by the NACK path like a lost frame.
-  uint32_t crc;
-  uint32_t host;
-  uint64_t bytes;  // payload length / rendezvous size
-  uint64_t vaddr;  // rendezvous target address
-  // total bytes of the eager MESSAGE this segment belongs to: the
-  // receiver-side message boundary. Without it a parked recv whose count
-  // mismatches the head message would consume it as partial fill and
-  // misassemble two messages into one buffer (the reference wire needs no
-  // equivalent because rxbuf_seek pairs whole DMA commands, not byte
-  // streams). Rides every MSG_EGR_DATA segment, with msg_off locating the
-  // segment inside its message (0 = message head) so an orphaned
-  // continuation segment — left behind when a mid-message recv times out —
-  // can never masquerade as a fresh head of the same length.
-  uint64_t msg_bytes;
-  uint64_t msg_off;
-};
-static_assert(sizeof(MsgHeader) == 64, "ACCL header is 64 bytes");
-// Bumped (…02) when the header's pad bytes became msg_bytes/msg_off
-// framing, (…03) when the dead strm word became the frame CRC32C and
-// MSG_ACK/MSG_NACK joined the protocol: a mixed-build world (old
-// sender, new receiver) would not error on size/magic but silently
-// never match and surface as RECEIVE_TIMEOUT — the magic makes
-// cross-version ranks fail fast at frame decode instead.
-constexpr uint32_t MSG_MAGIC = 0xACC17B03u;
+// Wire format (MsgType/MsgHeader/MSG_MAGIC) lives in wire.h — shared
+// with the transport side of the POE seam.
 
 // ---------------------------------------------------------------------------
 // dtype helpers: elementwise SUM/MAX incl. fp16/bf16 via uint16 conversion
@@ -269,195 +221,8 @@ static uint32_t combine_buffers(uint32_t dt, uint32_t func, void *a,
   }
 }
 
-// ---------------------------------------------------------------------------
-// socket helpers
-// ---------------------------------------------------------------------------
-
-static bool send_all(int fd, const void *buf, size_t n) {
-  const char *p = (const char *)buf;
-  while (n) {
-    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (w <= 0) return false;
-    p += w;
-    n -= (size_t)w;
-  }
-  return true;
-}
-
-static bool recv_all(int fd, void *buf, size_t n) {
-  char *p = (char *)buf;
-  while (n) {
-    ssize_t r = ::recv(fd, p, n, 0);
-    if (r <= 0) return false;
-    p += r;
-    n -= (size_t)r;
-  }
-  return true;
-}
-
-// ---------------------------------------------------------------------------
-// CRC32C (Castagnoli, the iSCSI/RDMA wire polynomial): frame integrity
-// for the reliability sublayer. Hardware SSE4.2 crc32 instructions when
-// the host has them (one-time cpuid dispatch; ~an order of magnitude
-// over the table walk — what keeps the no-fault CRC cost inside the
-// chaos gate's 3% per-dispatch budget), byte-table fallback otherwise.
-// ---------------------------------------------------------------------------
-
-constexpr uint32_t CRC32C_POLY = 0x82F63B78u;  // reflected Castagnoli
-
-static uint32_t g_crc32c_table[256];
-
-static void crc32c_table_init() {
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; k++)
-      c = (c & 1) ? (CRC32C_POLY ^ (c >> 1)) : (c >> 1);
-    g_crc32c_table[i] = c;
-  }
-}
-
-static uint32_t crc32c_sw(uint32_t crc, const uint8_t *p, size_t n) {
-  for (size_t i = 0; i < n; i++)
-    crc = g_crc32c_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
-  return crc;
-}
-
-#if defined(__x86_64__)
-// The crc32 instruction has ~3-cycle latency at 1/cycle throughput, so
-// a single dependent chain runs at a third of the machine's rate —
-// and the frame CRC is the dominant term of the reliability
-// sublayer's no-fault budget. Standard remedy: run THREE independent
-// lanes over adjacent blocks and splice them with the GF(2)
-// "advance-over-N-zero-bytes" operator (CRC is linear: crc(A||B) =
-// shift_|B|(crc(A)) ^ crc(B)), precomputed as 4x256 tables for the two
-// block sizes. Measured ~2.5-3x over the single chain on the CI host —
-// what holds the chaos gate's 3% per-dispatch bound at jumbo frames.
-constexpr size_t CRC_LONG = 8192, CRC_SHORT = 256;  // powers of two
-static uint32_t g_crc_zeros_long[4][256];
-static uint32_t g_crc_zeros_short[4][256];
-
-// GF(2) 32x32 matrix applied to a 32-bit vector (mat[i] = image of
-// basis bit i).
-static uint32_t gf2_times(const uint32_t *mat, uint32_t vec) {
-  uint32_t sum = 0;
-  while (vec) {
-    if (vec & 1) sum ^= *mat;
-    vec >>= 1;
-    mat++;
-  }
-  return sum;
-}
-
-static void gf2_square(uint32_t *dst, const uint32_t *src) {
-  for (int i = 0; i < 32; i++) dst[i] = gf2_times(src, src[i]);
-}
-
-// Build the 4x256 table form of the operator advancing a (reflected)
-// CRC32C register over `len` zero bytes, len a power of two: the
-// one-zero-BIT operator squared log2(8*len) times.
-static void crc32c_zeros(uint32_t zeros[4][256], size_t len) {
-  uint32_t a[32], b[32];
-  a[0] = CRC32C_POLY;
-  for (int i = 1; i < 32; i++) a[i] = 1u << (i - 1);
-  uint32_t *src = a, *dst = b;
-  int squarings = 3;  // 8 bits = one byte
-  for (size_t l = len; l > 1; l >>= 1) squarings++;
-  for (int k = 0; k < squarings; k++) {
-    gf2_square(dst, src);
-    uint32_t *t = src;
-    src = dst;
-    dst = t;
-  }
-  for (int j = 0; j < 4; j++)
-    for (uint32_t i = 0; i < 256; i++)
-      zeros[j][i] = gf2_times(src, i << (8 * j));
-}
-
-static inline uint32_t crc32c_shift(const uint32_t zeros[4][256],
-                                    uint32_t crc) {
-  return zeros[0][crc & 0xFF] ^ zeros[1][(crc >> 8) & 0xFF] ^
-         zeros[2][(crc >> 16) & 0xFF] ^ zeros[3][crc >> 24];
-}
-
-__attribute__((target("sse4.2")))
-static uint32_t crc32c_hw(uint32_t crc, const uint8_t *p, size_t n) {
-  uint64_t c0 = crc;
-  while (n >= 3 * CRC_LONG) {
-    uint64_t c1 = 0, c2 = 0;
-    const uint8_t *e = p + CRC_LONG;
-    do {
-      uint64_t v0, v1, v2;  // alignment-safe loads (UBSan-clean)
-      std::memcpy(&v0, p, 8);
-      std::memcpy(&v1, p + CRC_LONG, 8);
-      std::memcpy(&v2, p + 2 * CRC_LONG, 8);
-      c0 = __builtin_ia32_crc32di(c0, v0);
-      c1 = __builtin_ia32_crc32di(c1, v1);
-      c2 = __builtin_ia32_crc32di(c2, v2);
-      p += 8;
-    } while (p < e);
-    c0 = crc32c_shift(g_crc_zeros_long, (uint32_t)c0) ^ (uint32_t)c1;
-    c0 = crc32c_shift(g_crc_zeros_long, (uint32_t)c0) ^ (uint32_t)c2;
-    p += 2 * CRC_LONG;
-    n -= 3 * CRC_LONG;
-  }
-  while (n >= 3 * CRC_SHORT) {
-    uint64_t c1 = 0, c2 = 0;
-    const uint8_t *e = p + CRC_SHORT;
-    do {
-      uint64_t v0, v1, v2;
-      std::memcpy(&v0, p, 8);
-      std::memcpy(&v1, p + CRC_SHORT, 8);
-      std::memcpy(&v2, p + 2 * CRC_SHORT, 8);
-      c0 = __builtin_ia32_crc32di(c0, v0);
-      c1 = __builtin_ia32_crc32di(c1, v1);
-      c2 = __builtin_ia32_crc32di(c2, v2);
-      p += 8;
-    } while (p < e);
-    c0 = crc32c_shift(g_crc_zeros_short, (uint32_t)c0) ^ (uint32_t)c1;
-    c0 = crc32c_shift(g_crc_zeros_short, (uint32_t)c0) ^ (uint32_t)c2;
-    p += 2 * CRC_SHORT;
-    n -= 3 * CRC_SHORT;
-  }
-  while (n >= 8) {
-    uint64_t v;
-    std::memcpy(&v, p, 8);
-    c0 = __builtin_ia32_crc32di(c0, v);
-    p += 8;
-    n -= 8;
-  }
-  uint32_t c32 = (uint32_t)c0;
-  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
-  return c32;
-}
-#endif
-
-static uint32_t (*g_crc32c_fn)(uint32_t, const uint8_t *, size_t) =
-    crc32c_sw;
-static std::once_flag g_crc32c_once;
-
-static uint32_t crc32c(uint32_t crc, const void *p, size_t n) {
-  std::call_once(g_crc32c_once, [] {
-    crc32c_table_init();
-#if defined(__x86_64__)
-    if (__builtin_cpu_supports("sse4.2")) {
-      crc32c_zeros(g_crc_zeros_long, CRC_LONG);
-      crc32c_zeros(g_crc_zeros_short, CRC_SHORT);
-      g_crc32c_fn = crc32c_hw;
-    }
-#endif
-  });
-  return g_crc32c_fn(crc, (const uint8_t *)p, n);
-}
-
-// Whole-frame CRC: header with the crc field zeroed, then the payload.
-static uint32_t frame_crc(const MsgHeader &h, const void *payload,
-                          size_t plen) {
-  MsgHeader tmp = h;
-  tmp.crc = 0;
-  uint32_t c = crc32c(0xFFFFFFFFu, &tmp, sizeof tmp);
-  if (plen) c = crc32c(c, payload, plen);
-  return c ^ 0xFFFFFFFFu;
-}
+// CRC32C + frame_crc live in reliability.{h,cpp} (session-side; the
+// transport never computes integrity).
 
 // ---------------------------------------------------------------------------
 // runtime
@@ -475,6 +240,7 @@ static constexpr uint64_t STREAM_SEG_BYTES = 1ull << 20;
 struct RxSlot {
   enum { IDLE, VALID } status = IDLE;
   uint32_t src = 0, tag = 0, seqn = 0;
+  uint32_t lane = 0;  // the (src, lane) seqn stream this segment rides
   uint64_t msg_bytes = 0;  // total length of the message this segment is of
   uint64_t msg_off = 0;    // this segment's byte offset inside that message
   // landing time: a strict recv meeting a MISMATCHED head defers while
@@ -598,37 +364,42 @@ struct Completion {
 
 }  // namespace
 
-// ----- local (intra-process) POE registry ----------------------------------
-// A third protocol-offload engine beside the TCP session mesh and the
-// sessionless datagram POE: ranks living in one process (EmuWorld's
-// threads — the emulator's normal shape) deliver frames by direct call
-// into the peer runtime, no sockets and no kernel copies — the
-// intra-node fast-path role NCCL fills with SHM/P2P transports. The
-// registry maps each rank's nominal port to its runtime; `local_refs`
-// pins a peer across one delivery so destroy cannot free it mid-call.
-struct accl_rt;
-static std::mutex g_local_mu;
-static std::condition_variable g_local_cv;
-static std::unordered_map<uint16_t, accl_rt *> g_local_ports;
-
-struct accl_rt {
+struct accl_rt : public acclw::PoeSink {
   uint32_t world, rank;
   uint32_t rx_buf_bytes, max_eager;
   uint64_t max_rndzv;
   std::vector<uint8_t> exchmem = std::vector<uint8_t>(EXCHMEM_BYTES, 0);
   std::mutex exch_mu;
 
-  // transport — TCP full mesh (session-based, the EasyNet-class POE) or
-  // one shared datagram socket (sessionless, the VNX-UDP POE analog:
-  // every segment is a standalone packet carrying the full 64 B header,
-  // reassembled purely by (src, tag, seqn) — the udp_depacketizer role)
-  std::vector<int> peer_fd;          // per-rank socket (self = -1), TCP mode
-  std::vector<std::mutex> tx_mu;     // serialize frames per link
-  std::vector<std::thread> rx_threads;
-  int listen_fd = -1;
+  // The Protocol Offload Engine behind the seam (src/transport.h) — TCP
+  // full mesh (session-based, the EasyNet-class POE), one shared
+  // datagram socket (sessionless, the VNX-UDP POE analog: every segment
+  // a standalone packet carrying the full 64 B header, reassembled
+  // purely by (src, tag, seqn) — the udp_depacketizer role), or the
+  // intra-process registry (direct-call delivery, the intra-node
+  // fast-path role NCCL fills with SHM/P2P transports). The session
+  // builds frames and hands the Poe scatter-gather views; inbound
+  // frames arrive via on_frame (the PoeSink side of this struct).
+  std::unique_ptr<acclw::Poe> poe;
   bool udp_mode = false;
-  int udp_fd = -1;
-  std::vector<sockaddr_in> peer_sa;  // datagram peer addresses
+  // Per-peer LANES (TCP only, ACCL_RT_LANES, clamped [1, 2]): each
+  // (peer, lane) pair is an independent ordered link carrying its own
+  // seqn stream, so a jumbo eager message on the bulk lane (lane 1,
+  // messages >= lane_bulk_bytes) cannot head-of-line-block a small
+  // message on the default lane. All per-peer stream state below is
+  // indexed by sid = rank * n_lanes + lane. Default 1 lane — the
+  // single-stream wire, bit-identical to the pre-lane protocol.
+  uint32_t n_lanes = 1;
+  uint64_t lane_bulk_bytes = 64ull << 10;  // ACCL_RT_LANE_BULK_BYTES
+  bool legacy_wire = false;  // ACCL_RT_WIRE_LEGACY: per-frame-syscall
+                             // cost model, batching off (bench A/B)
+  bool tx_batch_on = false;  // computed at create: vectored batching
+                             // armed (off under chaos/WAN/legacy/local
+                             // — those paths need per-frame emission)
+  uint32_t sid(uint32_t r, uint32_t lane) const { return r * n_lanes + lane; }
+  uint32_t lane_of(uint64_t msg_bytes) const {
+    return (n_lanes > 1 && msg_bytes >= lane_bulk_bytes) ? 1u : 0u;
+  }
   std::vector<bool> hello_seen;      // bring-up handshake state
   std::mutex hello_mu;
   std::condition_variable hello_cv;
@@ -641,12 +412,14 @@ struct accl_rt {
   std::vector<size_t> idle_q;
   size_t base_rx_slots = 0;  // configured ring size; growth beyond it is
                              // burst absorption and compacts when drained
-  // (src, seqn) -> slot index: seeks are O(1) even when a datagram burst
+  // (sid, seqn) -> slot index: seeks are O(1) even when a datagram burst
   // grows the ring to 2^20 slots (a linear scan made draining a large
   // burst quadratic). src_valid_count keeps stray-seqn detection O(1).
+  // All stream-indexed maps below key on sid = src * n_lanes + lane —
+  // each lane is its own ordered seqn stream.
   std::unordered_map<uint64_t, size_t> rx_index;
   std::vector<uint32_t> src_valid_count;
-  // src -> the call (CollState address) that has consumed part of a
+  // sid -> the call (CollState address) that has consumed part of a
   // multi-segment eager message from that src and owns the remainder of
   // its stream: segments of one message share tag and consecutive seqns,
   // so a DIFFERENT call matching the next head by tag would interleave
@@ -654,8 +427,8 @@ struct accl_rt {
   // a collective on the same src link). Guarded by rx_mu; released on
   // message completion or call termination (release_rx_ownership).
   std::unordered_map<uint32_t, const void *> rx_stream_owner;
-  static uint64_t rx_key(uint32_t src, uint32_t seqn) {
-    return ((uint64_t)src << 32) | seqn;
+  static uint64_t rx_key(uint32_t sid, uint32_t seqn) {
+    return ((uint64_t)sid << 32) | seqn;
   }
 
   // Outstanding SC_RECV registry for posted-order FIFO pairing (see the
@@ -715,7 +488,7 @@ struct accl_rt {
     bool abort = false;   // revoker asked the rx thread to let go
     const void *tok = nullptr;
   };
-  std::unordered_map<uint32_t, EagerLanding> eager_landings;  // by src
+  std::unordered_map<uint32_t, EagerLanding> eager_landings;  // by sid
 
   // Remove a call's landings (rx_mu held via lk). An in-flight direct
   // read is asked to let go via `abort`; the rx thread's read loop is
@@ -743,7 +516,7 @@ struct accl_rt {
       eager_landings.erase(it);
     }
   }
-  // srcs whose seqn head may hold orphaned continuation segments of a
+  // sids whose seqn head may hold orphaned continuation segments of a
   // message whose recv died mid-consumption: seek discards segments with
   // msg_off != 0 until the next message head surfaces. Guarded by rx_mu.
   std::set<uint32_t> rx_drain_srcs;
@@ -781,7 +554,8 @@ struct accl_rt {
   std::mutex rndzv_mu;
   std::condition_variable rndzv_cv;
 
-  // per-peer sequence numbers (ccl_offload_control.h:297-310)
+  // per-(peer, lane) sequence numbers (ccl_offload_control.h:297-310),
+  // indexed by sid — each lane is an independent ordered stream
   std::vector<uint32_t> inbound_seq, outbound_seq;
 
   // call + retry queues and sequencer thread (run() analog). Calls on the
@@ -945,40 +719,24 @@ struct accl_rt {
                           // in stats, so a chaos soak never spams stderr
   uint64_t retx_budget_bytes = 16ull << 20;  // per dst, oldest evicted
   uint32_t nack_max = 24;                    // per-seqn attempt budget
-  struct RetxFrame {
-    uint32_t seqn;
-    std::shared_ptr<std::vector<uint8_t>> bytes;  // header + payload
-  };
-  struct RetxBuf {
-    std::deque<RetxFrame> q;
-    uint64_t bytes = 0;
-  };
-  std::vector<RetxBuf> retx;  // per dst; rely_mu
+  // RetxFrame/RetxBuf/HeldFrame/WantState are the shared reliability
+  // types (reliability.h); retention is BY REFERENCE — the FramePtr in
+  // the retx buffer is the same serialized frame the wire shipped.
+  std::vector<RetxBuf> retx;  // per (dst, lane) sid; rely_mu
   // retransmits requested by peers, drained by the HEALTH thread: the
   // rx thread must never perform a blocking data-frame send itself —
   // two peers simultaneously retransmitting jumbo frames to each other
   // from their rx loops would stop draining their sockets while
   // blocked in send_all and mutually wedge both links (a liveness
   // hazard the pre-rely rx thread never had). rely_mu.
-  std::deque<std::pair<uint32_t, std::shared_ptr<std::vector<uint8_t>>>>
-      retx_pending;
-  struct HeldFrame {  // REORDER injection: frame held to swap with the
-    std::shared_ptr<std::vector<uint8_t>> bytes;  // next one to its dst
-    std::chrono::steady_clock::time_point since;
-  };
-  std::unordered_map<uint32_t, HeldFrame> reorder_held;  // rely_mu
+  std::deque<FramePtr> retx_pending;  // dst + lane ride the header
+  std::unordered_map<uint32_t, HeldFrame> reorder_held;  // by sid; rely_mu
   std::mutex rely_mu;
   std::thread rely_thread;
   // receiver-side per-src want/ack state (rx_mu, like the rx state it
   // describes). want = the head seqn a consumer is provably waiting on
   // (recorded at seek miss); acked_upto = the last cumulative ack sent.
-  struct WantState {
-    bool active = false;
-    uint32_t seqn = 0;
-    uint32_t attempts = 0;
-    std::chrono::steady_clock::time_point next_nack{};
-  };
-  std::vector<WantState> want;
+  std::vector<WantState> want;  // per (src, lane) sid
   std::vector<uint32_t> acked_upto;
   std::vector<std::chrono::steady_clock::time_point> last_ack_t;
 
@@ -1028,13 +786,8 @@ struct accl_rt {
   std::atomic<bool> fault_tail_pending{false};
   std::atomic<uint32_t> fault_tail_dst{0};
 
-  // local (intra-process) POE state: my nominal port, the world's port
-  // map, and the pin count of in-flight deliveries INTO this runtime
-  // (guarded by g_local_mu)
+  // intra-process POE (registry + pinning live in the LocalPoe)
   bool local_mode = false;
-  uint16_t local_port = 0;
-  std::vector<uint16_t> local_ports_vec;
-  int local_refs = 0;
 
   // Generation counter of rx-side progress events (eager landings,
   // rendezvous addresses/completions): the sequencer snapshots it before
@@ -1121,15 +874,29 @@ struct accl_rt {
   // receiving runtime (no rx threads exist in local mode). The caller
   // holds none of ITS OWN locks (every frame_out site releases first),
   // so taking this runtime's rx/rndzv locks cannot deadlock.
-  bool local_deliver(const MsgHeader &h, const uint8_t *payload,
-                     size_t plen) {
+  // ----- PoeSink: inbound frames from the transport seam ------------------
+
+  // One inbound frame. Mem-backed bodies (datagram / in-process POEs)
+  // arrive whole; stream bodies (TCP) expose the link so payloads land
+  // directly at their destination.
+  bool on_frame(uint32_t lane, const MsgHeader &h,
+                acclw::PayloadSource &body) override {
+    if (body.data()) return on_frame_mem(lane, h, body.data(), body.remaining());
+    return on_frame_stream(lane, h, body);
+  }
+
+  // Memory-resident frame (the whole payload arrived with the header):
+  // the merged landing path of the in-process and datagram POEs.
+  bool on_frame_mem(uint32_t lane, const MsgHeader &h, const uint8_t *payload,
+                    size_t plen) {
     if (stop.load()) return false;
+    uint32_t s = sid(h.src, lane);
     // rx volume counts PRE-CRC on every transport (the acclrt.h
     // contract: a lossy link shows frames ARRIVING, damaged or not)
     if (h.msg_type == MSG_EGR_DATA) stat_rx_frames++;
     // dead host semantics for the in-process POE: frames into a wedged
     // rank are swallowed (never landed, never blocking the sender)
-    if (killed.load(std::memory_order_relaxed)) return true;
+    if (local_mode && killed.load(std::memory_order_relaxed)) return true;
     if (rely_wire) {
       auto t0 = std::chrono::steady_clock::now();
       bool okc = h.crc == frame_crc(h, payload, plen);
@@ -1141,30 +908,48 @@ struct accl_rt {
         // touched — never landed. An eager drop leaves a seqn gap the
         // nack path repairs like a loss.
         stat_crc_drops++;
-        if (h.msg_type == MSG_EGR_DATA) {
+        if (h.msg_type == MSG_EGR_DATA &&
+            !killed.load(std::memory_order_relaxed)) {
           std::lock_guard<std::mutex> g(rx_mu);
-          note_want_locked(h.src, /*proven=*/true);
+          note_want_locked(s, /*proven=*/true);
         }
         return true;
       }
     }
     switch (h.msg_type) {
+      case MSG_HELLO:
+        // datagram bring-up solicit (hello traffic has no meaning
+        // in-process — the local POE's registry IS its bring-up)
+        if (udp_mode) frame_out(h.src, MSG_HELLO_ACK, 0, 0, 0, 0, nullptr, 0);
+        [[fallthrough]];
+      case MSG_HELLO_ACK:
+        if (udp_mode) {
+          std::lock_guard<std::mutex> g(hello_mu);
+          hello_seen[h.src] = true;
+          hello_cv.notify_all();
+        }
+        return true;
       case MSG_ACK:
-        handle_ack(h.src, h.seqn);
+        if (!killed.load(std::memory_order_relaxed))
+          handle_ack(h.src, lane, h.seqn);
         return true;
       case MSG_NACK:
-        handle_nack(h.src, h.seqn);
+        if (!killed.load(std::memory_order_relaxed))
+          handle_nack(h.src, lane, h.seqn);
         return true;
       case MSG_EGR_DATA: {
+        if (killed.load(std::memory_order_relaxed)) return true;  // dead host
         {
           // direct landing (zero-copy for the consumer): same
-          // eligibility as the TCP rx path, but the copy happens right
-          // here under rx_mu — in-process memcpy, no staging
+          // eligibility as the stream POE's rx path, but the copy
+          // happens right here under rx_mu — in-process memcpy, no
+          // staging. (Landings register only on ordered links, so the
+          // datagram POE never matches one.)
           std::lock_guard<std::mutex> lk(rx_mu);
-          auto lnd = eager_landings.find(h.src);
+          auto lnd = eager_landings.find(s);
           if (lnd != eager_landings.end() && !lnd->second.in_use &&
-              !lnd->second.abort && h.seqn == inbound_seq[h.src] &&
-              src_valid_count[h.src] == 0 && !rx_drain_srcs.count(h.src) &&
+              !lnd->second.abort && h.seqn == inbound_seq[s] &&
+              src_valid_count[s] == 0 && !rx_drain_srcs.count(s) &&
               (lnd->second.tag == TAG_ANY || h.tag == TAG_ANY ||
                lnd->second.tag == h.tag) &&
               h.msg_bytes == lnd->second.want &&
@@ -1174,28 +959,31 @@ struct accl_rt {
               std::memcpy(lnd->second.base + lnd->second.landed, payload,
                           plen);
             lnd->second.landed += plen;
-            inbound_seq[h.src] = h.seqn + 1;
+            inbound_seq[s] = h.seqn + 1;
             rx_event();
             return true;
           }
         }
         std::vector<uint8_t> copy(payload, payload + plen);
-        if (!land_eager(h, std::move(copy), /*allow_grow=*/true))
+        if (!land_eager(h, lane, std::move(copy), /*allow_grow=*/true))
           return false;
         return true;
       }
       case MSG_RNDZV_ADDR: {
+        if (udp_mode) break;  // rendezvous not offered on the datagram POE
         {
           std::lock_guard<std::mutex> g(rndzv_mu);
-          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
+          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag,
+                            wire_host(h.host)});
           rndzv_cv.notify_all();
         }
         rx_event();
         return true;
       }
       case MSG_RNDZV_WRITE: {
+        if (udp_mode) break;
         // validate + land + complete in one critical section (the
-        // staged TCP path's semantics; in-process the copy IS direct)
+        // staged stream path's semantics; in-process the copy IS direct)
         bool posted = false;
         {
           std::lock_guard<std::mutex> g(rndzv_mu);
@@ -1219,79 +1007,46 @@ struct accl_rt {
         return true;
       }
       default:
-        return true;  // hello traffic has no meaning in-process
+        return true;
     }
+    // rendezvous message on the sessionless POE: one-sided writes need
+    // a session transport (reference: RDMA-only message types) — drop
+    if (debug_on)
+      fprintf(stderr, "[r%u] drop mt=%u on datagram transport\n", rank,
+              h.msg_type);
+    return true;
   }
 
-  // Resolve + pin the peer runtime, deliver on THIS thread, unpin.
-  // Bring-up is the registry itself: a peer not yet constructed
-  // registers within the creation barrier, so wait briefly.
-  // The two g_local_mu acquisitions per frame are deliberate: the
-  // registry lock is what makes peer TEARDOWN safe (destroy
-  // deregisters, then waits refs==0 — a lock-free cached-pointer
-  // pin would race destruction between load and increment). Streamed
-  // hops are jumbo segments, so big transfers take a handful of
-  // round trips, and the measured bottleneck on the CI host is
-  // scheduler parking, not this futex.
-  bool local_send(uint32_t dst, const MsgHeader &h, const uint8_t *payload,
-                  size_t payload_len) {
-    accl_rt *peer_rt = nullptr;
-    {
-      std::unique_lock<std::mutex> g(g_local_mu);
-      auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::seconds(10);
-      for (;;) {
-        auto it = g_local_ports.find(local_ports_vec[dst]);
-        if (it != g_local_ports.end()) {
-          peer_rt = it->second;
-          peer_rt->local_refs++;
-          break;
-        }
-        if (stop.load() ||
-            g_local_cv.wait_until(g, deadline) == std::cv_status::timeout)
-          return false;
-      }
-    }
-    bool ok = peer_rt->local_deliver(h, payload, payload_len);
-    {
-      std::lock_guard<std::mutex> g(g_local_mu);
-      peer_rt->local_refs--;
-      g_local_cv.notify_all();
-    }
+  // Raw-frame emit: POE delivery of ONE serialized frame (header +
+  // payload contiguous, CRC already set; dst and lane ride the header).
+  // The retransmit path, the reorder-hold release, and the duplicate
+  // injection all ride this, so a resent frame is byte-identical to
+  // the original.
+  bool wire_emit(const FrameBuf &f) {
+    FrameView v = frame_view(f);
+    return poe_send(v.h.dst, wire_lane(v.h), &v, 1);
+  }
+
+  // Every outbound frame funnels here. Debug-build invariant of the
+  // vectored wire (the no-double-copy contract): the transport ships
+  // borrowed scatter-gather views — payload_copies() counts
+  // transport-side coalescing and stays zero except under the
+  // ACCL_RT_WIRE_LEGACY cost model.
+  bool poe_send(uint32_t dst, uint32_t lane, const FrameView *fv, size_t n) {
+    if (stop.load()) return false;
+    bool ok = poe->send_frames(dst, lane, fv, n);
+    assert(legacy_wire || poe->payload_copies() == 0);
     return ok;
   }
 
-  // Raw-frame emit: transport-specific delivery of ONE serialized frame
-  // (header + payload contiguous, CRC already set). The retransmit
-  // path, the reorder-hold release, and the duplicate injection all
-  // ride this, so a resent frame is byte-identical to the original.
-  bool wire_emit(uint32_t dst, const std::vector<uint8_t> &f) {
-    if (stop.load()) return false;
-    size_t plen = f.size() - sizeof(MsgHeader);
-    if (local_mode) {
-      MsgHeader h;
-      std::memcpy(&h, f.data(), sizeof h);
-      return local_send(dst, h, f.data() + sizeof h, plen);
-    }
-    if (udp_mode) {
-      wan_charge(plen);
-      ssize_t n = sendto(udp_fd, f.data(), f.size(), 0,
-                         (const sockaddr *)&peer_sa[dst],
-                         sizeof(sockaddr_in));
-      return n == (ssize_t)f.size();
-    }
-    std::lock_guard<std::mutex> g(tx_mu[dst]);
-    wan_charge(plen);
-    return send_all(peer_fd[dst], f.data(), f.size());
-  }
-
   // Cumulative ack from a peer: everything below `upto` landed there —
-  // release the retained frames.
-  void handle_ack(uint32_t src, uint32_t upto) {
+  // release the retained frames of that (peer, lane) stream.
+  void handle_ack(uint32_t src, uint32_t lane, uint32_t upto) {
     stat_ack_rx++;
     std::lock_guard<std::mutex> g(rely_mu);
-    if (src >= retx.size()) return;
-    RetxBuf &rb = retx[src];
+    uint32_t s = sid(src, lane);
+    if (s >= retx.size()) return;
+    RetxBuf &rb = retx[s];
     while (!rb.q.empty() && (int32_t)(rb.q.front().seqn - upto) < 0) {
       rb.bytes -= rb.q.front().bytes->size();
       rb.q.pop_front();
@@ -1299,21 +1054,22 @@ struct accl_rt {
   }
 
   // Selective-retransmit request: queue the retained frame for the
-  // HEALTH thread to resend verbatim (never a blocking send on the rx
-  // thread that received the nack — see retx_pending). A seqn already
-  // evicted from the bounded buffer is unrecoverable at this layer
-  // (counted; the receiver's deadline owns it); a seqn the sender has
-  // not produced yet is a benign receiver probe (a parked recv
+  // HEALTH thread to resend verbatim (never a blocking data-frame send
+  // on the rx thread that received the nack — see retx_pending). A seqn
+  // already evicted from the bounded buffer is unrecoverable at this
+  // layer (counted; the receiver's deadline owns it); a seqn the sender
+  // has not produced yet is a benign receiver probe (a parked recv
   // nacking a head the sender is still computing) and is ignored.
-  void handle_nack(uint32_t src, uint32_t seqn) {
+  void handle_nack(uint32_t src, uint32_t lane, uint32_t seqn) {
     stat_nack_rx++;
     if (killed.load(std::memory_order_relaxed)) return;
-    std::shared_ptr<std::vector<uint8_t>> f;
+    FramePtr f;
     bool evicted = false;
     {
       std::lock_guard<std::mutex> g(rely_mu);
-      if (src >= retx.size()) return;
-      RetxBuf &rb = retx[src];
+      uint32_t s = sid(src, lane);
+      if (s >= retx.size()) return;
+      RetxBuf &rb = retx[s];
       for (auto &rf : rb.q)
         if (rf.seqn == seqn) {
           f = rf.bytes;
@@ -1325,11 +1081,11 @@ struct accl_rt {
         // dedup: a re-nack arriving before the pending resend went out
         // must not queue the same frame twice
         for (auto &p : retx_pending)
-          if (p.second == f) {
+          if (p == f) {
             f = nullptr;
             break;
           }
-        if (f) retx_pending.emplace_back(src, f);
+        if (f) retx_pending.push_back(f);
       }
     }
     if (evicted) {
@@ -1340,24 +1096,24 @@ struct accl_rt {
     }
   }
 
-  // Record that a consumer is provably waiting on (src, inbound head):
-  // the health thread turns a persistent want into bounded-backoff
-  // NACKs. `proven` (a CRC drop, or stray seqns queued behind the gap)
-  // nacks after ~1 ms; a bare miss may just be a not-yet-sent head (or
-  // a frame mid-flight behind a scheduler stall) and waits ~8 ms first
-  // — the sender ignores a nack for a seqn it has not produced, but a
-  // nack for one already in flight costs a spurious retransmit+dup,
-  // so the bare-miss delay is deliberately above ordinary host jitter.
-  // rx_mu held by the caller.
-  void note_want_locked(uint32_t src, bool proven = false) {
-    if (!rely_wire || src >= want.size()) return;
-    WantState &w = want[src];
-    uint32_t s = inbound_seq[src];
-    if (w.active && w.seqn == s) return;
+  // Record that a consumer is provably waiting on (stream sid, inbound
+  // head): the health thread turns a persistent want into
+  // bounded-backoff NACKs. `proven` (a CRC drop, or stray seqns queued
+  // behind the gap) nacks after ~1 ms; a bare miss may just be a
+  // not-yet-sent head (or a frame mid-flight behind a scheduler stall)
+  // and waits ~8 ms first — the sender ignores a nack for a seqn it
+  // has not produced, but a nack for one already in flight costs a
+  // spurious retransmit+dup, so the bare-miss delay is deliberately
+  // above ordinary host jitter. rx_mu held by the caller.
+  void note_want_locked(uint32_t s, bool proven = false) {
+    if (!rely_wire || s >= want.size()) return;
+    WantState &w = want[s];
+    uint32_t sq = inbound_seq[s];
+    if (w.active && w.seqn == sq) return;
     w.active = true;
-    w.seqn = s;
+    w.seqn = sq;
     w.attempts = 0;
-    bool fast = proven || src_valid_count[src] > 0;
+    bool fast = proven || src_valid_count[s] > 0;
     w.next_nack = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(fast ? 1 : 8);
   }
@@ -1378,15 +1134,15 @@ struct accl_rt {
       // frame_out, which is the cost the chaos gate budgets.
       auto t0 = std::chrono::steady_clock::now();
       struct Ctl {
-        uint32_t dst;
+        uint32_t s;  // stream sid — dst = s / n_lanes, lane = s % n_lanes
         MsgType mt;
         uint32_t seqn;
       };
       std::vector<Ctl> ctl;
       {
         std::lock_guard<std::mutex> g(rx_mu);
-        for (uint32_t s = 0; s < world; s++) {
-          if (s == rank) continue;
+        for (uint32_t s = 0; s < world * n_lanes; s++) {
+          if (s / n_lanes == rank) continue;
           WantState &w = want[s];
           if (w.active && inbound_seq[s] != w.seqn)
             w.active = false;  // repaired (or advanced past)
@@ -1419,34 +1175,60 @@ struct accl_rt {
       // no follower to swap with — the nack path would recover it, but
       // releasing here keeps the common case one round trip cheaper)
       // and drain the peers' queued retransmit requests
-      std::vector<std::pair<uint32_t,
-                            std::shared_ptr<std::vector<uint8_t>>>> rel;
+      std::vector<FramePtr> rel;
       {
         std::lock_guard<std::mutex> g(rely_mu);
         for (auto it = reorder_held.begin(); it != reorder_held.end();) {
           if (t0 - it->second.since >= std::chrono::milliseconds(2)) {
-            rel.emplace_back(it->first, it->second.bytes);
+            rel.push_back(it->second.bytes);
             it = reorder_held.erase(it);
           } else {
             ++it;
           }
         }
         while (!retx_pending.empty()) {
-          rel.emplace_back(retx_pending.front());
+          rel.push_back(retx_pending.front());
           retx_pending.pop_front();
           stat_retx_sent++;
         }
       }
       for (auto &c : ctl)
-        frame_out(c.dst, c.mt, 0, c.seqn, 0, 0, nullptr, 0);
-      for (auto &r : rel) wire_emit(r.first, *r.second);
+        frame_out(c.s / n_lanes, c.mt, 0, c.seqn, 0, 0, nullptr, 0,
+                  /*host=*/0, /*msg_bytes=*/0, /*msg_off=*/0,
+                  /*lane=*/c.s % n_lanes);
+      for (auto &r : rel) wire_emit(*r);
     }
+  }
+
+  // A sender-side frame batch to one (dst, lane): views accumulate and
+  // flush as ONE scatter-gather send_frames call — many small frames
+  // per writev/sendmmsg, the syscall-floor cut for the tiny-message
+  // regime. `keep` pins serialized rely frames until the flush ships
+  // them (retx-budget eviction must not free a frame the batch still
+  // references); non-rely views borrow the caller's payload, which
+  // egr_send keeps alive through its final flush.
+  struct TxBatch {
+    uint32_t dst = 0, lane = 0;
+    std::vector<FrameView> views;
+    std::vector<FramePtr> keep;
+    size_t bytes = 0;
+  };
+  static constexpr size_t TX_BATCH_FRAMES = 256;     // one writev's worth
+  static constexpr size_t TX_BATCH_BYTES = 4u << 20;
+  bool flush_batch(TxBatch &b) {
+    if (b.views.empty()) return true;
+    bool ok = poe_send(b.dst, b.lane, b.views.data(), b.views.size());
+    b.views.clear();
+    b.keep.clear();
+    b.bytes = 0;
+    return ok;
   }
 
   bool frame_out(uint32_t dst, MsgType mt, uint32_t tag, uint32_t seqn,
                  uint64_t bytes, uint64_t vaddr, const void *payload,
                  size_t payload_len, uint32_t host = 0,
-                 uint64_t msg_bytes = 0, uint64_t msg_off = 0) {
+                 uint64_t msg_bytes = 0, uint64_t msg_off = 0,
+                 uint32_t lane = 0, TxBatch *batch = nullptr) {
     // a wedged rank's wire is dark: outbound frames vanish before the
     // transport (bring-up hellos stay exempt so a pre-armed kill can
     // never wedge a PEER's creation barrier)
@@ -1460,7 +1242,7 @@ struct accl_rt {
     h.dst = dst;
     h.tag = tag;
     h.seqn = seqn;
-    h.host = host;
+    h.host = wire_pack_host(host, lane);
     h.bytes = bytes;
     h.vaddr = vaddr;
     h.msg_bytes = msg_bytes;
@@ -1476,16 +1258,16 @@ struct accl_rt {
     }
     if (mt == MSG_EGR_DATA) stat_tx_frames++;
     if (rely_wire && mt == MSG_EGR_DATA) {
-      // serialize once: the same bytes feed the retransmit buffer and
-      // the wire, so a NACK replays the frame verbatim
-      auto f = std::make_shared<std::vector<uint8_t>>(sizeof h +
-                                                      payload_len);
+      // serialize once: the same bytes feed the retransmit buffer, the
+      // TX batch, and the wire, so a NACK replays the frame verbatim —
+      // retention is BY REFERENCE, never a second payload copy
+      auto f = std::make_shared<FrameBuf>(sizeof h + payload_len);
       std::memcpy(f->data(), &h, sizeof h);
       if (payload_len)
         std::memcpy(f->data() + sizeof h, payload, payload_len);
       {
         std::lock_guard<std::mutex> g(rely_mu);
-        RetxBuf &rb = retx[dst];
+        RetxBuf &rb = retx[sid(dst, lane)];
         rb.q.push_back({seqn, f});
         rb.bytes += f->size();
         while (rb.bytes > retx_budget_bytes && rb.q.size() > 1) {
@@ -1493,7 +1275,16 @@ struct accl_rt {
           rb.q.pop_front();  // a nack for it will count retx_miss
         }
       }
-      std::shared_ptr<std::vector<uint8_t>> wire = f;
+      if (batch && tx_batch_on) {
+        batch->views.push_back(frame_view(*f));
+        batch->keep.push_back(f);
+        batch->bytes += f->size();
+        if (batch->views.size() >= TX_BATCH_FRAMES ||
+            batch->bytes >= TX_BATCH_BYTES)
+          return flush_batch(*batch);
+        return true;
+      }
+      FramePtr wire = f;
       bool dup = false, hold = false;
       if (fault_pct_armed) {
         if (rng_u01() * 100.0 < fault_loss_pct) {
@@ -1506,7 +1297,7 @@ struct accl_rt {
           // there are any; the crc field itself on header-only frames —
           // framing fields stay intact either way, so the stream
           // survives and only the integrity check can catch it.
-          auto bad = std::make_shared<std::vector<uint8_t>>(*f);
+          auto bad = std::make_shared<FrameBuf>(*f);
           size_t off = payload_len
                            ? sizeof h + (size_t)(rng_u01() * payload_len)
                            : offsetof(MsgHeader, crc);
@@ -1519,16 +1310,17 @@ struct accl_rt {
         hold = rng_u01() * 100.0 < fault_reorder_pct;
       }
       // REORDER: emit any previously-held frame AFTER this one (the
-      // swap), or hold this one for the next frame to the same dst
-      std::shared_ptr<std::vector<uint8_t>> released;
+      // swap), or hold this one for the next frame to the same
+      // (dst, lane) stream
+      FramePtr released;
       {
         std::lock_guard<std::mutex> g(rely_mu);
-        auto it = reorder_held.find(dst);
+        auto it = reorder_held.find(sid(dst, lane));
         if (it != reorder_held.end()) {
           released = it->second.bytes;
           reorder_held.erase(it);
         } else if (hold) {
-          reorder_held[dst] =
+          reorder_held[sid(dst, lane)] =
               HeldFrame{wire, std::chrono::steady_clock::now()};
           stat_inj_reorder++;
           wire = nullptr;
@@ -1536,45 +1328,32 @@ struct accl_rt {
       }
       bool ok = true;
       if (wire) {
-        ok = wire_emit(dst, *wire);
+        ok = wire_emit(*wire);
         if (ok && dup) {
           stat_inj_dup++;
-          ok = wire_emit(dst, *wire);
+          ok = wire_emit(*wire);
         }
       }
-      if (released && ok) ok = wire_emit(dst, *released);
+      if (released && ok) ok = wire_emit(*released);
       return ok;
     }
-    if (local_mode)
-      return local_send(dst, h, (const uint8_t *)payload, payload_len);
-    if (udp_mode) {
-      // sessionless: header + payload in one datagram (udp_packetizer
-      // analog — segment == packet). The WAN charge has no tx lock to
-      // ride here — the datagram POE has no per-link session to
-      // serialize on in the first place.
-      wan_charge(payload_len);
-      std::vector<uint8_t> pkt(sizeof h + payload_len);
-      std::memcpy(pkt.data(), &h, sizeof h);
-      if (payload_len) std::memcpy(pkt.data() + sizeof h, payload, payload_len);
-      ssize_t n = sendto(udp_fd, pkt.data(), pkt.size(), 0,
-                         (const sockaddr *)&peer_sa[dst], sizeof(sockaddr_in));
-      return n == (ssize_t)pkt.size();
+    if (batch && tx_batch_on && mt == MSG_EGR_DATA) {
+      FrameView v;
+      v.h = h;
+      v.payload = (const uint8_t *)payload;
+      v.payload_len = payload_len;
+      batch->views.push_back(v);
+      batch->bytes += sizeof h + payload_len;
+      if (batch->views.size() >= TX_BATCH_FRAMES ||
+          batch->bytes >= TX_BATCH_BYTES)
+        return flush_batch(*batch);
+      return true;
     }
-    std::lock_guard<std::mutex> g(tx_mu[dst]);
-    // emulated-WAN link charge inside tx_mu: frames to one peer
-    // serialize through their link like a real wire (see wan_alpha_us)
-    wan_charge(payload_len);
-    if (debug_on)
-      fprintf(stderr, "[r%u] tx mt=%u dst=%u fd=%d bytes=%llu\n", rank,
-              (unsigned)mt, dst, peer_fd[dst], (unsigned long long)bytes);
-    if (!send_all(peer_fd[dst], &h, sizeof h)) {
-      if (debug_on)
-        fprintf(stderr, "[r%u] TX FAIL hdr dst=%u\n", rank, dst);
-      return false;
-    }
-    if (payload_len && !send_all(peer_fd[dst], payload, payload_len))
-      return false;
-    return true;
+    FrameView v;
+    v.h = h;
+    v.payload = (const uint8_t *)payload;
+    v.payload_len = payload_len;
+    return poe_send(dst, lane, &v, 1);
   }
 
   // depacketizer -> rxbuf enqueue/dequeue: land a segment in an IDLE slot
@@ -1585,8 +1364,9 @@ struct accl_rt {
   // datagram loss surfacing as timeouts) and would starve bring-up
   // hello processing. The ring grows on demand up to a generous bound,
   // past which the blocking backpressure applies as a last resort.
-  bool land_eager(const MsgHeader &h, std::vector<uint8_t> payload,
-                  bool allow_grow = false) {
+  bool land_eager(const MsgHeader &h, uint32_t lane,
+                  std::vector<uint8_t> payload, bool allow_grow = false) {
+    uint32_t s = sid(h.src, lane);
     std::unique_lock<std::mutex> lk(rx_mu);
     size_t idx;
     if (!idle_q.empty()) {
@@ -1601,7 +1381,7 @@ struct accl_rt {
       idx = idle_q.back();
       idle_q.pop_back();
     }
-    if ((int32_t)(h.seqn - inbound_seq[h.src]) < 0) {
+    if ((int32_t)(h.seqn - inbound_seq[s]) < 0) {
       // seqn already consumed: a LATE duplicate (datagram dup, or a
       // retransmit that crossed its own repair). Landing it would
       // leave a VALID slot no seek ever requests (leaked slot,
@@ -1611,12 +1391,12 @@ struct accl_rt {
       stat_dup_drops++;
       if (debug_on)
         fprintf(stderr, "[r%u] land DROP late src=%u seqn=%u want=%u\n", rank,
-                h.src, h.seqn, inbound_seq[h.src]);
+                h.src, h.seqn, inbound_seq[s]);
       idle_q.push_back(idx);
       return true;
     }
-    if (!rx_index.emplace(rx_key(h.src, h.seqn), idx).second) {
-      // duplicate (src, seqn): idempotent drop (a datagram duplicate,
+    if (!rx_index.emplace(rx_key(s, h.seqn), idx).second) {
+      // duplicate (sid, seqn): idempotent drop (a datagram duplicate,
       // an injected dup, or a retransmit racing the original) — the
       // first arrival wins
       stat_dup_drops++;
@@ -1628,98 +1408,26 @@ struct accl_rt {
     slot.src = h.src;
     slot.tag = h.tag;
     slot.seqn = h.seqn;
+    slot.lane = lane;
     slot.msg_bytes = h.msg_bytes;
     slot.msg_off = h.msg_off;
     slot.t_land = std::chrono::steady_clock::now();
     slot.data = std::move(payload);
-    src_valid_count[h.src]++;
+    src_valid_count[s]++;
     rx_event();
     return true;
   }
 
-  // Sessionless datagram receive loop: ONE socket carries every peer;
-  // the header identifies the sender (the udp_depacketizer role —
-  // per-packet routing with no connection state).
-  void udp_rx_loop() {
-    std::vector<uint8_t> pkt(sizeof(MsgHeader) + 65536);
-    std::vector<uint8_t> payload;
-    while (!stop.load()) {
-      ssize_t n = recvfrom(udp_fd, pkt.data(), pkt.size(), 0, nullptr, nullptr);
-      if (n < (ssize_t)sizeof(MsgHeader)) {
-        if (stop.load()) return;
-        continue;  // runt/interrupted
-      }
-      MsgHeader h;
-      std::memcpy(&h, pkt.data(), sizeof h);
-      if (h.magic != MSG_MAGIC || h.src >= world) continue;
-      // pre-CRC, like every transport (acclrt.h rx_frames contract)
-      if (h.msg_type == MSG_EGR_DATA) stat_rx_frames++;
-      if (rely_wire) {
-        size_t pl = h.msg_type == MSG_EGR_DATA ? (size_t)h.bytes : 0;
-        if ((ssize_t)(sizeof h + pl) > n) continue;  // truncated
-        auto t0 = std::chrono::steady_clock::now();
-        bool okc = h.crc == frame_crc(h, pkt.data() + sizeof h, pl);
-        stat_rely_ns += (uint64_t)std::chrono::duration_cast<
-            std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
-                                      t0)
-            .count();
-        if (!okc) {
-          stat_crc_drops++;  // dropped, never landed
-          if (h.msg_type == MSG_EGR_DATA &&
-              !killed.load(std::memory_order_relaxed)) {
-            std::lock_guard<std::mutex> g(rx_mu);
-            note_want_locked(h.src, /*proven=*/true);
-          }
-          continue;
-        }
-      }
-      switch (h.msg_type) {
-        case MSG_HELLO:
-          frame_out(h.src, MSG_HELLO_ACK, 0, 0, 0, 0, nullptr, 0);
-          [[fallthrough]];
-        case MSG_HELLO_ACK: {
-          std::lock_guard<std::mutex> g(hello_mu);
-          hello_seen[h.src] = true;
-          hello_cv.notify_all();
-          break;
-        }
-        case MSG_ACK:
-          if (!killed.load(std::memory_order_relaxed))
-            handle_ack(h.src, h.seqn);
-          break;
-        case MSG_NACK:
-          if (!killed.load(std::memory_order_relaxed))
-            handle_nack(h.src, h.seqn);
-          break;
-        case MSG_EGR_DATA: {
-          size_t plen = (size_t)h.bytes;
-          if ((ssize_t)(sizeof h + plen) != n) continue;  // truncated
-          if (killed.load(std::memory_order_relaxed)) break;  // dead host
-          payload.assign(pkt.data() + sizeof h, pkt.data() + sizeof h + plen);
-          if (!land_eager(h, std::move(payload), /*allow_grow=*/true))
-            return;
-          break;
-        }
-        default:
-          // rendezvous needs one-sided writes: not offered on the lossy
-          // sessionless POE (reference: RDMA-only message types)
-          if (debug_on)
-            fprintf(stderr, "[r%u] drop mt=%u on datagram transport\n", rank,
-                    h.msg_type);
-          break;
-      }
-    }
-  }
-
   // Poll-bounded pinned read shared by BOTH zero-copy landing paths
   // (eager landings and rendezvous one-sided writes): read `plen` bytes
-  // from fd into `dest`, consulting `still_pinned()` between 100 ms
-  // slices — when it reports the pin is gone (revocation), the
-  // remainder diverts to scratch (the byte stream must stay framed) and
-  // `ack_divert()` runs exactly once to release the buffer and wake the
-  // bounded-waiting revoker. Returns false on link death / stop;
-  // `*diverted_out` reports whether the payload was consumed-to-void.
-  bool pinned_read(int fd, uint8_t *dest, size_t plen,
+  // from the stream body into `dest`, consulting `still_pinned()`
+  // between 100 ms slices — when it reports the pin is gone
+  // (revocation), the remainder diverts to scratch (the byte stream
+  // must stay framed) and `ack_divert()` runs exactly once to release
+  // the buffer and wake the bounded-waiting revoker. Returns false on
+  // link death / stop; `*diverted_out` reports whether the payload was
+  // consumed-to-void.
+  bool pinned_read(PayloadSource &body, uint8_t *dest, size_t plen,
                    const std::function<bool()> &still_pinned,
                    const std::function<void()> &ack_divert,
                    bool *diverted_out) {
@@ -1727,8 +1435,7 @@ struct accl_rt {
     bool diverted = false;
     size_t off = 0;
     while (off < plen && !stop.load()) {
-      struct pollfd pf{fd, POLLIN, 0};
-      int pr = poll(&pf, 1, 100);
+      int pr = body.poll_in(100);
       if (!diverted && !still_pinned()) {
         scratch.resize(plen);
         diverted = true;
@@ -1736,7 +1443,7 @@ struct accl_rt {
       }
       if (pr <= 0) continue;
       uint8_t *tgt = diverted ? scratch.data() : dest;
-      ssize_t r = ::recv(fd, tgt + off, plen - off, 0);
+      ssize_t r = body.read_avail(tgt + off, plen - off);
       if (r <= 0) {
         *diverted_out = diverted;
         return false;
@@ -1747,304 +1454,288 @@ struct accl_rt {
     return off >= plen;
   }
 
-  void rx_loop(uint32_t peer) {
-    std::vector<uint8_t> payload;
-    while (!stop.load()) {
-      MsgHeader h;
-      if (!recv_all(peer_fd[peer], &h, sizeof h)) {
-        if (debug_on && !stop.load())
-          fprintf(stderr, "[r%u] RX LINK DOWN peer=%u\n", rank, peer);
-        return;
+  // One inbound frame from an ordered stream-POE link. The transport
+  // already validated magic, src (the link's peer), and lane (the
+  // link's lane); payload bytes are still ON THE WIRE behind `body`, so
+  // the zero-copy landings read them straight into their destination.
+  // Returning false drops the link (the transport's rx loop exits).
+  bool on_frame_stream(uint32_t lane, const MsgHeader &h,
+                       PayloadSource &body) {
+    thread_local std::vector<uint8_t> payload;
+    uint32_t s = sid(h.src, lane);
+    // reliability control frames: header-only, verified and handled
+    // inline (they never enter the seqn stream or the rx ring)
+    if (h.msg_type == MSG_ACK || h.msg_type == MSG_NACK) {
+      if (rely_wire && h.crc != frame_crc(h, nullptr, 0)) {
+        stat_crc_drops++;
+        return true;  // acks are cumulative, nacks retried: both survive
       }
-      if (h.magic != MSG_MAGIC) {
-        if (debug_on)
-          fprintf(stderr, "[r%u] RX BAD MAGIC peer=%u\n", rank, peer);
-        return;
+      if (killed.load(std::memory_order_relaxed)) return true;
+      if (h.msg_type == MSG_ACK)
+        handle_ack(h.src, lane, h.seqn);
+      else
+        handle_nack(h.src, lane, h.seqn);
+      return true;
+    }
+    if (h.msg_type == MSG_EGR_DATA) stat_rx_frames++;
+    size_t plen = body.remaining();
+    if (killed.load(std::memory_order_relaxed)) {
+      // wedged rank: payload bytes are read off the link (the peer's
+      // tx framing must not block on a dead consumer) and discarded —
+      // nothing lands, nothing completes
+      payload.resize(plen);
+      if (plen && !body.read_exact(payload.data(), plen)) return false;
+      return true;
+    }
+    // Direct placement: a registered landing whose message this
+    // segment continues takes the payload straight off the wire
+    // into the final buffer — no slot, no staging copy. Eligible only
+    // when this segment is the next seqn with nothing queued before
+    // it (the ordered link makes that exact). `in_use` pins the
+    // destination across the unlocked read; revocation waits on it.
+    if (h.msg_type == MSG_EGR_DATA && plen) {
+      uint8_t *dest = nullptr;
+      std::unique_lock<std::mutex> lk(rx_mu);
+      auto lnd = eager_landings.find(s);
+      if (lnd != eager_landings.end() && !lnd->second.in_use &&
+          !lnd->second.abort &&
+          h.seqn == inbound_seq[s] && src_valid_count[s] == 0 &&
+          !rx_drain_srcs.count(s) &&
+          (lnd->second.tag == TAG_ANY || h.tag == TAG_ANY ||
+           lnd->second.tag == h.tag) &&
+          h.msg_bytes == lnd->second.want &&
+          h.msg_off == lnd->second.landed &&
+          h.bytes <= lnd->second.want - lnd->second.landed) {
+        lnd->second.in_use = true;
+        dest = lnd->second.base + lnd->second.landed;
       }
-      // this is PEER's session socket: a frame claiming any other src is
-      // forged or corrupt — drop the link before any src-indexed state
-      // (inbound_seq, src_valid_count, landings) is touched
-      if (h.src != peer) {
-        if (debug_on)
-          fprintf(stderr, "[r%u] RX BAD SRC %u on link peer=%u\n", rank,
-                  h.src, peer);
-        return;
-      }
-      if (debug_on)
-        fprintf(stderr, "[r%u] rx mt=%u from=%u\n", rank, h.msg_type, h.src);
-      // reliability control frames: header-only, verified and handled
-      // inline (they never enter the seqn stream or the rx ring)
-      if (h.msg_type == MSG_ACK || h.msg_type == MSG_NACK) {
-        if (rely_wire && h.crc != frame_crc(h, nullptr, 0)) {
+      if (dest) {
+        lk.unlock();
+        bool diverted = false;
+        bool ok = pinned_read(
+            body, dest, plen,
+            [&] {
+              std::lock_guard<std::mutex> g(rx_mu);
+              auto it2 = eager_landings.find(s);
+              return it2 != eager_landings.end() && !it2->second.abort;
+            },
+            [&] {
+              std::lock_guard<std::mutex> g(rx_mu);
+              auto it2 = eager_landings.find(s);
+              if (it2 != eager_landings.end()) it2->second.in_use = false;
+              rx_cv.notify_all();
+            },
+            &diverted);
+        // integrity check BEFORE the landing is published: the frame
+        // was read straight into the consumer's buffer (in_use still
+        // pins it), so a corrupt frame must not advance `landed` or
+        // the inbound seqn — the bytes sit unobservable until the
+        // retransmitted clean frame overwrites them, and the recv can
+        // only ever complete with verified data ("never landed").
+        bool crc_ok = true;
+        if (ok && !diverted && rely_wire) {
+          auto t0 = std::chrono::steady_clock::now();
+          crc_ok = h.crc == frame_crc(h, dest, plen);
+          stat_rely_ns += (uint64_t)std::chrono::duration_cast<
+              std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
+                                        t0)
+              .count();
+        }
+        lk.lock();
+        lnd = eager_landings.find(s);  // may have been erased
+        if (!diverted && lnd != eager_landings.end())
+          lnd->second.in_use = false;
+        if (!ok || stop.load()) {
+          rx_cv.notify_all();
+          return false;
+        }
+        if (!crc_ok) {
           stat_crc_drops++;
-          continue;  // acks are cumulative, nacks retried: both survive
+          note_want_locked(s, /*proven=*/true);
+          rx_cv.notify_all();
+          return true;
         }
-        if (killed.load(std::memory_order_relaxed)) continue;
-        if (h.msg_type == MSG_ACK)
-          handle_ack(h.src, h.seqn);
-        else
-          handle_nack(h.src, h.seqn);
-        continue;
-      }
-      if (h.msg_type == MSG_EGR_DATA) stat_rx_frames++;
-      size_t plen = 0;
-      if (h.msg_type == MSG_EGR_DATA || h.msg_type == MSG_RNDZV_WRITE)
-        plen = (size_t)h.bytes;
-      if (killed.load(std::memory_order_relaxed)) {
-        // wedged rank: payload bytes are read off the socket (the
-        // peer's tx framing must not block on a dead consumer) and
-        // discarded — nothing lands, nothing completes
-        payload.resize(plen);
-        if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
-        continue;
-      }
-      // Direct placement: a registered landing whose message this
-      // segment continues takes the payload straight off the socket
-      // into the final buffer — no slot, no staging copy. Eligible only
-      // when this segment is the next seqn with nothing queued before
-      // it (the ordered link makes that exact). `in_use` pins the
-      // destination across the unlocked read; revocation waits on it.
-      if (h.msg_type == MSG_EGR_DATA && plen) {
-        uint8_t *dest = nullptr;
-        std::unique_lock<std::mutex> lk(rx_mu);
-        auto lnd = eager_landings.find(h.src);
-        if (lnd != eager_landings.end() && !lnd->second.in_use &&
-            !lnd->second.abort &&
-            h.seqn == inbound_seq[h.src] && src_valid_count[h.src] == 0 &&
-            !rx_drain_srcs.count(h.src) &&
-            (lnd->second.tag == TAG_ANY || h.tag == TAG_ANY ||
-             lnd->second.tag == h.tag) &&
-            h.msg_bytes == lnd->second.want &&
-            h.msg_off == lnd->second.landed &&
-            h.bytes <= lnd->second.want - lnd->second.landed) {
-          lnd->second.in_use = true;
-          dest = lnd->second.base + lnd->second.landed;
+        if (!diverted && lnd != eager_landings.end()) {
+          lnd->second.landed += plen;
+        } else if (diverted && h.msg_off + plen < h.msg_bytes) {
+          // consumed-to-void mid-message: the rest of the dying
+          // message is orphan tail whatever the revoker saw (it may
+          // have observed landed == 0 and skipped arming)
+          rx_drain_srcs.insert(s);
         }
-        if (dest) {
-          lk.unlock();
-          bool diverted = false;
-          bool ok = pinned_read(
-              peer_fd[peer], dest, plen,
-              [&] {
-                std::lock_guard<std::mutex> g(rx_mu);
-                auto it2 = eager_landings.find(h.src);
-                return it2 != eager_landings.end() && !it2->second.abort;
-              },
-              [&] {
-                std::lock_guard<std::mutex> g(rx_mu);
-                auto it2 = eager_landings.find(h.src);
-                if (it2 != eager_landings.end()) it2->second.in_use = false;
-                rx_cv.notify_all();
-              },
-              &diverted);
-          // integrity check BEFORE the landing is published: the frame
-          // was read straight into the consumer's buffer (in_use still
-          // pins it), so a corrupt frame must not advance `landed` or
-          // the inbound seqn — the bytes sit unobservable until the
-          // retransmitted clean frame overwrites them, and the recv can
-          // only ever complete with verified data ("never landed").
-          bool crc_ok = true;
-          if (ok && !diverted && rely_wire) {
-            auto t0 = std::chrono::steady_clock::now();
-            crc_ok = h.crc == frame_crc(h, dest, plen);
-            stat_rely_ns += (uint64_t)std::chrono::duration_cast<
-                std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
-                                          t0)
-                .count();
+        inbound_seq[s] = h.seqn + 1;
+        rx_event();
+        return true;
+      }
+    }
+    // One-sided writes land DIRECTLY at the posted vaddr — the
+    // zero-copy semantics the rendezvous protocol promises (the old
+    // path staged through `payload` then memcpy'd). Same poll-bounded
+    // pin/abort protocol as the eager landings: in_use pins the
+    // target, revocation flips abort and the read diverts to scratch
+    // within one 100 ms slice, so a timed-out caller's buffer is
+    // never written after revocation returns.
+    if (h.msg_type == MSG_RNDZV_WRITE && plen) {
+      uint8_t *dest = nullptr;
+      {
+        std::lock_guard<std::mutex> g(rndzv_mu);
+        for (auto &pa : posted_addrs) {
+          if (pa.vaddr == h.vaddr && pa.src == h.src &&
+              pa.bytes == h.bytes && !pa.in_use && !pa.abort) {
+            pa.in_use = true;
+            dest = (uint8_t *)(uintptr_t)h.vaddr;
+            break;
           }
-          lk.lock();
-          lnd = eager_landings.find(h.src);  // may have been erased
-          if (!diverted && lnd != eager_landings.end())
-            lnd->second.in_use = false;
-          if (!ok || stop.load()) {
-            rx_cv.notify_all();
-            return;
-          }
-          if (!crc_ok) {
-            stat_crc_drops++;
-            note_want_locked(h.src, /*proven=*/true);
-            rx_cv.notify_all();
-            continue;
-          }
-          if (!diverted && lnd != eager_landings.end()) {
-            lnd->second.landed += plen;
-          } else if (diverted && h.msg_off + plen < h.msg_bytes) {
-            // consumed-to-void mid-message: the rest of the dying
-            // message is orphan tail whatever the revoker saw (it may
-            // have observed landed == 0 and skipped arming)
-            rx_drain_srcs.insert(h.src);
-          }
-          inbound_seq[h.src] = h.seqn + 1;
-          rx_event();
-          continue;
         }
       }
-      // One-sided writes land DIRECTLY at the posted vaddr — the
-      // zero-copy semantics the rendezvous protocol promises (the old
-      // path staged through `payload` then memcpy'd). Same poll-bounded
-      // pin/abort protocol as the eager landings: in_use pins the
-      // target, revocation flips abort and the read diverts to scratch
-      // within one 100 ms slice, so a timed-out caller's buffer is
-      // never written after revocation returns.
-      if (h.msg_type == MSG_RNDZV_WRITE && plen) {
-        uint8_t *dest = nullptr;
+      if (dest) {
+        auto find_mine = [&]() -> RndzvAddr * {
+          for (auto &pa : posted_addrs)
+            if (pa.vaddr == h.vaddr && pa.src == h.src &&
+                pa.bytes == h.bytes && pa.in_use)
+              return &pa;
+          return nullptr;
+        };
+        bool diverted = false;
+        bool ok = pinned_read(
+            body, dest, plen,
+            [&] {
+              std::lock_guard<std::mutex> g(rndzv_mu);
+              RndzvAddr *pa = find_mine();
+              return pa != nullptr && !pa->abort;
+            },
+            [&] {
+              std::lock_guard<std::mutex> g(rndzv_mu);
+              RndzvAddr *pa = find_mine();
+              if (pa) pa->in_use = false;
+              rndzv_cv.notify_all();
+            },
+            &diverted);
+        // integrity check before the completion is published: a
+        // corrupt one-sided write must not complete the recv (the
+        // posting stays live, so a clean re-post/retry can still
+        // land; rendezvous rides the session transport, so this is
+        // the wire-corruption backstop, not a retransmit seam)
+        bool crc_ok = true;
+        if (ok && !diverted && rely_wire) {
+          auto t0 = std::chrono::steady_clock::now();
+          crc_ok = h.crc == frame_crc(h, dest, plen);
+          stat_rely_ns += (uint64_t)std::chrono::duration_cast<
+              std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
+                                        t0)
+              .count();
+          if (!crc_ok) stat_crc_drops++;
+        }
         {
           std::lock_guard<std::mutex> g(rndzv_mu);
-          for (auto &pa : posted_addrs) {
-            if (pa.vaddr == h.vaddr && pa.src == h.src &&
-                pa.bytes == h.bytes && !pa.in_use && !pa.abort) {
-              pa.in_use = true;
-              dest = (uint8_t *)(uintptr_t)h.vaddr;
-              break;
-            }
-          }
-        }
-        if (dest) {
-          auto find_mine = [&]() -> RndzvAddr * {
-            for (auto &pa : posted_addrs)
-              if (pa.vaddr == h.vaddr && pa.src == h.src &&
-                  pa.bytes == h.bytes && pa.in_use)
-                return &pa;
-            return nullptr;
-          };
-          bool diverted = false;
-          bool ok = pinned_read(
-              peer_fd[peer], dest, plen,
-              [&] {
-                std::lock_guard<std::mutex> g(rndzv_mu);
-                RndzvAddr *pa = find_mine();
-                return pa != nullptr && !pa->abort;
-              },
-              [&] {
-                std::lock_guard<std::mutex> g(rndzv_mu);
-                RndzvAddr *pa = find_mine();
-                if (pa) pa->in_use = false;
-                rndzv_cv.notify_all();
-              },
-              &diverted);
-          // integrity check before the completion is published: a
-          // corrupt one-sided write must not complete the recv (the
-          // posting stays live, so a clean re-post/retry can still
-          // land; rendezvous rides the session transport, so this is
-          // the wire-corruption backstop, not a retransmit seam)
-          bool crc_ok = true;
-          if (ok && !diverted && rely_wire) {
-            auto t0 = std::chrono::steady_clock::now();
-            crc_ok = h.crc == frame_crc(h, dest, plen);
-            stat_rely_ns += (uint64_t)std::chrono::duration_cast<
-                std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
-                                          t0)
-                .count();
-            if (!crc_ok) stat_crc_drops++;
-          }
-          {
-            std::lock_guard<std::mutex> g(rndzv_mu);
-            RndzvAddr *pa = find_mine();
-            if (pa) pa->in_use = false;
-            if (!ok || stop.load()) {
-              rndzv_cv.notify_all();
-            } else if (!diverted && crc_ok && pa) {
-              // completed write: consume the posting, publish completion
-              for (auto it = posted_addrs.begin(); it != posted_addrs.end();
-                   ++it) {
-                if (&*it == pa) {
-                  posted_addrs.erase(it);
-                  break;
-                }
-              }
-              done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
-              rndzv_cv.notify_all();
-            }
-            // diverted: revoked mid-write — consumed-to-void, no
-            // completion (the reference's late-write drop semantics)
-          }
-          if (!ok || stop.load()) return;
-          rx_event();
-          continue;
-        }
-      }
-      payload.resize(plen);
-      if (plen && !recv_all(peer_fd[peer], payload.data(), plen)) return;
-      if (rely_wire) {
-        auto t0 = std::chrono::steady_clock::now();
-        bool okc = h.crc == frame_crc(h, payload.data(), plen);
-        stat_rely_ns += (uint64_t)std::chrono::duration_cast<
-            std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
-                                      t0)
-            .count();
-        if (!okc) {
-          // counted and dropped, never landed; an eager gap arms the
-          // nack repair path
-          stat_crc_drops++;
-          if (h.msg_type == MSG_EGR_DATA) {
-            std::lock_guard<std::mutex> g(rx_mu);
-            note_want_locked(h.src, /*proven=*/true);
-          }
-          continue;
-        }
-      }
-      switch (h.msg_type) {
-        case MSG_EGR_DATA: {
-          // allow_grow on the session transport too: the ring collectives
-          // stream whole chunks as multi-segment messages, and a blocked
-          // rx thread (ring full, sequencer mid-send) would stall the
-          // socket into a ring-wide write deadlock. Growth is burst
-          // absorption — the ring compacts once drained.
-          if (!land_eager(h, std::move(payload), /*allow_grow=*/true)) return;
-          break;
-        }
-        case MSG_RNDZV_ADDR: {
-          {
-            std::lock_guard<std::mutex> g(rndzv_mu);
-            addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag, h.host});
+          RndzvAddr *pa = find_mine();
+          if (pa) pa->in_use = false;
+          if (!ok || stop.load()) {
             rndzv_cv.notify_all();
-          }
-          rx_event();  // wake a parked sequencer waiting on the address
-          break;
-        }
-        case MSG_RNDZV_WRITE: {
-          // one-sided write: valid ONLY into an address this rank posted
-          // to exactly this peer with exactly this size — otherwise any
-          // connected peer would hold an arbitrary-write primitive into
-          // the process. Unposted writes are dropped (and logged).
-          // validate + land + complete in ONE critical section: a
-          // completion timeout cannot slip between the posted-check and
-          // the memcpy and free the target buffer underneath the write
-          bool posted = false;
-          {
-            std::lock_guard<std::mutex> g(rndzv_mu);
+          } else if (!diverted && crc_ok && pa) {
+            // completed write: consume the posting, publish completion
             for (auto it = posted_addrs.begin(); it != posted_addrs.end();
                  ++it) {
-              if (it->vaddr == h.vaddr && it->src == h.src &&
-                  it->bytes == h.bytes) {
+              if (&*it == pa) {
                 posted_addrs.erase(it);
-                posted = true;
                 break;
               }
             }
-            if (posted) {
-              std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
-              done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
-              rndzv_cv.notify_all();
-            }
+            done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+            rndzv_cv.notify_all();
           }
-          if (posted) rx_event();  // wake a parked completion poll
-          if (!posted) {
-            // counted (stats2 rndzv_drops), printed only under
-            // ACCL_RT_DEBUG: wire-drop logging must never spam stderr
-            // on a revocation-heavy or chaos run
-            stat_rndzv_drops++;
-            if (debug_on)
-              fprintf(stderr,
-                      "[r%u] DROP unposted RNDZV_WRITE from r%u vaddr=%llx "
-                      "bytes=%llu\n",
-                      rank, h.src, (unsigned long long)h.vaddr,
-                      (unsigned long long)h.bytes);
-          }
-          break;
+          // diverted: revoked mid-write — consumed-to-void, no
+          // completion (the reference's late-write drop semantics)
         }
+        if (!ok || stop.load()) return false;
+        rx_event();
+        return true;
       }
     }
+    payload.resize(plen);
+    if (plen && !body.read_exact(payload.data(), plen)) return false;
+    if (rely_wire) {
+      auto t0 = std::chrono::steady_clock::now();
+      bool okc = h.crc == frame_crc(h, payload.data(), plen);
+      stat_rely_ns += (uint64_t)std::chrono::duration_cast<
+          std::chrono::nanoseconds>(std::chrono::steady_clock::now() -
+                                    t0)
+          .count();
+      if (!okc) {
+        // counted and dropped, never landed; an eager gap arms the
+        // nack repair path
+        stat_crc_drops++;
+        if (h.msg_type == MSG_EGR_DATA) {
+          std::lock_guard<std::mutex> g(rx_mu);
+          note_want_locked(s, /*proven=*/true);
+        }
+        return true;
+      }
+    }
+    switch (h.msg_type) {
+      case MSG_EGR_DATA: {
+        // allow_grow on the session transport too: the ring collectives
+        // stream whole chunks as multi-segment messages, and a blocked
+        // rx thread (ring full, sequencer mid-send) would stall the
+        // socket into a ring-wide write deadlock. Growth is burst
+        // absorption — the ring compacts once drained.
+        if (!land_eager(h, lane, std::move(payload), /*allow_grow=*/true))
+          return false;
+        break;
+      }
+      case MSG_RNDZV_ADDR: {
+        {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          addr_q.push_back({h.src, h.vaddr, h.bytes, h.tag,
+                            wire_host(h.host)});
+          rndzv_cv.notify_all();
+        }
+        rx_event();  // wake a parked sequencer waiting on the address
+        break;
+      }
+      case MSG_RNDZV_WRITE: {
+        // one-sided write: valid ONLY into an address this rank posted
+        // to exactly this peer with exactly this size — otherwise any
+        // connected peer would hold an arbitrary-write primitive into
+        // the process. Unposted writes are dropped (and logged).
+        // validate + land + complete in ONE critical section: a
+        // completion timeout cannot slip between the posted-check and
+        // the memcpy and free the target buffer underneath the write
+        bool posted = false;
+        {
+          std::lock_guard<std::mutex> g(rndzv_mu);
+          for (auto it = posted_addrs.begin(); it != posted_addrs.end();
+               ++it) {
+            if (it->vaddr == h.vaddr && it->src == h.src &&
+                it->bytes == h.bytes) {
+              posted_addrs.erase(it);
+              posted = true;
+              break;
+            }
+          }
+          if (posted) {
+            std::memcpy((void *)(uintptr_t)h.vaddr, payload.data(), plen);
+            done_q.push_back({h.src, h.vaddr, h.bytes, h.tag});
+            rndzv_cv.notify_all();
+          }
+        }
+        if (posted) rx_event();  // wake a parked completion poll
+        if (!posted) {
+          // counted (stats2 rndzv_drops), printed only under
+          // ACCL_RT_DEBUG: wire-drop logging must never spam stderr
+          // on a revocation-heavy or chaos run
+          stat_rndzv_drops++;
+          if (debug_on)
+            fprintf(stderr,
+                    "[r%u] DROP unposted RNDZV_WRITE from r%u vaddr=%llx "
+                    "bytes=%llu\n",
+                    rank, h.src, (unsigned long long)h.vaddr,
+                    (unsigned long long)h.bytes);
+        }
+        break;
+      }
+    }
+    return true;
   }
 
   // ----- eager protocol (send .c:611-648 / recv .c:687-704) -----
@@ -2077,18 +1768,28 @@ struct accl_rt {
     }
     uint64_t seg_max = seg_bytes ? seg_bytes : rx_buf_bytes;
     if (udp_mode) seg_max = std::min<uint64_t>(seg_max, rx_buf_bytes);
+    // lane selection is per MESSAGE (every segment rides the same seqn
+    // stream): bulk messages take the bulk lane so a jumbo in flight
+    // cannot head-of-line-block a small message on lane 0
+    uint32_t lane = lane_of(bytes);
     // one-shot fault arming: this message's final segment is delayed or
     // lost (see the fault-injection block above)
     bool fault_this = false;
     if ((fault_delay_tail_ms > 0 || fault_drop_tail) && bytes > seg_max &&
         !fault_armed.exchange(true))
       fault_this = true;
+    TxBatch batch;
+    batch.dst = dst;
+    batch.lane = lane;
     uint64_t off = 0;
     while (off < bytes || bytes == 0) {
       uint64_t seg = std::min<uint64_t>(seg_max, bytes - off);
-      uint32_t seqn = outbound_seq[dst]++;
+      uint32_t seqn = outbound_seq[sid(dst, lane)]++;
       bool last = (off + seg >= bytes);
       if (fault_this && last) {
+        // tail levers run with batching off (tx_batch_on), but never
+        // leave queued frames stranded behind the delayed/dropped tail
+        if (!flush_batch(batch)) return RECEIVE_TIMEOUT_ERROR;
         if (fault_drop_tail) return NO_ERROR;  // lost on the wire
         // slow tail: deliver from a helper thread after the delay (the
         // caller must not send MORE traffic to dst before it lands, or
@@ -2098,6 +1799,7 @@ struct accl_rt {
         fault_tail_pending.store(true, std::memory_order_release);
         std::lock_guard<std::mutex> g(fault_mu);
         fault_threads.emplace_back([this, dst, tag, seqn, seg, bytes, off,
+                                    lane,
                                     payload = std::move(payload)] {
           for (int waited = 0; waited < fault_delay_tail_ms && !stop.load();
                waited += 10)
@@ -2113,29 +1815,33 @@ struct accl_rt {
             // fault_tail_pending release/acquire pair: any egr_send
             // that could advance the counter observes pending==true
             // first and aborts, so a racing write cannot exist.
-            if (outbound_seq[dst] != seqn + 1) {
+            if (outbound_seq[sid(dst, lane)] != seqn + 1) {
               fprintf(stderr,
                       "[r%u] FATAL: ACCL_RT_FAULT_DELAY_TAIL_MS wire-order "
                       "violation at delivery: outbound_seq[r%u]=%u advanced "
                       "past the delayed tail seqn=%u before the helper "
                       "thread delivered it\n",
-                      rank, dst, outbound_seq[dst], seqn);
+                      rank, dst, outbound_seq[sid(dst, lane)], seqn);
               abort();
             }
             frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, payload.data(),
                       seg, /*host=*/0, /*msg_bytes=*/bytes,
-                      /*msg_off=*/off);
+                      /*msg_off=*/off, lane);
           }
           fault_tail_pending.store(false, std::memory_order_release);
         });
         return NO_ERROR;
       }
       if (!frame_out(dst, MSG_EGR_DATA, tag, seqn, seg, 0, ptr + off, seg,
-                     /*host=*/0, /*msg_bytes=*/bytes, /*msg_off=*/off))
+                     /*host=*/0, /*msg_bytes=*/bytes, /*msg_off=*/off, lane,
+                     &batch))
         return RECEIVE_TIMEOUT_ERROR;
       off += seg;
       if (bytes == 0) break;  // zero-length notification (barrier)
     }
+    // the caller's `ptr` (borrowed by non-rely batched views) stays
+    // alive across this flush — the batch never outlives the call
+    if (!flush_batch(batch)) return RECEIVE_TIMEOUT_ERROR;
     return NO_ERROR;
   }
 
@@ -2157,22 +1863,24 @@ struct accl_rt {
   //    match) -> DMA_TAG_MISMATCH_ERROR. The non-strict SC_RECV retry
   //    path keeps NOT_READY there, because another parked recv with the
   //    matching tag may legally consume the head first.
-  uint32_t seek_locked(uint32_t src, uint32_t tag, uint8_t *ptr, uint64_t cap,
-                       uint64_t *got, bool strict_tag = false,
-                       bool msg_start = false, uint64_t want_msg = 0) {
-    drain_orphans_locked(src);
-    uint32_t want_seqn = inbound_seq[src];
-    auto it = rx_index.find(rx_key(src, want_seqn));
+  uint32_t seek_locked(uint32_t src, uint32_t lane, uint32_t tag,
+                       uint8_t *ptr, uint64_t cap, uint64_t *got,
+                       bool strict_tag = false, bool msg_start = false,
+                       uint64_t want_msg = 0) {
+    uint32_t s_id = sid(src, lane);
+    drain_orphans_locked(s_id);
+    uint32_t want_seqn = inbound_seq[s_id];
+    auto it = rx_index.find(rx_key(s_id, want_seqn));
     if (it == rx_index.end()) {
       // stray seqns with a missing head: on the bare ordered link this
       // can never legally occur (PACK_SEQ_NUMBER_ERROR); with the
       // reliability sublayer on it is exactly what a lost/corrupt/
       // reordered frame looks like MID-REPAIR — defer and let the nack
       // path fill the gap (note_want_locked arms it).
-      if (src_valid_count[src] > 0 && !udp_mode && !rely_wire)
+      if (src_valid_count[s_id] > 0 && !udp_mode && !rely_wire)
         return PACK_SEQ_NUMBER_ERROR;  // stray seqn on an ordered link
       stat_seek_miss++;
-      note_want_locked(src);
+      note_want_locked(s_id);
       return NOT_READY;
     }
     stat_seek_hit++;
@@ -2238,8 +1946,8 @@ struct accl_rt {
       std::memcpy(ptr, s.data.data(), s.data.size());
     release_slot_locked(i);
     rx_index.erase(it);
-    src_valid_count[src]--;
-    inbound_seq[src] = want_seqn + 1;
+    src_valid_count[s_id]--;
+    inbound_seq[s_id] = want_seqn + 1;
     rx_cv.notify_all();
     return NO_ERROR;
   }
@@ -2250,19 +1958,19 @@ struct accl_rt {
   // head (msg_off == 0) surfaces, then resume normal matching. Runs at
   // the top of seek AND before the SC_RECV elder-pairing check, so FIFO
   // eligibility is always judged against the true next message head.
-  void drain_orphans_locked(uint32_t src) {
-    while (rx_drain_srcs.count(src)) {
-      auto dit = rx_index.find(rx_key(src, inbound_seq[src]));
+  void drain_orphans_locked(uint32_t s_id) {
+    while (rx_drain_srcs.count(s_id)) {
+      auto dit = rx_index.find(rx_key(s_id, inbound_seq[s_id]));
       if (dit == rx_index.end()) return;  // tail not yet arrived: stay armed
       RxSlot &ds = rx_slots[dit->second];
       if (ds.msg_off == 0) {
-        rx_drain_srcs.erase(src);  // a fresh head: drain complete
+        rx_drain_srcs.erase(s_id);  // a fresh head: drain complete
         return;
       }
       release_slot_locked(dit->second);
       rx_index.erase(dit);
-      src_valid_count[src]--;
-      inbound_seq[src]++;
+      src_valid_count[s_id]--;
+      inbound_seq[s_id]++;
     }
   }
 
@@ -2288,11 +1996,11 @@ struct accl_rt {
       for (size_t i = 0; i < rx_slots.size(); i++) {
         RxSlot &s = rx_slots[i];
         if (s.status != RxSlot::VALID) continue;
-        uint32_t src = s.src;
-        if ((int32_t)(s.seqn + 1 - inbound_seq[src]) > 0)
-          inbound_seq[src] = s.seqn + 1;
-        rx_index.erase(rx_key(src, s.seqn));
-        src_valid_count[src]--;
+        uint32_t ss = sid(s.src, s.lane);
+        if ((int32_t)(s.seqn + 1 - inbound_seq[ss]) > 0)
+          inbound_seq[ss] = s.seqn + 1;
+        rx_index.erase(rx_key(ss, s.seqn));
+        src_valid_count[ss]--;
         release_slot_locked(i);  // may compact: the loop bound re-reads
       }
       rx_drain_srcs.clear();
@@ -2300,7 +2008,7 @@ struct accl_rt {
       // old-world gap must not nack into the new world, and the acked
       // watermark follows the advanced seqns so no ack ever regresses
       for (auto &w : want) w = WantState{};
-      for (uint32_t s = 0; s < world && s < acked_upto.size(); s++)
+      for (uint32_t s = 0; s < acked_upto.size(); s++)
         acked_upto[s] = inbound_seq[s];
       rx_cv.notify_all();
     }
@@ -2613,6 +2321,11 @@ struct accl_rt {
         if (rt.udp_mode && n > st.max_rndzv) return DMA_SIZE_ERROR;
         std::lock_guard<std::mutex> lk(rt.rx_mu);
         const void *tok = (const void *)&st;
+        // the lane this message rides is a pure function of its size —
+        // both ends compute it from the message length, so the receiver
+        // watches exactly the seqn stream the sender feeds
+        const uint32_t lane = rt.lane_of(n);
+        const uint32_t lsid = rt.sid(gsrc, lane);
         // SC_RECV posted-order FIFO: outstanding p2p recvs register a
         // ticket (first execution follows run() order — the sequencer
         // starts fresh calls in queue order), and a recv may take a new
@@ -2632,15 +2345,16 @@ struct accl_rt {
         // stream ownership: a call that consumed part of a multi-segment
         // message from gsrc owns the remainder — any other call defers,
         // or it would interleave payload mid-message
-        auto ow = rt.rx_stream_owner.find(gsrc);
+        auto ow = rt.rx_stream_owner.find(lsid);
         if (ow != rt.rx_stream_owner.end() && ow->second != tok)
           return NOT_READY;
         if (!strict) {
           if (st.off == 0) {
             // judge FIFO eligibility against the true next message head,
             // not an orphaned continuation segment awaiting drain
-            rt.drain_orphans_locked(gsrc);
-            auto hit = rt.rx_index.find(rx_key(gsrc, rt.inbound_seq[gsrc]));
+            rt.drain_orphans_locked(lsid);
+            auto hit =
+                rt.rx_index.find(rx_key(lsid, rt.inbound_seq[lsid]));
             if (hit != rt.rx_index.end()) {
               const RxSlot &hs = rt.rx_slots[hit->second];
               for (const auto &r : rt.outstanding_recvs)
@@ -2657,23 +2371,24 @@ struct accl_rt {
         // re-arms the call deadline) before falling through to the
         // slot path — segments that landed in slots while the landing
         // was ineligible (other traffic queued ahead) still merge here.
-        auto itl = st.landing ? rt.eager_landings.find(gsrc)
+        auto itl = st.landing ? rt.eager_landings.find(lsid)
                               : rt.eager_landings.end();
         if (itl != rt.eager_landings.end() && itl->second.tok == tok)
           st.off = itl->second.landed;
         for (;;) {
           if (st.off >= n && n > 0) break;
           uint64_t got = 0;
-          uint32_t rc = rt.seek_locked(gsrc, tag, p ? p + st.off : nullptr,
-                                       n - st.off, &got, strict,
+          uint32_t rc = rt.seek_locked(gsrc, lane, tag,
+                                       p ? p + st.off : nullptr, n - st.off,
+                                       &got, strict,
                                        /*msg_start=*/st.off == 0,
                                        /*want_msg=*/n);
           if (rc != NO_ERROR) {  // NOT_READY keeps st.off progress
             if (rc == NOT_READY && st.off > 0 && st.off < n)
-              rt.rx_stream_owner[gsrc] = tok;  // mid-message: claim
+              rt.rx_stream_owner[lsid] = tok;  // mid-message: claim
             if (rc == NOT_READY && strict && !rt.udp_mode && p && n > 0 &&
                 !st.landing &&
-                rt.eager_landings.find(gsrc) == rt.eager_landings.end() &&
+                rt.eager_landings.find(lsid) == rt.eager_landings.end() &&
                 n >= (64ull << 10)) {
               // threshold: only chunks big enough that the saved
               // staging copy + slot allocation outweigh the
@@ -2682,7 +2397,7 @@ struct accl_rt {
               // register direct placement for the remainder: the rx
               // thread reads our message's further segments straight
               // into p (rxbuf bypass; see EagerLanding)
-              rt.eager_landings[gsrc] =
+              rt.eager_landings[lsid] =
                   EagerLanding{p, n, st.off, tag, /*in_use=*/false,
                                /*abort=*/false, tok};
               st.landing = true;
@@ -2696,13 +2411,13 @@ struct accl_rt {
           if (st.off >= n) break;  // n == 0: one zero-length segment
         }
         if (st.landing) {
-          auto drop = rt.eager_landings.find(gsrc);
+          auto drop = rt.eager_landings.find(lsid);
           if (drop != rt.eager_landings.end() && drop->second.tok == tok)
             rt.eager_landings.erase(drop);
           st.landing = false;
         }
         st.off = 0;
-        auto own = rt.rx_stream_owner.find(gsrc);
+        auto own = rt.rx_stream_owner.find(lsid);
         if (own != rt.rx_stream_owner.end() && own->second == tok)
           rt.rx_stream_owner.erase(own);
         return NO_ERROR;
@@ -3692,11 +3407,6 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   rt->rx_slots.resize(n_rx_bufs);
   rt->base_rx_slots = n_rx_bufs;
   for (size_t i = 0; i < rt->rx_slots.size(); i++) rt->idle_q.push_back(i);
-  rt->inbound_seq.assign(world, 0);
-  rt->outbound_seq.assign(world, 0);
-  rt->src_valid_count.assign(world, 0);
-  rt->peer_fd.assign(world, -1);
-  rt->tx_mu = std::vector<std::mutex>(world);
   rt->wr(IDCODE, 0xACC17B00u);
   if (const char *s = getenv("ACCL_RT_SHAPE")) {
     if (!strcmp(s, "ring")) rt->shape_override = 1;
@@ -3760,30 +3470,72 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
   rt->rely_wire = rt->rely_on &&
                   (transport != ACCL_RT_TRANSPORT_LOCAL ||
                    rt->fault_pct_armed);
-  rt->retx.resize(world);
-  rt->want.assign(world, accl_rt::WantState{});
-  rt->acked_upto.assign(world, 0);
-  rt->last_ack_t.assign(world, std::chrono::steady_clock::now());
+  // ----- wire shape: legacy cost model, lanes, TX batching ----------------
+  // ACCL_RT_WIRE_LEGACY=1: pre-vectored transmit (per-frame syscalls,
+  // coalescing copies) — the A/B baseline `bench --wire-gate` measures
+  // the scatter-gather path against. Legacy implies the single-lane
+  // bit-identical wire.
+  if (const char *s = getenv("ACCL_RT_WIRE_LEGACY"))
+    rt->legacy_wire = atoi(s) != 0;
+  // ACCL_RT_LANES (session transport only): per-peer lanes. Lane 0
+  // carries small messages and all control traffic; lane 1 carries bulk
+  // messages >= ACCL_RT_LANE_BULK_BYTES, so a jumbo frame in flight
+  // cannot head-of-line-block a small message. Default 1 = the legacy
+  // single-stream wire, bit-identical framing.
+  if (transport == ACCL_RT_TRANSPORT_TCP && !rt->legacy_wire) {
+    if (const char *s = getenv("ACCL_RT_LANES")) {
+      int v = atoi(s);
+      if (v > (int)WIRE_MAX_LANES) v = WIRE_MAX_LANES;
+      if (v >= 1) rt->n_lanes = (uint32_t)v;
+    }
+  }
+  if (const char *s = getenv("ACCL_RT_LANE_BULK_BYTES")) {
+    long long v = atoll(s);
+    if (v > 0) rt->lane_bulk_bytes = (uint64_t)v;
+  }
+  // TX batching (many frames -> one vectored syscall) stays OFF where
+  // per-frame emission is part of the contract: the legacy cost model,
+  // the seeded chaos stream (frame-order determinism), the WAN shaper
+  // (per-frame charges), the in-process POE (delivery IS the call), and
+  // the one-shot tail levers (their wire-order asserts reason about
+  // single frames).
+  rt->tx_batch_on = !rt->legacy_wire && !rt->fault_pct_armed &&
+                    transport != ACCL_RT_TRANSPORT_LOCAL &&
+                    rt->wan_alpha_us == 0 && rt->wan_bytes_per_us <= 0 &&
+                    rt->fault_delay_tail_ms == 0 && !rt->fault_drop_tail;
+  // per-stream state: one seqn stream per (peer, lane) sid
+  const uint32_t n_streams = world * rt->n_lanes;
+  rt->inbound_seq.assign(n_streams, 0);
+  rt->outbound_seq.assign(n_streams, 0);
+  rt->src_valid_count.assign(n_streams, 0);
+  rt->retx.resize(n_streams);
+  rt->want.assign(n_streams, WantState{});
+  rt->acked_upto.assign(n_streams, 0);
+  rt->last_ack_t.assign(n_streams, std::chrono::steady_clock::now());
   auto start_rely = [](accl_rt *r) {
     if (r->rely_wire) r->rely_thread = std::thread([r] { r->rely_loop(); });
   };
+  acclw::PoeConfig pc;
+  pc.world = world;
+  pc.rank = rank;
+  pc.ports = ports;
+  pc.lanes = rt->n_lanes;
+  pc.legacy_wire = rt->legacy_wire;
+  pc.debug = rt->debug_on;
+  if ((rt->wan_alpha_us > 0 || rt->wan_bytes_per_us > 0) &&
+      transport != ACCL_RT_TRANSPORT_LOCAL)
+    pc.shaper = [rt](size_t payload_len) { rt->wan_charge(payload_len); };
 
   if (transport == ACCL_RT_TRANSPORT_LOCAL) {
     // intra-process POE: no sockets, no rx threads — the sender's
-    // thread delivers straight into the peer runtime (local_deliver).
-    // Bring-up IS the registry: frame_out waits for a peer's entry.
+    // thread delivers straight into the peer runtime's sink.
+    // Bring-up IS the registry: send_frames waits for a peer's entry.
     rt->local_mode = true;
-    rt->local_port = ports[rank];
-    rt->local_ports_vec.assign(ports, ports + world);
-    {
-      std::lock_guard<std::mutex> g(g_local_mu);
-      if (g_local_ports.count(ports[rank])) {
-        delete rt;  // port collision: refuse rather than misroute
-        return nullptr;
-      }
-      g_local_ports[ports[rank]] = rt;
+    rt->poe = acclw::make_local_poe(pc);
+    if (!rt->poe->connect(rt)) {
+      delete rt;  // port collision: refuse rather than misroute
+      return nullptr;
     }
-    g_local_cv.notify_all();
     rt->seq_thread = std::thread([rt] { rt->sequencer(); });
     start_rely(rt);
     return rt;
@@ -3794,31 +3546,15 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     // Segment must fit one datagram with its header.
     if (rt->rx_buf_bytes > 60000) rt->rx_buf_bytes = 60000;
     rt->udp_mode = true;
-    rt->udp_fd = socket(AF_INET, SOCK_DGRAM, 0);
-    int buf = 64 * 1024 * 1024;  // absorb bursts: the POE has no sessions
-    // FORCE ignores net.core.rmem_max when privileged; fall back otherwise
-    if (setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUFFORCE, &buf, sizeof buf))
-      setsockopt(rt->udp_fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
-    setsockopt(rt->udp_fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
-    sockaddr_in sa{};
-    sa.sin_family = AF_INET;
-    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-    sa.sin_port = htons(ports[rank]);
-    if (bind(rt->udp_fd, (sockaddr *)&sa, sizeof sa) != 0) {
-      close(rt->udp_fd);
-      delete rt;
-      return nullptr;
-    }
-    rt->peer_sa.resize(world);
-    for (uint32_t i = 0; i < world; i++) {
-      rt->peer_sa[i] = sockaddr_in{};
-      rt->peer_sa[i].sin_family = AF_INET;
-      rt->peer_sa[i].sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-      rt->peer_sa[i].sin_port = htons(ports[i]);
-    }
+    // hello state must exist BEFORE connect: the rx thread it spawns
+    // can deliver a peer's hello immediately
     rt->hello_seen.assign(world, false);
     rt->hello_seen[rank] = true;
-    rt->rx_threads.emplace_back([rt] { rt->udp_rx_loop(); });
+    rt->poe = acclw::make_udp_poe(pc);
+    if (!rt->poe->connect(rt)) {
+      delete rt;  // bind failure
+      return nullptr;
+    }
     // bring-up handshake: solicit hellos until every peer answered
     // (datagrams sent before a peer binds are simply lost, so re-solicit)
     auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
@@ -3844,91 +3580,11 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
     return rt;
   }
 
-  // listen
-  rt->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
-  int one = 1;
-  setsockopt(rt->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
-  sockaddr_in sa{};
-  sa.sin_family = AF_INET;
-  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  sa.sin_port = htons(ports[rank]);
-  if (bind(rt->listen_fd, (sockaddr *)&sa, sizeof sa) != 0 ||
-      listen(rt->listen_fd, (int)world) != 0) {
-    delete rt;
-    return nullptr;
-  }
-  // accept from lower ranks in a helper thread while connecting to higher;
-  // a periodic accept timeout + overall deadline prevents a missing peer
-  // from wedging bring-up forever.
-  std::atomic<bool> accept_ok{true};
-  struct timeval tv{0, 200 * 1000};
-  setsockopt(rt->listen_fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-  std::thread acceptor([&] {
-    auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(30);
-    uint32_t accepted = 0;
-    while (accepted < rank) {
-      int fd = accept(rt->listen_fd, nullptr, nullptr);
-      if (fd < 0) {
-        if (std::chrono::steady_clock::now() > deadline) {
-          accept_ok.store(false);
-          return;
-        }
-        continue;  // EAGAIN from the periodic timeout
-      }
-      // accepted fds inherit the listener's SO_RCVTIMEO on Linux. Keep a
-      // BOUNDED timeout for the 4-byte rank hello (a connector that
-      // established but never identifies itself — observed on sandboxed
-      // loopback stacks — must not wedge bring-up forever), then clear
-      // it so idle links don't die with EAGAIN later.
-      struct timeval hello_tv{5, 0};
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_tv, sizeof hello_tv);
-      uint32_t peer;
-      if (!recv_all(fd, &peer, 4) || peer >= world) {
-        close(fd);
-        continue;
-      }
-      struct timeval never{0, 0};
-      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &never, sizeof never);
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-      rt->peer_fd[peer] = fd;
-      accepted++;
-    }
-  });
-  bool ok = true;
-  for (uint32_t i = rank + 1; i < world && ok; i++) {
-    sockaddr_in pa{};
-    pa.sin_family = AF_INET;
-    pa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-    pa.sin_port = htons(ports[i]);
-    // retry: peers come up in any order. Each attempt gets a FRESH
-    // socket — POSIX leaves a socket unspecified after a failed
-    // connect, and some loopback stacks wedge a re-connected fd
-    // forever (observed as a bring-up hang on sandboxed kernels).
-    int fd = -1;
-    int tries = 0;
-    for (;;) {
-      fd = socket(AF_INET, SOCK_STREAM, 0);
-      if (connect(fd, (sockaddr *)&pa, sizeof pa) == 0) break;
-      close(fd);
-      fd = -1;
-      if (++tries > 2000) { ok = false; break; }
-      std::this_thread::sleep_for(std::chrono::milliseconds(5));
-    }
-    if (!ok) break;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-    uint32_t me = rank;
-    send_all(fd, &me, 4);
-    rt->peer_fd[i] = fd;
-  }
-  acceptor.join();
-  if (!ok || !accept_ok.load()) {
+  // session POE: full TCP mesh, one ordered stream per (peer, lane)
+  rt->poe = acclw::make_tcp_poe(pc);
+  if (!rt->poe->connect(rt)) {
     accl_rt_destroy(rt);
     return nullptr;
-  }
-  // links are up: drop the accept timeout side effects (fd no longer used)
-  for (uint32_t i = 0; i < world; i++) {
-    if (i == rank) continue;
-    rt->rx_threads.emplace_back([rt, i] { rt->rx_loop(i); });
   }
   rt->seq_thread = std::thread([rt] { rt->sequencer(); });
   start_rely(rt);
@@ -3950,28 +3606,14 @@ void accl_rt_destroy(accl_rt_t *rt) {
   rt->rx_cv.notify_all();
   rt->rndzv_cv.notify_all();
   rt->hello_cv.notify_all();
-  if (rt->local_mode) {
-    // deregister, then drain in-flight deliveries pinned on this
-    // runtime (each is one bounded local_deliver call)
-    std::unique_lock<std::mutex> g(g_local_mu);
-    g_local_ports.erase(rt->local_port);
-    g_local_cv.notify_all();
-    while (rt->local_refs > 0)
-      g_local_cv.wait(g);
+  // tear the wire down first: begin_shutdown unblocks the POE's rx
+  // loops (closes links / pokes the datagram socket / deregisters from
+  // the in-process registry and drains deliveries pinned on this
+  // runtime), join reaps them — after this no sink call is in flight
+  if (rt->poe) {
+    rt->poe->begin_shutdown();
+    rt->poe->join();
   }
-  for (int fd : rt->peer_fd)
-    if (fd >= 0) { shutdown(fd, SHUT_RDWR); close(fd); }
-  if (rt->udp_fd >= 0) {
-    // wake the datagram rx thread: shutdown() is a no-op on unconnected
-    // UDP sockets, so poke ourselves with a runt datagram (the rx loop
-    // re-checks `stop` on any short read), then close
-    sendto(rt->udp_fd, "", 0, 0, (const sockaddr *)&rt->peer_sa[rt->rank],
-           sizeof(sockaddr_in));
-    close(rt->udp_fd);
-  }
-  if (rt->listen_fd >= 0) close(rt->listen_fd);
-  for (auto &t : rt->rx_threads)
-    if (t.joinable()) t.join();
   if (rt->seq_thread.joinable()) rt->seq_thread.join();
   if (rt->rely_thread.joinable()) rt->rely_thread.join();
   {
@@ -4103,6 +3745,8 @@ size_t accl_rt_get_stats2(accl_rt_t *rt, uint64_t *out, size_t cap) {
       rt->stat_inj_loss.load(),    rt->stat_inj_corrupt.load(),
       rt->stat_inj_dup.load(),     rt->stat_inj_reorder.load(),
       rt->stat_rely_ns.load(),
+      rt->poe ? rt->poe->tx_syscalls() : 0,
+      rt->poe ? rt->poe->tx_batched() : 0,
   };
   size_t n = cap < ACCL_RT_STATS2_COUNT ? cap : (size_t)ACCL_RT_STATS2_COUNT;
   for (size_t i = 0; i < n; i++) out[i] = vals[i];

@@ -1,0 +1,127 @@
+// accl-tpu native runtime: wire format shared by the transport /
+// reliability / session translation units (the one header every side of
+// the POE seam may include). Holds ONLY the on-the-wire frame layout and
+// the frame container types — no sockets, no retransmit state, no
+// session logic.
+
+#ifndef ACCLRT_WIRE_H
+#define ACCLRT_WIRE_H
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <vector>
+
+namespace acclw {
+
+// ---------------------------------------------------------------------------
+// Wire format: 64-byte header (eth_intf.h:94-151 analog) + payload
+// ---------------------------------------------------------------------------
+enum MsgType : uint32_t {
+  MSG_EGR_DATA = 0,    // eager segment into an rx slot
+  MSG_RNDZV_ADDR = 1,  // receiver -> sender address notification
+  MSG_RNDZV_WRITE = 2, // sender -> receiver one-sided write payload
+  MSG_HELLO = 3,       // datagram bring-up solicit (reply expected)
+  MSG_HELLO_ACK = 4,   // datagram bring-up reply (no further reply)
+  // reliability sublayer control frames (header-only; seqn is the
+  // REFERENCED data seqn, never a slot in the per-peer seqn stream):
+  MSG_ACK = 5,   // receiver -> sender: cumulative "everything below
+                 // seqn landed" — sender GCs its retransmit buffer
+  MSG_NACK = 6,  // receiver -> sender: "resend (src, seqn)" — the
+                 // selective-retransmit request a gap or CRC drop arms
+};
+
+struct MsgHeader {
+  uint32_t magic;
+  uint32_t msg_type;
+  uint32_t src;
+  uint32_t dst;
+  uint32_t tag;
+  uint32_t seqn;
+  // CRC32C over the whole frame (header with this field zeroed +
+  // payload), set on every frame when the reliability sublayer is on
+  // (ACCL_RT_RELY, default 1; the field was dead pad before — the
+  // offload engine owning integrity below the host, README.md:6). A
+  // mismatch is counted and the frame DROPPED, never landed: corrupt
+  // data cannot reach a reduce lane; the seqn gap it leaves is
+  // repaired by the NACK path like a lost frame.
+  uint32_t crc;
+  // low 16 bits: the host flag (desc word 8's host<<8 nibble, 0/1 in
+  // practice); high 16 bits: the LANE this frame rides (see wire_lane).
+  // Lanes are independent per-peer seqn streams — a jumbo eager message
+  // on the bulk lane cannot head-of-line-block a small message on the
+  // default lane. Rendezvous and bring-up frames always ride lane 0.
+  uint32_t host;
+  uint64_t bytes;  // payload length / rendezvous size
+  uint64_t vaddr;  // rendezvous target address
+  // total bytes of the eager MESSAGE this segment belongs to: the
+  // receiver-side message boundary. Without it a parked recv whose count
+  // mismatches the head message would consume it as partial fill and
+  // misassemble two messages into one buffer (the reference wire needs no
+  // equivalent because rxbuf_seek pairs whole DMA commands, not byte
+  // streams). Rides every MSG_EGR_DATA segment, with msg_off locating the
+  // segment inside its message (0 = message head) so an orphaned
+  // continuation segment — left behind when a mid-message recv times out —
+  // can never masquerade as a fresh head of the same length.
+  uint64_t msg_bytes;
+  uint64_t msg_off;
+};
+static_assert(sizeof(MsgHeader) == 64, "ACCL header is 64 bytes");
+// Bumped (…02) when the header's pad bytes became msg_bytes/msg_off
+// framing, (…03) when the dead strm word became the frame CRC32C and
+// MSG_ACK/MSG_NACK joined the protocol, (…04) when the host word's high
+// half became the lane id (multi-lane per-peer seqn streams): a
+// mixed-build world (old sender, new receiver) would not error on
+// size/magic but silently never match and surface as RECEIVE_TIMEOUT —
+// the magic makes cross-version ranks fail fast at frame decode instead.
+constexpr uint32_t MSG_MAGIC = 0xACC17B04u;
+
+// Lane packing: the header's host word carries {lane:16, host:16}.
+constexpr uint32_t WIRE_MAX_LANES = 2;  // 0 = default, 1 = bulk
+inline uint32_t wire_pack_host(uint32_t host, uint32_t lane) {
+  return (host & 0xFFFFu) | (lane << 16);
+}
+inline uint32_t wire_host(uint32_t host_word) { return host_word & 0xFFFFu; }
+inline uint32_t wire_lane(const MsgHeader &h) { return h.host >> 16; }
+
+// Payload bytes that follow this header on the wire (framing is derived
+// from the header alone — no length prefix).
+inline size_t wire_payload_len(const MsgHeader &h) {
+  return (h.msg_type == MSG_EGR_DATA || h.msg_type == MSG_RNDZV_WRITE)
+             ? (size_t)h.bytes
+             : 0;
+}
+
+// A fully serialized frame (header immediately followed by payload) and
+// the refcount that keeps it pinned: the retransmit buffer, the chaos
+// reorder hold, and an in-flight TX batch all share ONE buffer — the
+// frame's bytes are built exactly once and retained by reference until
+// the last holder lets go (no second payload copy for retention).
+using FrameBuf = std::vector<uint8_t>;
+using FramePtr = std::shared_ptr<FrameBuf>;
+
+// Borrowed scatter-gather view of one outbound frame. The header rides
+// BY VALUE (stable storage for an iovec while the payload pointer is
+// borrowed from caller memory); `contiguous` marks views over a
+// serialized FrameBuf, where payload - sizeof(MsgHeader) is the buffer
+// start and a legacy single-write may ship it without coalescing.
+struct FrameView {
+  MsgHeader h;
+  const uint8_t *payload = nullptr;
+  size_t payload_len = 0;
+  bool contiguous = false;
+};
+
+inline FrameView frame_view(const FrameBuf &f) {
+  FrameView v;
+  std::memcpy(&v.h, f.data(), sizeof v.h);
+  v.payload = f.data() + sizeof(MsgHeader);
+  v.payload_len = f.size() - sizeof(MsgHeader);
+  v.contiguous = true;
+  return v;
+}
+
+}  // namespace acclw
+
+#endif  // ACCLRT_WIRE_H

@@ -335,9 +335,12 @@ CHAOS_SEEDS = 30
 
 @pytest.mark.parametrize("seed", range(CHAOS_SEEDS))
 def test_chaos_fuzz_transport_absorbs_seeded_faults(fault_env, seed):
-    """30-seed chaos fuzz: random seeded loss/corrupt/dup/reorder rates
-    over a p2p frame storm (rx-buf-sized segments, so the fault model
-    gets hundreds of draws) plus collective dispatches. Every answer
+    """30-seed chaos fuzz over all three POEs (the seed picks the
+    transport, so the session TCP wire, the sessionless datagram wire,
+    and the in-process registry each absorb a third of the seeds):
+    random seeded loss/corrupt/dup/reorder rates over a p2p frame storm
+    (rx-buf-sized segments, so the fault model gets hundreds of draws)
+    plus collective dispatches. Every answer
     must be BITWISE vs the no-fault oracle (integer payloads), the
     retransmit counters strictly positive (the faults provably fired
     and were provably repaired), and zero calls may surface an error —
@@ -351,7 +354,7 @@ def test_chaos_fuzz_transport_absorbs_seeded_faults(fault_env, seed):
     corrupt = 1.0 + float(rng.uniform(0, 1.0))
     dup = 0.5 + float(rng.uniform(0, 1.0))
     reorder = float(rng.uniform(0, 1.5))
-    transport = "local" if seed % 3 else "tcp"
+    transport = ("tcp", "udp", "local")[seed % 3]
     world = int(rng.choice([2, 4]))
     op = str(rng.choice(["allreduce", "allgather", "alltoall"]))
     fault_env(ACCL_RT_FAULT_LOSS_PCT=loss, ACCL_RT_FAULT_CORRUPT_PCT=corrupt,
@@ -421,6 +424,127 @@ def test_chaos_fuzz_transport_absorbs_seeded_faults(fault_env, seed):
     if agg["inj_dup"]:
         assert agg["dup_drops"] > 0, \
             f"seed {seed}: duplicate frames not deduped ({agg})"
+
+
+@pytest.mark.parametrize("transport", ["tcp", "udp"])
+def test_chaos_kill_rank_control(fault_env, transport):
+    """Seeded chaos PLUS the kill-rank control lever, on both socket
+    POEs: mid-chaos the killed rank's wire goes dark after its call
+    budget — the calls inside the budget still complete bitwise
+    (repair keeps working right up to the kill), and the first call
+    past it surfaces the timeout escalation on every rank instead of
+    hanging or delivering junk."""
+    fault_env(ACCL_RT_FAULT_LOSS_PCT=2, ACCL_RT_FAULT_CORRUPT_PCT=1,
+              ACCL_RT_FAULT_SEED=31, ACCL_RT_FAULT_KILL_RANK=1,
+              ACCL_RT_FAULT_KILL_AFTER=2)
+    n = 2048
+    xs = RNG.integers(-64, 64, size=(2, n)).astype(np.float32)
+    w = EmuWorld(2, max_eager=1 << 20, rx_buf_bytes=512,
+                 transport=transport)
+    try:
+        def body(rank, i):
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=800))
+            outs = []
+            for _k in range(2):  # inside the kill budget: bitwise
+                out = np.zeros(n, np.float32)
+                rank.allreduce(xs[i].copy(), out, n, ReduceFunction.SUM)
+                outs.append(out)
+            try:  # past the budget: rank 1 is dark
+                out = np.zeros(n, np.float32)
+                rank.allreduce(xs[i].copy(), out, n, ReduceFunction.SUM)
+                return outs, "completed"
+            except ACCLError as e:
+                return outs, e.retcode
+
+        res = w.run(body)
+    finally:
+        w.close()
+    for outs, verdict in res:
+        for out in outs:
+            np.testing.assert_array_equal(out, xs.sum(0))
+        assert verdict != "completed" and verdict & 0x800
+
+
+def test_two_lanes_break_head_of_line_blocking(fault_env):
+    """ACCL_RT_LANES=2: a 16 MiB jumbo eager message and a 1 KiB
+    message to the SAME peer ride separate per-peer lanes (separate
+    seqn streams over separate links), so the receiver completes the
+    small recv while the jumbo is still unconsumed — out-of-order
+    completion across lanes, which the single-lane wire forbids by
+    construction (see the companion HOL test below)."""
+    fault_env(ACCL_RT_LANES=2)
+    jumbo_n = (16 << 20) // 4
+    small_n = 1024 // 4
+    jumbo = RNG.integers(-100, 100, size=jumbo_n).astype(np.int32)
+    small = RNG.integers(-100, 100, size=small_n).astype(np.int32)
+    w = EmuWorld(2, max_eager=32 << 20, max_rndzv=64 << 20)
+    try:
+        def body(rank, i):
+            if i == 0:
+                # jumbo FIRST: on one lane it would occupy the link head
+                rank.send(jumbo.copy(), jumbo_n, dst=1, tag=7)
+                rank.send(small.copy(), small_n, dst=1, tag=9)
+                return None
+            # the small recv is the ONLY posted recv: it must complete
+            # even though the jumbo ahead of it is entirely unconsumed
+            got_small = np.zeros(small_n, np.int32)
+            rank.recv(got_small, small_n, src=0, tag=9)
+            got_jumbo = np.zeros(jumbo_n, np.int32)
+            rank.recv(got_jumbo, jumbo_n, src=0, tag=7)
+            return got_small, got_jumbo
+
+        res = w.run(body)
+    finally:
+        w.close()
+    got_small, got_jumbo = res[1]
+    np.testing.assert_array_equal(got_small, small)
+    np.testing.assert_array_equal(got_jumbo, jumbo)
+
+
+def test_single_lane_head_of_line_blocks(fault_env):
+    """The single-lane control for the test above: with the default
+    one-lane wire the jumbo at the stream head DOES head-of-line-block
+    the small recv (it times out), and the stream drains in wire order
+    afterwards — proving the lanes, not some matching quirk, are what
+    reorder completion."""
+    jumbo_n = (16 << 20) // 4
+    small_n = 1024 // 4
+    jumbo = RNG.integers(-100, 100, size=jumbo_n).astype(np.int32)
+    small = RNG.integers(-100, 100, size=small_n).astype(np.int32)
+    w = EmuWorld(2, max_eager=32 << 20, max_rndzv=64 << 20)
+    try:
+        def body(rank, i):
+            if i == 0:
+                rank.send(jumbo.copy(), jumbo_n, dst=1, tag=7)
+                rank.send(small.copy(), small_n, dst=1, tag=9)
+                return None
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=500))
+            got_small = np.zeros(small_n, np.int32)
+            try:
+                rank.recv(got_small, small_n, src=0, tag=9)
+                blocked = False
+            except ACCLError as e:
+                blocked = bool(e.retcode & 0x800)
+            # drain in wire order: jumbo, then the small message
+            rank.call(CallOptions(scenario=Operation.config,
+                                  function=int(CfgFunc.set_timeout),
+                                  count=5000))
+            got_jumbo = np.zeros(jumbo_n, np.int32)
+            rank.recv(got_jumbo, jumbo_n, src=0, tag=7)
+            rank.recv(got_small, small_n, src=0, tag=9)
+            return blocked, got_small, got_jumbo
+
+        res = w.run(body)
+    finally:
+        w.close()
+    blocked, got_small, got_jumbo = res[1]
+    assert blocked, "single-lane wire should HOL-block the small recv"
+    np.testing.assert_array_equal(got_small, small)
+    np.testing.assert_array_equal(got_jumbo, jumbo)
 
 
 def test_stats2_versioned_counter_surface():
