@@ -16,6 +16,7 @@ collectives stay on-device.
 from __future__ import annotations
 
 import time
+from typing import TYPE_CHECKING
 
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -53,6 +54,9 @@ from .request import BaseRequest
 from .telemetry import get_tracer
 from .utils.logging import Log
 
+if TYPE_CHECKING:
+    from .resilience.manager import ResilienceManager
+
 
 class ACCL:
     """Driver facade over a device backend (reference ACCL class)."""
@@ -89,7 +93,7 @@ class ACCL:
         # every synchronous data-plane call is checked against its
         # model-derived deadline post-completion (one perf_counter pair
         # + a cached policy lookup; None = zero overhead)
-        self._resilience = None
+        self._resilience: ResilienceManager | None = None
         # placeholder rank buffers backing the buffer-less stream forms
         # (reference send/recv/copy overloads that take only a dataType,
         # accl.hpp:190,278,349): one per (count, dtype), reused
@@ -114,7 +118,7 @@ class ACCL:
         # invalidates all prior communicator handles (their exchange-memory
         # addresses are reallocated), so the list starts fresh
         self.communicators.clear()
-        self._split_cache = {}
+        self._split_cache: dict[tuple[int, ...], Communicator] = {}
         world = dev.world
         ranks = [Rank(device_index=i, session_id=i) for i in range(world)]
         self.communicators.append(Communicator(ranks, 0, CCLOAddr.DYNAMIC_BASE))
@@ -129,7 +133,7 @@ class ACCL:
             addr += 4 * ac.WORDS_PER_ROW
         # dynamic exchange-memory allocator tail: later communicators
         # (split) are laid out from here
-        self._exchmem_alloc = addr
+        self._exchmem_alloc: int = addr
         # tuning registers (configure_tuning_parameters, accl.cpp:1198-1208)
         self.configure_tuning_parameters(
             TuningParams.default(cfg["max_rendezvous_size"]))
@@ -341,7 +345,7 @@ class ACCL:
                       int(opts.stream_flags))
             req = self.cclo.start(opts)
             ret = self._complete(req, sync_out, to_device, run_async)
-            if t0 is not None:
+            if mgr is not None and t0 is not None:
                 mgr.observe_call(opts.scenario, opts.count,
                                  dtype_nbytes(opts.data_type)
                                  if opts.data_type != DataType.none else 4,
@@ -997,7 +1001,7 @@ class ACCL:
                                      "supports_quantized_wire", False))
         return tuning
 
-    def arm_resilience(self, manager) -> None:
+    def arm_resilience(self, manager: ResilienceManager | None) -> None:
         """Arm per-call deadlines on this facade
         (resilience.ResilienceManager with a DeadlinePolicy): every
         synchronous data-plane call is checked against its

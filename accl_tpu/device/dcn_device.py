@@ -26,6 +26,7 @@ CPU devices on one host — same program structure, no TPU in the loop.
 from __future__ import annotations
 
 import functools
+from typing import cast
 
 import jax
 import numpy as np
@@ -65,7 +66,10 @@ class DCNCompiler(ScheduleCompiler):
 
     def __init__(self, mesh, outer_axis: str, inner_axis: str,
                  arith_table=None):
-        super().__init__(mesh, (outer_axis, inner_axis),
+        # jax collectives accept an axis-name tuple (the two-tier flat
+        # ring); the compiler annotation keeps the common single-axis
+        # str form, so the tuple goes through a cast at this one seam
+        super().__init__(mesh, cast(str, (outer_axis, inner_axis)),
                          arith_table=arith_table, use_pallas_ring=False)
         self.outer_axis = outer_axis
         self.inner_axis = inner_axis
@@ -263,7 +267,9 @@ class DCNDevice(TPUDevice):
                         (outer_axis, inner_axis))
         else:
             outer_axis, inner_axis = mesh.axis_names
-        super().__init__(mesh, axis_name=(outer_axis, inner_axis))
+        # tuple axis name: same seam as DCNCompiler — jax accepts it,
+        # the TPUDevice annotation keeps the single-axis common form
+        super().__init__(mesh, axis_name=cast(str, (outer_axis, inner_axis)))
         self.outer_axis = outer_axis
         self.inner_axis = inner_axis
         self.compiler = DCNCompiler(mesh, outer_axis, inner_axis)

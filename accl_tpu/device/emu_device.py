@@ -18,6 +18,7 @@ import pathlib
 import socket
 import subprocess
 import threading
+from typing import Literal, overload
 
 import numpy as np
 
@@ -158,7 +159,11 @@ def load_native():
         return lib
 
 
-def free_ports(n: int, hold: bool = False):
+@overload
+def free_ports(n: int, hold: Literal[True]) -> tuple[list[int], list[socket.socket]]: ...
+@overload
+def free_ports(n: int, hold: Literal[False] = False) -> list[int]: ...
+def free_ports(n, hold=False):
     """Reserve n free localhost ports (emulator launch helper, the role of
     test/model/emulator/run.py's port allocation).
 
@@ -543,6 +548,7 @@ class EmuWorld:
                 return
             last = errs[0]
             self.close()  # tear down the half-up world before retrying
+        assert last is not None  # loop body ran and every attempt failed
         raise last
 
     def close(self):

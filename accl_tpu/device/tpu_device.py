@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any
 
 import numpy as np
 import jax
@@ -81,7 +82,7 @@ class TPUDevice(CCLODevice):
         # _resolve_step applies them to fp32 calls only.
         self.hier_wires: tuple[DataType, DataType] = (DataType.none,
                                                       DataType.none)
-        self.buffers: dict[int, object] = {}  # address -> TPUBuffer
+        self.buffers: dict[int, Any] = {}  # address -> TPUBuffer
         self.timeout = 1_000_000
         self.max_eager_size = DEFAULT_MAX_EAGER_SIZE
         self.max_rendezvous_size = DEFAULT_MAX_RENDEZVOUS_SIZE
@@ -532,13 +533,12 @@ class TPUDevice(CCLODevice):
         # followed across tracks in the exported trace. A content digest,
         # not hash(): enum hashes are PYTHONHASHSEED-salted, and the
         # signature must match across runs so archived traces correlate.
+        sig = None
         if tracer.active:
             import hashlib
 
             sig = hashlib.sha256(
                 repr(desc.signature()).encode()).hexdigest()[:16]
-        else:
-            sig = None
         with tracer.span("record", cat="phase", track="device") as sp:
             sp.set(signature=sig, n_steps=len(desc.steps))
             plans = []

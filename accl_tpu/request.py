@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Any
 
 from .constants import ACCLError, ErrorCode, OperationStatus
 
@@ -31,6 +32,11 @@ class BaseRequest:
         self.retcode = 0
         self.duration_ns = 0
         self._done = threading.Event()
+        # facade riders (ACCL._complete / ACCL.wait): buffers whose
+        # device->host sync was deferred to wait(), and the private
+        # stream placeholder to release once the request completes
+        self._accl_sync_out: list = []
+        self._accl_scratch: Any = None
 
     def running(self):
         self.status = OperationStatus.EXECUTING
@@ -85,6 +91,10 @@ class TPURequest(BaseRequest):
         super().__init__(function_name)
         self.outputs = outputs
         self._on_complete = on_complete
+        # set by the device after plan selection: the resolved Plan this
+        # request executes, and its timing.predict estimate when tracing
+        self.plan: Any = None
+        self.predicted_s: float | None = None
         self.running()
 
     def wait(self, timeout: float | None = None) -> bool:
@@ -134,6 +144,9 @@ class SequenceRequest(TPURequest):
         super().__init__("sequence", outputs, on_complete=on_complete)
         self.plans = list(plans)
         self.num_steps = len(self.plans)
+        # set by the device when tracing: content hash of the recorded
+        # descriptor batch, the cache key the dispatch tests read
+        self.signature: str | None = None
         # exactly one device dispatch happened for the whole batch — the
         # observable inversion the sequence layer exists for (bench.py's
         # sequence_fused_vs_eager row and the cache-hit test read this)
@@ -161,6 +174,9 @@ class ParkedRecvRequest(BaseRequest):
         self._paired = threading.Event()
         self._claim_lock = threading.Lock()
         self._claimed = False
+        # device-side parking-slot sequence number (used to unpark the
+        # right entry when recvs race)
+        self._park_seq = 0
         # set by the device to drop the parking; a do-nothing callable,
         # not a def, so reassignment stays symmetric
         self._unpark = lambda: None  # noqa: E731

@@ -170,7 +170,7 @@ class Tracer:
     # -- emission ----------------------------------------------------------
 
     def span(self, name: str, cat: str = "call", track: str = "host",
-             **args):
+             **args) -> "_NullSpan | _LiveSpan":
         """Start a span context manager. An inactive tracer (ring off,
         no observers) returns the shared no-op before touching the
         arguments."""

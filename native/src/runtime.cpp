@@ -95,6 +95,40 @@ enum Scenario : uint32_t {
 // with the transport side of the POE seam.
 
 // ---------------------------------------------------------------------------
+// Timed condition waits: gcc-10's libtsan has no pthread_cond_clockwait
+// interceptor, and libstdc++ routes steady-clock wait_for/wait_until
+// through clockwait — the wait's internal unlock/reacquire becomes
+// invisible to TSan, so every lock pairing after a timed wait reports
+// as a false race or double lock. In TSan builds route timed waits
+// through the system clock, which takes the intercepted
+// pthread_cond_timedwait path. These timeouts are heartbeat ticks and
+// lost-wakeup backstops, not correctness deadlines, so wall-clock
+// sensitivity is acceptable in the sanitizer lane.
+// ---------------------------------------------------------------------------
+template <class Rep, class Period>
+static std::cv_status cv_wait_for(std::condition_variable &cv,
+                                  std::unique_lock<std::mutex> &lk,
+                                  std::chrono::duration<Rep, Period> d) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(lk, std::chrono::system_clock::now() + d);
+#else
+  return cv.wait_for(lk, d);
+#endif
+}
+
+template <class Rep, class Period, class Pred>
+static bool cv_wait_for(std::condition_variable &cv,
+                        std::unique_lock<std::mutex> &lk,
+                        std::chrono::duration<Rep, Period> d, Pred p) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(lk, std::chrono::system_clock::now() + d,
+                       std::move(p));
+#else
+  return cv.wait_for(lk, d, std::move(p));
+#endif
+}
+
+// ---------------------------------------------------------------------------
 // dtype helpers: elementwise SUM/MAX incl. fp16/bf16 via uint16 conversion
 // (reduce_ops plugin analog, here over host memory)
 // ---------------------------------------------------------------------------
@@ -365,10 +399,11 @@ struct Completion {
 }  // namespace
 
 struct accl_rt : public acclw::PoeSink {
-  uint32_t world, rank;
-  uint32_t rx_buf_bytes, max_eager;
-  uint64_t max_rndzv;
-  std::vector<uint8_t> exchmem = std::vector<uint8_t>(EXCHMEM_BYTES, 0);
+  uint32_t world, rank;  // ACCL_INIT_CONST
+  uint32_t rx_buf_bytes;  // ACCL_INIT_CONST
+  uint32_t max_eager;  // ACCL_ROLE_ONLY(seq); SC_CONFIG-mutable
+  uint64_t max_rndzv;  // ACCL_ROLE_ONLY(seq); SC_CONFIG-mutable
+  std::vector<uint8_t> exchmem = std::vector<uint8_t>(EXCHMEM_BYTES, 0);  // ACCL_GUARDED_BY(exch_mu)
   std::mutex exch_mu;
 
   // The Protocol Offload Engine behind the seam (src/transport.h) — TCP
@@ -380,8 +415,8 @@ struct accl_rt : public acclw::PoeSink {
   // fast-path role NCCL fills with SHM/P2P transports). The session
   // builds frames and hands the Poe scatter-gather views; inbound
   // frames arrive via on_frame (the PoeSink side of this struct).
-  std::unique_ptr<acclw::Poe> poe;
-  bool udp_mode = false;
+  std::unique_ptr<acclw::Poe> poe;  // ACCL_INIT_CONST
+  bool udp_mode = false;  // ACCL_INIT_CONST
   // Per-peer LANES (TCP only, ACCL_RT_LANES, clamped [1, 2]): each
   // (peer, lane) pair is an independent ordered link carrying its own
   // seqn stream, so a jumbo eager message on the bulk lane (lane 1,
@@ -389,18 +424,18 @@ struct accl_rt : public acclw::PoeSink {
   // message on the default lane. All per-peer stream state below is
   // indexed by sid = rank * n_lanes + lane. Default 1 lane — the
   // single-stream wire, bit-identical to the pre-lane protocol.
-  uint32_t n_lanes = 1;
-  uint64_t lane_bulk_bytes = 64ull << 10;  // ACCL_RT_LANE_BULK_BYTES
-  bool legacy_wire = false;  // ACCL_RT_WIRE_LEGACY: per-frame-syscall
+  uint32_t n_lanes = 1;  // ACCL_INIT_CONST
+  uint64_t lane_bulk_bytes = 64ull << 10;  // ACCL_INIT_CONST; ACCL_RT_LANE_BULK_BYTES
+  bool legacy_wire = false;  // ACCL_INIT_CONST; ACCL_RT_WIRE_LEGACY: per-frame-syscall
                              // cost model, batching off (bench A/B)
-  bool tx_batch_on = false;  // computed at create: vectored batching
+  bool tx_batch_on = false;  // ACCL_INIT_CONST; computed at create: vectored batching
                              // armed (off under chaos/WAN/legacy/local
                              // — those paths need per-frame emission)
   uint32_t sid(uint32_t r, uint32_t lane) const { return r * n_lanes + lane; }
   uint32_t lane_of(uint64_t msg_bytes) const {
     return (n_lanes > 1 && msg_bytes >= lane_bulk_bytes) ? 1u : 0u;
   }
-  std::vector<bool> hello_seen;      // bring-up handshake state
+  std::vector<bool> hello_seen;      // ACCL_GUARDED_BY(hello_mu); bring-up handshake state
   std::mutex hello_mu;
   std::condition_variable hello_cv;
   std::atomic<bool> stop{false};
@@ -408,17 +443,17 @@ struct accl_rt : public acclw::PoeSink {
   // eager rx ring + notifications (rxbuf_offload analog). idle_q is the
   // IDLE free-list (indices into rx_slots) so landing a segment is O(1)
   // even when the datagram transport grows the ring into the thousands.
-  std::vector<RxSlot> rx_slots;
-  std::vector<size_t> idle_q;
-  size_t base_rx_slots = 0;  // configured ring size; growth beyond it is
+  std::vector<RxSlot> rx_slots;  // ACCL_GUARDED_BY(rx_mu)
+  std::vector<size_t> idle_q;  // ACCL_GUARDED_BY(rx_mu)
+  size_t base_rx_slots = 0;  // ACCL_INIT_CONST; configured ring size; growth beyond it is
                              // burst absorption and compacts when drained
   // (sid, seqn) -> slot index: seeks are O(1) even when a datagram burst
   // grows the ring to 2^20 slots (a linear scan made draining a large
   // burst quadratic). src_valid_count keeps stray-seqn detection O(1).
   // All stream-indexed maps below key on sid = src * n_lanes + lane —
   // each lane is its own ordered seqn stream.
-  std::unordered_map<uint64_t, size_t> rx_index;
-  std::vector<uint32_t> src_valid_count;
+  std::unordered_map<uint64_t, size_t> rx_index;  // ACCL_GUARDED_BY(rx_mu)
+  std::vector<uint32_t> src_valid_count;  // ACCL_GUARDED_BY(rx_mu)
   // sid -> the call (CollState address) that has consumed part of a
   // multi-segment eager message from that src and owns the remainder of
   // its stream: segments of one message share tag and consecutive seqns,
@@ -426,7 +461,7 @@ struct accl_rt : public acclw::PoeSink {
   // payload mid-message (two concurrent TAG_ANY recvs, or a recv racing
   // a collective on the same src link). Guarded by rx_mu; released on
   // message completion or call termination (release_rx_ownership).
-  std::unordered_map<uint32_t, const void *> rx_stream_owner;
+  std::unordered_map<uint32_t, const void *> rx_stream_owner;  // ACCL_GUARDED_BY(rx_mu)
   static uint64_t rx_key(uint32_t sid, uint32_t seqn) {
     return ((uint64_t)sid << 32) | seqn;
   }
@@ -438,8 +473,8 @@ struct accl_rt : public acclw::PoeSink {
     uint64_t bytes, ticket;
     const void *tok;
   };
-  std::vector<OutstandingRecv> outstanding_recvs;
-  uint64_t recv_ticket_next = 0;
+  std::vector<OutstandingRecv> outstanding_recvs;  // ACCL_GUARDED_BY(rx_mu)
+  uint64_t recv_ticket_next = 0;  // ACCL_GUARDED_BY(rx_mu)
 
   // Last strict-recv head mismatch that DEFERRED instead of erroring
   // (the head_is_claimable softening in seek_locked): a deferred
@@ -456,7 +491,8 @@ struct accl_rt : public acclw::PoeSink {
     // provably stray (DMA_TAG_MISMATCH_ERROR / DMA_SIZE_ERROR): the
     // NOT_READY softening must not hide which protocol check tripped
     uint32_t code = 0;
-  } last_defer;
+  } last_defer;  // ACCL_GUARDED_BY(rx_mu)
+  // ACCL_REQUIRES(rx_mu)
   void note_defer_locked(const RxSlot &s, uint32_t want_tag,
                          uint64_t want_msg, uint32_t code) {
     last_defer.count++;
@@ -488,7 +524,7 @@ struct accl_rt : public acclw::PoeSink {
     bool abort = false;   // revoker asked the rx thread to let go
     const void *tok = nullptr;
   };
-  std::unordered_map<uint32_t, EagerLanding> eager_landings;  // by sid
+  std::unordered_map<uint32_t, EagerLanding> eager_landings;  // ACCL_GUARDED_BY(rx_mu); by sid
 
   // Remove a call's landings (rx_mu held via lk). An in-flight direct
   // read is asked to let go via `abort`; the rx thread's read loop is
@@ -499,6 +535,7 @@ struct accl_rt : public acclw::PoeSink {
   // unbounded recv_all wait would. A partially-landed message arms the
   // orphan drain for its tail. The cv wait releases the lock, so the
   // scan restarts after every wakeup (iterators don't survive the gap).
+  // ACCL_REQUIRES(rx_mu)
   void drop_landings_locked(std::unique_lock<std::mutex> &lk,
                             const void *tok) {
     for (;;) {
@@ -508,7 +545,7 @@ struct accl_rt : public acclw::PoeSink {
       if (it == eager_landings.end()) return;
       if (it->second.in_use) {
         it->second.abort = true;
-        rx_cv.wait_for(lk, std::chrono::milliseconds(250));
+        cv_wait_for(rx_cv, lk, std::chrono::milliseconds(250));
         continue;
       }
       if (it->second.landed > 0 && it->second.landed < it->second.want)
@@ -519,7 +556,7 @@ struct accl_rt : public acclw::PoeSink {
   // sids whose seqn head may hold orphaned continuation segments of a
   // message whose recv died mid-consumption: seek discards segments with
   // msg_off != 0 until the next message head surfaces. Guarded by rx_mu.
-  std::set<uint32_t> rx_drain_srcs;
+  std::set<uint32_t> rx_drain_srcs;  // ACCL_GUARDED_BY(rx_mu)
 
   // Drop every rx-side claim a terminating call holds: its stream
   // ownership AND its outstanding-recv ticket (a dead elder must not
@@ -544,19 +581,20 @@ struct accl_rt : public acclw::PoeSink {
   std::condition_variable rx_cv;
 
   // rendezvous pending queues (CMD/STS_RNDZV(_PENDING) analog)
-  std::deque<RndzvAddr> addr_q;
-  std::deque<RndzvDone> done_q;
+  std::deque<RndzvAddr> addr_q;  // ACCL_GUARDED_BY(rndzv_mu)
+  std::deque<RndzvDone> done_q;  // ACCL_GUARDED_BY(rndzv_mu)
   // addresses this rank has posted via rendezvous_send_addr, keyed by
   // vaddr with the peer allowed to write them: the ONLY targets a
   // MSG_RNDZV_WRITE may land on (anything else is an arbitrary-write
   // attempt and is dropped)
-  std::deque<RndzvAddr> posted_addrs;  // src = the peer we posted to
+  std::deque<RndzvAddr> posted_addrs;  // ACCL_GUARDED_BY(rndzv_mu); src = the peer we posted to
   std::mutex rndzv_mu;
   std::condition_variable rndzv_cv;
 
   // per-(peer, lane) sequence numbers (ccl_offload_control.h:297-310),
   // indexed by sid — each lane is an independent ordered stream
-  std::vector<uint32_t> inbound_seq, outbound_seq;
+  std::vector<uint32_t> inbound_seq;   // ACCL_GUARDED_BY(rx_mu)
+  std::vector<uint32_t> outbound_seq;  // ACCL_ROLE_ONLY(seq)
 
   // call + retry queues and sequencer thread (run() analog). Calls on the
   // SAME communicator execute FIFO, one in flight at a time: the eager
@@ -566,17 +604,17 @@ struct accl_rt : public acclw::PoeSink {
   // freely — that is the disjoint-communicator concurrency the retry
   // queue exists for; OVERLAPPING groups at different table addresses
   // need distinct tags, the documented eager-wire contract.
-  std::map<uint32_t, uint32_t> inflight_comms;  // comm_addr -> started calls
-  std::deque<Call> call_q, retry_q;
+  std::map<uint32_t, uint32_t> inflight_comms;  // ACCL_GUARDED_BY(call_mu); comm_addr -> started calls
+  std::deque<Call> call_q, retry_q;  // ACCL_GUARDED_BY(call_mu)
   std::mutex call_mu;
   std::condition_variable call_cv;
   std::thread seq_thread;
-  std::map<int64_t, std::shared_ptr<Completion>> completions;
+  std::map<int64_t, std::shared_ptr<Completion>> completions;  // ACCL_GUARDED_BY(comp_mu)
   std::mutex comp_mu;
   std::condition_variable comp_cv;
-  int64_t next_handle = 1;
+  int64_t next_handle = 1;  // ACCL_GUARDED_BY(comp_mu)
 
-  uint64_t timeout_ms = 5000;
+  uint64_t timeout_ms = 5000;  // ACCL_ROLE_ONLY(seq); SC_CONFIG-mutable
 
   // ACCL_RT_STATS=1 diagnostics: sequencer behavior counters
   std::atomic<uint64_t> stat_passes{0}, stat_parks{0}, stat_park_ns{0},
@@ -589,12 +627,12 @@ struct accl_rt : public acclw::PoeSink {
   // perf-counter-next-to-the-data-plane posture of the CCLO's duration
   // registers, with the host draining after the fact
   // (accl_rt_trace_read -> emu_device.EmuRank.trace_read).
-  bool trace_on = false;
-  size_t trace_cap = 4096;
-  std::deque<accl_rt_span_t> trace_q;
-  uint64_t trace_dropped = 0;
+  bool trace_on = false;  // ACCL_INIT_CONST
+  size_t trace_cap = 4096;  // ACCL_INIT_CONST
+  std::deque<accl_rt_span_t> trace_q;  // ACCL_GUARDED_BY(trace_mu)
+  uint64_t trace_dropped = 0;  // ACCL_GUARDED_BY(trace_mu)
   std::mutex trace_mu;
-  std::chrono::steady_clock::time_point t_create =
+  std::chrono::steady_clock::time_point t_create =  // ACCL_INIT_CONST
       std::chrono::steady_clock::now();
 
   void record_span(const Call &c, uint32_t rc) {
@@ -627,7 +665,7 @@ struct accl_rt : public acclw::PoeSink {
   // allreduce/allgather (0 auto, 1 ring, 2 recursive halving/doubling):
   // the benchmark harness sweeps both to calibrate the crossover
   // (tools/rt_stats_sweep.py --shape).
-  int shape_override = 0;
+  int shape_override = 0;  // ACCL_INIT_CONST
 
   // BFM-style wire-fault injection (the reference test strategy drives
   // its DUT through a bus-functional model that can corrupt/delay
@@ -639,8 +677,8 @@ struct accl_rt : public acclw::PoeSink {
   //     message loses its final segment outright (datagram-transport
   //     loss semantics: the seqn gap must surface as a clean timeout).
   // One-shot by design: the fault arms once per runtime.
-  int fault_delay_tail_ms = 0;
-  bool fault_drop_tail = false;
+  int fault_delay_tail_ms = 0;  // ACCL_INIT_CONST
+  bool fault_drop_tail = false;  // ACCL_INIT_CONST
   //   ACCL_RT_FAULT_KILL_RANK=R       rank R wedges PERMANENTLY (not the
   //     one-shot tail levers above): after ACCL_RT_FAULT_KILL_AFTER=N
   //     completed data-plane calls (default 0 — the very next call dies)
@@ -654,7 +692,7 @@ struct accl_rt : public acclw::PoeSink {
   //     their own recv deadlines. accl_rt_kill() is the programmatic
   //     form (the fault-gate soak kills a rank mid-stream).
   std::atomic<bool> killed{false};
-  int kill_after_calls = -1;  // sequencer-thread only; -1 = unarmed
+  int kill_after_calls = -1;  // ACCL_ROLE_ONLY(seq); sequencer-thread only; -1 = unarmed
 
   void wedge() {
     killed.store(true, std::memory_order_release);
@@ -673,8 +711,8 @@ struct accl_rt : public acclw::PoeSink {
   // the bench's emulated 2-tier world is unshaped local-POE pods
   // (fast ICI tier) beside shaped TCP groups (slow DCN tier). The
   // local POE is never shaped — it IS the fast tier.
-  uint32_t wan_alpha_us = 0;
-  double wan_bytes_per_us = 0.0;
+  uint32_t wan_alpha_us = 0;  // ACCL_INIT_CONST
+  double wan_bytes_per_us = 0.0;  // ACCL_INIT_CONST
 
   void wan_charge(size_t payload_len) {
     if (!wan_alpha_us && wan_bytes_per_us <= 0) return;
@@ -706,39 +744,39 @@ struct accl_rt : public acclw::PoeSink {
   // the existing RECEIVE_TIMEOUT escalation, never an unbounded stall.
   // World-uniform: every rank of a world must run the same rely mode
   // (a rely-off sender's crc=0 frames fail a rely-on receiver's check).
-  bool rely_on = true;
+  bool rely_on = true;  // ACCL_INIT_CONST
   // the EFFECTIVE wire flag: rely_on, except on the in-process local
   // POE with no fault model armed — that "wire" is a synchronous
   // function call that cannot lose or corrupt frames, so CRC + retx
   // retention there is pure overhead protecting against nothing (both
   // sides of a local world share the process env, so the mode is
   // world-uniform by construction)
-  bool rely_wire = true;
-  bool debug_on = false;  // ACCL_RT_DEBUG, read once at create: wire
+  bool rely_wire = true;  // ACCL_INIT_CONST
+  bool debug_on = false;  // ACCL_INIT_CONST; ACCL_RT_DEBUG, read once at create: wire
                           // drop/tx prints are gated on this AND counted
                           // in stats, so a chaos soak never spams stderr
-  uint64_t retx_budget_bytes = 16ull << 20;  // per dst, oldest evicted
-  uint32_t nack_max = 24;                    // per-seqn attempt budget
+  uint64_t retx_budget_bytes = 16ull << 20;  // ACCL_INIT_CONST; per dst, oldest evicted
+  uint32_t nack_max = 24;                    // ACCL_INIT_CONST; per-seqn attempt budget
   // RetxFrame/RetxBuf/HeldFrame/WantState are the shared reliability
   // types (reliability.h); retention is BY REFERENCE — the FramePtr in
   // the retx buffer is the same serialized frame the wire shipped.
-  std::vector<RetxBuf> retx;  // per (dst, lane) sid; rely_mu
+  std::vector<RetxBuf> retx;  // ACCL_GUARDED_BY(rely_mu); per (dst, lane) sid; rely_mu
   // retransmits requested by peers, drained by the HEALTH thread: the
   // rx thread must never perform a blocking data-frame send itself —
   // two peers simultaneously retransmitting jumbo frames to each other
   // from their rx loops would stop draining their sockets while
   // blocked in send_all and mutually wedge both links (a liveness
   // hazard the pre-rely rx thread never had). rely_mu.
-  std::deque<FramePtr> retx_pending;  // dst + lane ride the header
-  std::unordered_map<uint32_t, HeldFrame> reorder_held;  // by sid; rely_mu
+  std::deque<FramePtr> retx_pending;  // ACCL_GUARDED_BY(rely_mu); dst + lane ride the header
+  std::unordered_map<uint32_t, HeldFrame> reorder_held;  // ACCL_GUARDED_BY(rely_mu); by sid; rely_mu
   std::mutex rely_mu;
   std::thread rely_thread;
   // receiver-side per-src want/ack state (rx_mu, like the rx state it
   // describes). want = the head seqn a consumer is provably waiting on
   // (recorded at seek miss); acked_upto = the last cumulative ack sent.
-  std::vector<WantState> want;  // per (src, lane) sid
-  std::vector<uint32_t> acked_upto;
-  std::vector<std::chrono::steady_clock::time_point> last_ack_t;
+  std::vector<WantState> want;  // ACCL_GUARDED_BY(rx_mu); per (src, lane) sid
+  std::vector<uint32_t> acked_upto;  // ACCL_GUARDED_BY(rx_mu)
+  std::vector<std::chrono::steady_clock::time_point> last_ack_t;  // ACCL_GUARDED_BY(rx_mu)
 
   // Seeded bus-functional fault model (generalizes the one-shot
   // DROP_TAIL/DELAY_TAIL levers; the reference drives its DUT through a
@@ -757,10 +795,10 @@ struct accl_rt : public acclw::PoeSink {
   // and retransmits ride clean, so repair always converges); drawn from
   // a per-runtime splitmix64 stream, so a given (seed, rank, frame
   // order) chaos run is reproducible.
-  double fault_loss_pct = 0, fault_corrupt_pct = 0;
-  double fault_dup_pct = 0, fault_reorder_pct = 0;
-  bool fault_pct_armed = false;
-  uint64_t rng_state = 0;
+  double fault_loss_pct = 0, fault_corrupt_pct = 0;  // ACCL_INIT_CONST
+  double fault_dup_pct = 0, fault_reorder_pct = 0;  // ACCL_INIT_CONST
+  bool fault_pct_armed = false;  // ACCL_INIT_CONST
+  uint64_t rng_state = 0;  // ACCL_GUARDED_BY(rng_mu)
   std::mutex rng_mu;
   double rng_u01() {  // splitmix64 -> [0, 1)
     std::lock_guard<std::mutex> g(rng_mu);
@@ -787,7 +825,7 @@ struct accl_rt : public acclw::PoeSink {
   std::atomic<uint32_t> fault_tail_dst{0};
 
   // intra-process POE (registry + pinning live in the LocalPoe)
-  bool local_mode = false;
+  bool local_mode = false;  // ACCL_INIT_CONST
 
   // Generation counter of rx-side progress events (eager landings,
   // rendezvous addresses/completions): the sequencer snapshots it before
@@ -886,7 +924,9 @@ struct accl_rt : public acclw::PoeSink {
   }
 
   // Memory-resident frame (the whole payload arrived with the header):
-  // the merged landing path of the in-process and datagram POEs.
+  // the merged landing path of the in-process and datagram POEs. The
+  // stream POE never produces mem-backed bodies (on_frame dispatches on
+  // body.data()), so tcp rx roles cannot enter.  // ACCL_POE(udp,local)
   bool on_frame_mem(uint32_t lane, const MsgHeader &h, const uint8_t *payload,
                     size_t plen) {
     if (stop.load()) return false;
@@ -1105,6 +1145,7 @@ struct accl_rt : public acclw::PoeSink {
   // has not produced, but a nack for one already in flight costs a
   // spurious retransmit+dup, so the bare-miss delay is deliberately
   // above ordinary host jitter. rx_mu held by the caller.
+  // ACCL_REQUIRES(rx_mu)
   void note_want_locked(uint32_t s, bool proven = false) {
     if (!rely_wire || s >= want.size()) return;
     WantState &w = want[s];
@@ -1376,6 +1417,10 @@ struct accl_rt : public acclw::PoeSink {
       rx_slots.emplace_back();
       idx = rx_slots.size() - 1;
     } else {
+      // last-resort backpressure past 2^20 slots: park the rx thread
+      // until the sequencer frees a slot; stop wakes it, so teardown
+      // cannot wedge (the alternative is dropping frames).
+      // ACCL_ALLOW(ACCLN101: rx backpressure park past the 2^20-slot ring cap; woken by stop)
       rx_cv.wait(lk, [&] { return stop.load() || !idle_q.empty(); });
       if (stop.load()) return false;
       idx = idle_q.back();
@@ -1589,6 +1634,8 @@ struct accl_rt : public acclw::PoeSink {
         }
       }
       if (dest) {
+        // only ever invoked under rndzv_mu (pin-check / unpin /
+        // completion scopes below)  // ACCL_REQUIRES(rndzv_mu)
         auto find_mine = [&]() -> RndzvAddr * {
           for (auto &pa : posted_addrs)
             if (pa.vaddr == h.vaddr && pa.src == h.src &&
@@ -1815,12 +1862,14 @@ struct accl_rt : public acclw::PoeSink {
             // fault_tail_pending release/acquire pair: any egr_send
             // that could advance the counter observes pending==true
             // first and aborts, so a racing write cannot exist.
+            // ACCL_ALLOW(ACCLN103: fault-thread read ordered by the fault_tail_pending release/acquire pair)
             if (outbound_seq[sid(dst, lane)] != seqn + 1) {
               fprintf(stderr,
                       "[r%u] FATAL: ACCL_RT_FAULT_DELAY_TAIL_MS wire-order "
                       "violation at delivery: outbound_seq[r%u]=%u advanced "
                       "past the delayed tail seqn=%u before the helper "
                       "thread delivered it\n",
+                      // ACCL_ALLOW(ACCLN103: same release/acquire-ordered read, echoed in the abort message)
                       rank, dst, outbound_seq[sid(dst, lane)], seqn);
               abort();
             }
@@ -1863,6 +1912,7 @@ struct accl_rt : public acclw::PoeSink {
   //    match) -> DMA_TAG_MISMATCH_ERROR. The non-strict SC_RECV retry
   //    path keeps NOT_READY there, because another parked recv with the
   //    matching tag may legally consume the head first.
+  // ACCL_REQUIRES(rx_mu)
   uint32_t seek_locked(uint32_t src, uint32_t lane, uint32_t tag,
                        uint8_t *ptr, uint64_t cap, uint64_t *got,
                        bool strict_tag = false, bool msg_start = false,
@@ -1958,6 +2008,7 @@ struct accl_rt : public acclw::PoeSink {
   // head (msg_off == 0) surfaces, then resume normal matching. Runs at
   // the top of seek AND before the SC_RECV elder-pairing check, so FIFO
   // eligibility is always judged against the true next message head.
+  // ACCL_REQUIRES(rx_mu)
   void drain_orphans_locked(uint32_t s_id) {
     while (rx_drain_srcs.count(s_id)) {
       auto dit = rx_index.find(rx_key(s_id, inbound_seq[s_id]));
@@ -2032,6 +2083,7 @@ struct accl_rt : public acclw::PoeSink {
     }
   }
 
+  // ACCL_REQUIRES(rx_mu)
   void release_slot_locked(size_t i) {
     RxSlot &s = rx_slots[i];
     s.status = RxSlot::IDLE;
@@ -2099,6 +2151,7 @@ struct accl_rt : public acclw::PoeSink {
   // clearing in_use, diverting the rest of the payload to scratch — the
   // target buffer is never written after this returns. The cv wait
   // drops the lock, so the scan restarts after each wakeup.
+  // ACCL_REQUIRES(rndzv_mu)
   void revoke_posted_locked(std::unique_lock<std::mutex> &lk, uint32_t src,
                             uint64_t vaddr, uint64_t bytes, uint32_t tag) {
     for (;;) {
@@ -2110,7 +2163,7 @@ struct accl_rt : public acclw::PoeSink {
       if (it == posted_addrs.end()) return;
       if (it->in_use) {
         it->abort = true;
-        rndzv_cv.wait_for(lk, std::chrono::milliseconds(250));
+        cv_wait_for(rndzv_cv, lk, std::chrono::milliseconds(250));
         continue;
       }
       posted_addrs.erase(it);
@@ -3351,7 +3404,7 @@ struct accl_rt : public acclw::PoeSink {
           // spurious wakeups/s stole the core from the threads moving
           // data (rt_stats parks ~= seek_miss signature); 2 ms keeps
           // the backstop while the predicate does the real waking.
-          rx_cv.wait_for(lk, std::chrono::milliseconds(2), [&] {
+          cv_wait_for(rx_cv, lk, std::chrono::milliseconds(2), [&] {
             return stop.load() ||
                    rx_events.load(std::memory_order_acquire) != ev0;
           });
@@ -3388,6 +3441,25 @@ struct accl_rt : public acclw::PoeSink {
   }
 };
 
+// glibc's std::mutex is zero-initialized — no pthread_mutex_init call —
+// so ThreadSanitizer never observes a mutex's construction. If the heap
+// block previously hosted a pthread mutex that WAS destroyed (the Python
+// host destroys them constantly), the stale "destroyed" sync state
+// suppresses lock-based happens-before and every guarded access pair
+// reports as a false race. Announce each runtime mutex's birth.
+#if defined(__SANITIZE_THREAD__)
+extern "C" void __tsan_mutex_create(void *addr, unsigned flags);
+static void tsan_announce_mutexes(accl_rt *rt) {
+  for (std::mutex *m :
+       {&rt->exch_mu, &rt->hello_mu, &rt->rx_mu, &rt->rndzv_mu, &rt->call_mu,
+        &rt->comp_mu, &rt->trace_mu, &rt->fault_mu, &rt->rely_mu,
+        &rt->rng_mu})
+    __tsan_mutex_create(m, 0);
+}
+#else
+static void tsan_announce_mutexes(accl_rt *) {}
+#endif
+
 // ---------------------------------------------------------------------------
 // C API
 // ---------------------------------------------------------------------------
@@ -3399,6 +3471,7 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
                              uint32_t rx_buf_bytes, uint32_t max_eager_bytes,
                              uint64_t max_rndzv_bytes, uint32_t transport) {
   auto *rt = new accl_rt();
+  tsan_announce_mutexes(rt);
   rt->world = world;
   rt->rank = rank;
   rt->rx_buf_bytes = rx_buf_bytes;
@@ -3573,7 +3646,7 @@ accl_rt_t *accl_rt_create_ex(uint32_t world, uint32_t rank,
       for (uint32_t i : missing)
         rt->frame_out(i, MSG_HELLO, 0, 0, 0, 0, nullptr, 0);
       std::unique_lock<std::mutex> lk(rt->hello_mu);
-      rt->hello_cv.wait_for(lk, std::chrono::milliseconds(50));
+      cv_wait_for(rt->hello_cv, lk, std::chrono::milliseconds(50));
     }
     rt->seq_thread = std::thread([rt] { rt->sequencer(); });
     start_rely(rt);
@@ -3606,14 +3679,14 @@ void accl_rt_destroy(accl_rt_t *rt) {
   rt->rx_cv.notify_all();
   rt->rndzv_cv.notify_all();
   rt->hello_cv.notify_all();
-  // tear the wire down first: begin_shutdown unblocks the POE's rx
-  // loops (closes links / pokes the datagram socket / deregisters from
-  // the in-process registry and drains deliveries pinned on this
-  // runtime), join reaps them — after this no sink call is in flight
-  if (rt->poe) {
-    rt->poe->begin_shutdown();
-    rt->poe->join();
-  }
+  // tear the wire down first: begin_shutdown revokes the sockets and
+  // unblocks the POE's rx loops (shutdown()/self-poke/registry
+  // deregistration) — senders see the revoked fds and fail fast
+  if (rt->poe) rt->poe->begin_shutdown();
+  // reap the runtime's own sender threads BEFORE Poe::join closes the
+  // revoked fds: the rely/sequencer threads may still be inside a
+  // send syscall on an fd they loaded before revocation, and closing
+  // under them would hand the descriptor number to a concurrent open
   if (rt->seq_thread.joinable()) rt->seq_thread.join();
   if (rt->rely_thread.joinable()) rt->rely_thread.join();
   {
@@ -3621,6 +3694,9 @@ void accl_rt_destroy(accl_rt_t *rt) {
     for (auto &t : rt->fault_threads)
       if (t.joinable()) t.join();
   }
+  // now reap the rx loops and close the deferred fds — after this no
+  // sink call is in flight
+  if (rt->poe) rt->poe->join();
   if (getenv("ACCL_RT_STATS"))
     fprintf(stderr,
             "[r%u] stats: passes=%llu parks=%llu park_ms=%.1f "
@@ -3681,7 +3757,8 @@ int accl_rt_wait(accl_rt_t *rt, int64_t handle, uint64_t timeout_ms) {
     rt->comp_cv.wait(lk, pred);
     return 1;
   }
-  return rt->comp_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)
+  return cv_wait_for(rt->comp_cv, lk, std::chrono::milliseconds(timeout_ms),
+                     pred)
              ? 1
              : 0;
 }

@@ -232,6 +232,34 @@ struct PoeStats {
 // kernel's IOV_MAX/UIO_MAXIOV of 1024)
 constexpr size_t MAX_IOV = 512;
 
+// glibc's std::mutex never calls pthread_mutex_init, so ThreadSanitizer
+// misses mutex construction; heap reuse over a previously-destroyed
+// pthread mutex then poisons happens-before tracking (see the twin note
+// in runtime.cpp). Announce heap-allocated transport mutexes explicitly.
+#if defined(__SANITIZE_THREAD__)
+extern "C" void __tsan_mutex_create(void *addr, unsigned flags);
+static void tsan_fresh_mutex(std::mutex &m) { __tsan_mutex_create(&m, 0); }
+#else
+static void tsan_fresh_mutex(std::mutex &) {}
+#endif
+
+// Steady-clock cv.wait_until routes through pthread_cond_clockwait,
+// which gcc-10's libtsan does not intercept — the wait's internal
+// unlock/reacquire is invisible and poisons lock happens-before (see
+// the twin note on cv_wait_for in runtime.cpp). TSan builds convert
+// the remaining budget to a system-clock deadline, taking the
+// intercepted pthread_cond_timedwait path.
+static std::cv_status cv_wait_deadline(
+    std::condition_variable &cv, std::unique_lock<std::mutex> &lk,
+    std::chrono::steady_clock::time_point deadline) {
+#if defined(__SANITIZE_THREAD__)
+  return cv.wait_until(lk, std::chrono::system_clock::now() +
+                               (deadline - std::chrono::steady_clock::now()));
+#else
+  return cv.wait_until(lk, deadline);
+#endif
+}
+
 // ---------------------------------------------------------------------------
 // TCP POE: session full mesh, one ordered byte stream per (peer, lane)
 // ---------------------------------------------------------------------------
@@ -241,8 +269,11 @@ class TcpPoe final : public Poe {
   explicit TcpPoe(const PoeConfig &cfg)
       : cfg_(cfg),
         ports_(cfg.ports, cfg.ports + cfg.world),
-        fds_(cfg.world * cfg.lanes, -1),
-        tx_mu_(cfg.world * cfg.lanes) {}
+        fds_(cfg.world * cfg.lanes),
+        tx_mu_(cfg.world * cfg.lanes) {
+    for (auto &f : fds_) f.store(-1, std::memory_order_relaxed);
+    for (auto &m : tx_mu_) tsan_fresh_mutex(m);
+  }
   ~TcpPoe() override {
     begin_shutdown();
     join();
@@ -290,14 +321,15 @@ class TcpPoe final : public Poe {
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_tv, sizeof hello_tv);
         uint32_t hello[2];
         if (!recv_all(fd, hello, sizeof hello) || hello[0] >= world ||
-            hello[1] >= lanes || fds_[hello[0] * lanes + hello[1]] >= 0) {
+            hello[1] >= lanes ||
+            fds_[hello[0] * lanes + hello[1]].load() >= 0) {
           close(fd);
           continue;
         }
         struct timeval never{0, 0};
         setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &never, sizeof never);
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-        fds_[hello[0] * lanes + hello[1]] = fd;
+        fds_[hello[0] * lanes + hello[1]].store(fd);
         accepted++;
       }
     });
@@ -329,7 +361,7 @@ class TcpPoe final : public Poe {
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
         uint32_t hello[2] = {rank, lane};
         send_all(fd, hello, sizeof hello);
-        fds_[i * lanes + lane] = fd;
+        fds_[i * lanes + lane].store(fd);
       }
     }
     acceptor.join();
@@ -346,7 +378,7 @@ class TcpPoe final : public Poe {
                    size_t n) override {
     if (stop_.load()) return false;
     std::lock_guard<std::mutex> g(tx_mu_[dst * cfg_.lanes + lane]);
-    int fd = fds_[dst * cfg_.lanes + lane];
+    int fd = fds_[dst * cfg_.lanes + lane].load();
     if (fd < 0) return false;
     if (cfg_.debug)
       for (size_t i = 0; i < n; i++)
@@ -413,14 +445,20 @@ class TcpPoe final : public Poe {
 
   void begin_shutdown() override {
     if (stop_.exchange(true)) return;
-    for (int &fd : fds_)
+    // revoke + shutdown() only: the half-close unblocks rx loops parked
+    // in recv (they see EOF and exit). close() is deferred to join() so
+    // the descriptor number cannot be recycled by another thread's
+    // open while an rx loop is still blocked on it.
+    for (auto &f : fds_) {
+      int fd = f.exchange(-1);
       if (fd >= 0) {
         shutdown(fd, SHUT_RDWR);
-        close(fd);
-        fd = -1;
+        doomed_.push_back(fd);
       }
+    }
     if (listen_fd_ >= 0) {
-      close(listen_fd_);
+      shutdown(listen_fd_, SHUT_RDWR);
+      doomed_.push_back(listen_fd_);
       listen_fd_ = -1;
     }
   }
@@ -428,6 +466,8 @@ class TcpPoe final : public Poe {
   void join() override {
     for (auto &t : rx_threads_)
       if (t.joinable()) t.join();
+    for (int fd : doomed_) close(fd);
+    doomed_.clear();
   }
 
   uint32_t lanes() const override { return cfg_.lanes; }
@@ -439,7 +479,7 @@ class TcpPoe final : public Poe {
 
  private:
   void rx_loop(uint32_t peer, uint32_t lane) {
-    int fd = fds_[peer * cfg_.lanes + lane];
+    int fd = fds_[peer * cfg_.lanes + lane].load();
     // legacy cost model: one recv per header, one per payload; the
     // vectored path batches — a single large recv drains many frames
     // into the per-link buffer (the rx half of the syscalls-per-frame
@@ -506,14 +546,19 @@ class TcpPoe final : public Poe {
     return true;
   }
 
-  PoeConfig cfg_;
-  std::vector<uint16_t> ports_;
-  std::vector<int> fds_;          // per (peer, lane); self = -1
+  PoeConfig cfg_;                 // ACCL_INIT_CONST
+  std::vector<uint16_t> ports_;   // ACCL_INIT_CONST
+  // per (peer, lane); self = -1. Atomic: begin_shutdown revokes fds
+  // (-1 + close) while rx loops and senders read them.
+  std::vector<std::atomic<int>> fds_;
   std::vector<std::mutex> tx_mu_; // serialize frames per (peer, lane) link
   std::vector<std::thread> rx_threads_;
-  int listen_fd_ = -1;
+  int listen_fd_ = -1;            // ACCL_ROLE_ONLY(acceptor)
+  // fds revoked by begin_shutdown, closed by join() once the rx
+  // threads are reaped (shutdown-then-deferred-close teardown)
+  std::vector<int> doomed_;       // ACCL_ROLE_ONLY(fini)
   std::atomic<bool> stop_{false};
-  PoeSink *sink_ = nullptr;
+  PoeSink *sink_ = nullptr;       // ACCL_INIT_CONST
   PoeStats stats_;
 };
 
@@ -533,19 +578,20 @@ class UdpPoe final : public Poe {
 
   bool connect(PoeSink *sink) override {
     sink_ = sink;
-    fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+    fd_.store(socket(AF_INET, SOCK_DGRAM, 0));
+    int fd = fd_.load();
     int buf = 64 * 1024 * 1024;  // absorb bursts: the POE has no sessions
     // FORCE ignores net.core.rmem_max when privileged; fall back otherwise
-    if (setsockopt(fd_, SOL_SOCKET, SO_RCVBUFFORCE, &buf, sizeof buf))
-      setsockopt(fd_, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
-    setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
+    if (setsockopt(fd, SOL_SOCKET, SO_RCVBUFFORCE, &buf, sizeof buf))
+      setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
     sockaddr_in sa{};
     sa.sin_family = AF_INET;
     sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     sa.sin_port = htons(ports_[cfg_.rank]);
-    if (bind(fd_, (sockaddr *)&sa, sizeof sa) != 0) {
-      close(fd_);
-      fd_ = -1;
+    if (bind(fd, (sockaddr *)&sa, sizeof sa) != 0) {
+      close(fd);
+      fd_.store(-1);
       return false;
     }
     peer_sa_.resize(cfg_.world);
@@ -562,6 +608,7 @@ class UdpPoe final : public Poe {
   bool send_frames(uint32_t dst, uint32_t, const FrameView *fv,
                    size_t n) override {
     if (stop_.load()) return false;
+    const int fd = fd_.load();
     const sockaddr *to = (const sockaddr *)&peer_sa_[dst];
     if (cfg_.legacy_wire) {
       // pre-vectored cost model: stage header+payload into one packet
@@ -577,7 +624,7 @@ class UdpPoe final : public Poe {
           stats_.payload_copies += fv[i].payload_len;
         }
         stats_.tx_syscalls++;
-        ssize_t w = sendto(fd_, pkt.data(), pkt.size(), 0, to,
+        ssize_t w = sendto(fd, pkt.data(), pkt.size(), 0, to,
                            sizeof(sockaddr_in));
         if (w != (ssize_t)pkt.size()) return false;
       }
@@ -596,7 +643,7 @@ class UdpPoe final : public Poe {
       mh.msg_iov = iov;
       mh.msg_iovlen = fv[0].payload_len ? 2 : 1;
       stats_.tx_syscalls++;
-      return sendmsg(fd_, &mh, 0) ==
+      return sendmsg(fd, &mh, 0) ==
              (ssize_t)(sizeof(MsgHeader) + fv[0].payload_len);
     }
     // batch: many datagrams per syscall via sendmmsg, each message its
@@ -616,7 +663,7 @@ class UdpPoe final : public Poe {
     size_t sent = 0;
     while (sent < n) {
       stats_.tx_syscalls++;
-      int w = sendmmsg(fd_, mm.data() + sent, (unsigned)(n - sent), 0);
+      int w = sendmmsg(fd, mm.data() + sent, (unsigned)(n - sent), 0);
       if (w <= 0) return false;
       sent += (size_t)w;
     }
@@ -625,19 +672,25 @@ class UdpPoe final : public Poe {
 
   void begin_shutdown() override {
     if (stop_.exchange(true)) return;
-    if (fd_ >= 0) {
+    int fd = fd_.exchange(-1);
+    if (fd >= 0) {
       // wake the datagram rx thread: shutdown() is a no-op on
       // unconnected UDP sockets, so poke ourselves with a runt datagram
-      // (the rx loop re-checks `stop` on any short read), then close
-      sendto(fd_, "", 0, 0, (const sockaddr *)&peer_sa_[cfg_.rank],
+      // (the rx loop re-checks `stop` on any short read). close() is
+      // deferred to join() so the descriptor cannot be recycled while
+      // the rx thread is still blocked in recvfrom on it.
+      sendto(fd, "", 0, 0, (const sockaddr *)&peer_sa_[cfg_.rank],
              sizeof(sockaddr_in));
-      close(fd_);
-      fd_ = -1;
+      doomed_ = fd;
     }
   }
 
   void join() override {
     if (rx_thread_.joinable()) rx_thread_.join();
+    if (doomed_ >= 0) {
+      close(doomed_);
+      doomed_ = -1;
+    }
   }
 
   uint32_t lanes() const override { return 1; }
@@ -651,7 +704,8 @@ class UdpPoe final : public Poe {
   void rx_loop() {
     std::vector<uint8_t> pkt(sizeof(MsgHeader) + 65536);
     while (!stop_.load()) {
-      ssize_t n = recvfrom(fd_, pkt.data(), pkt.size(), 0, nullptr, nullptr);
+      ssize_t n =
+          recvfrom(fd_.load(), pkt.data(), pkt.size(), 0, nullptr, nullptr);
       if (n < (ssize_t)sizeof(MsgHeader)) {
         if (stop_.load()) return;
         continue;  // runt/interrupted
@@ -669,13 +723,18 @@ class UdpPoe final : public Poe {
     }
   }
 
-  PoeConfig cfg_;
-  std::vector<uint16_t> ports_;
-  std::vector<sockaddr_in> peer_sa_;
-  int fd_ = -1;
+  PoeConfig cfg_;                     // ACCL_INIT_CONST
+  std::vector<uint16_t> ports_;       // ACCL_INIT_CONST
+  std::vector<sockaddr_in> peer_sa_;  // ACCL_INIT_CONST
+  // atomic: begin_shutdown revokes the socket while the rx loop reads
+  // it for recvfrom
+  std::atomic<int> fd_{-1};
+  // socket revoked by begin_shutdown, closed by join() after the rx
+  // thread is reaped (shutdown-then-deferred-close teardown)
+  int doomed_ = -1;                   // ACCL_ROLE_ONLY(fini)
   std::thread rx_thread_;
   std::atomic<bool> stop_{false};
-  PoeSink *sink_ = nullptr;
+  PoeSink *sink_ = nullptr;           // ACCL_INIT_CONST
   PoeStats stats_;
 };
 
@@ -734,7 +793,8 @@ class LocalPoe final : public Poe {
           break;
         }
         if (stop_.load() ||
-            g_local_cv.wait_until(g, deadline) == std::cv_status::timeout)
+            cv_wait_deadline(g_local_cv, g, deadline) ==
+                std::cv_status::timeout)
           return false;
       }
     }
@@ -772,12 +832,13 @@ class LocalPoe final : public Poe {
   uint64_t payload_copies() const override { return 0; }
 
  private:
-  PoeConfig cfg_;
-  std::vector<uint16_t> ports_;
-  PoeSink *sink_ = nullptr;
+  PoeConfig cfg_;                // ACCL_INIT_CONST
+  std::vector<uint16_t> ports_;  // ACCL_INIT_CONST
+  PoeSink *sink_ = nullptr;      // ACCL_INIT_CONST
   std::atomic<bool> stop_{false};
-  bool registered_ = false;  // g_local_mu
-  int refs_ = 0;             // in-flight deliveries INTO us; g_local_mu
+  bool registered_ = false;  // ACCL_GUARDED_BY(g_local_mu)
+  // in-flight deliveries INTO us
+  int refs_ = 0;             // ACCL_GUARDED_BY(g_local_mu)
 };
 
 }  // namespace

@@ -1,0 +1,161 @@
+"""Native concurrency certifier tests (tools/native_check.py).
+
+The accl_lint posture applied to the C++ runtime: the fixture corpus is
+replayed with EXACT diagnosed-code-set equality, the live tree must
+certify clean, the lock-cycle witness must be rendered (worked-example
+style), and the reverted PR 14 rx-thread-blocking-send pattern is
+pinned as a corpus regression that trips ACCLN101.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+TOOL = REPO / "tools" / "native_check.py"
+CORPUS = REPO / "tools" / "native_lint_corpus"
+
+sys.path.insert(0, str(REPO / "tools"))
+import native_check  # noqa: E402
+
+HAVE_CINDEX = native_check.load_cindex() is not None
+needs_cindex = pytest.mark.skipif(
+    not HAVE_CINDEX, reason="libclang (clang.cindex) unavailable")
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(TOOL), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=600)
+
+
+def _fixture_model(name):
+    cindex = native_check.load_cindex()
+    return native_check.build_model(
+        cindex, [CORPUS / name], [str(native_check.NATIVE / "include")])
+
+
+# ---------------------------------------------------------------------------
+# corpus replay: exact-code equality, one fixture per rule
+# ---------------------------------------------------------------------------
+
+
+@needs_cindex
+def test_corpus_replays_clean():
+    """Every fixture is diagnosed with EXACTLY its // EXPECT set."""
+    r = _run("--corpus")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 mismatch(es)" in r.stdout
+
+
+def test_corpus_covers_every_rule():
+    """One known-bad fixture per semantic rule, plus a good twin —
+    the corpus is the rule set's pinned contract."""
+    expected = set()
+    for fx in CORPUS.glob("*.cpp"):
+        for m in native_check.EXPECT_RE.finditer(fx.read_text()):
+            expected |= {c.strip() for c in m.group(1).split(",") if c.strip()}
+    assert {"ACCLN101", "ACCLN102", "ACCLN103", "ACCLN104",
+            "ACCLN105"} <= expected
+    goods = [f for f in CORPUS.glob("*.cpp")
+             if not native_check.EXPECT_RE.search(f.read_text())]
+    assert len(goods) >= 4, "good twins keep the rules honest"
+
+
+@needs_cindex
+def test_pr14_rx_blocking_send_trips_accln101():
+    """Regression pin: the reverted PR 14 pattern — an rx thread
+    retransmitting through the blocking send path — is rejected with
+    ACCLN101 and the witness names the rx root and the call path."""
+    model = _fixture_model("bad_rx_blocking_send.cpp")
+    waivers = []
+    fx = CORPUS / "bad_rx_blocking_send.cpp"
+    diags = native_check.run_rules(model, {fx: fx.name}, waivers)
+    assert [d.code for d in diags] == ["ACCLN101"]
+    rendered = diags[0].render()
+    assert "send_all" in rendered
+    assert "rx root" in rendered
+    assert "rx_loop" in rendered and "retransmit" in rendered
+
+
+# ---------------------------------------------------------------------------
+# live tree: the certifier's own acceptance gate
+# ---------------------------------------------------------------------------
+
+
+@needs_cindex
+def test_live_tree_certifies_clean():
+    r = _run("--tree")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 diagnostic(s)" in r.stdout
+    # waivers are visible claims, never silent: the known rx
+    # backpressure park must be REPORTED even though it is allowed
+    assert "[waiver]" in r.stdout
+    assert "ACCLN101 waived" in r.stdout
+
+
+@needs_cindex
+def test_live_tree_finds_thread_roots_and_roles():
+    """Role inference sees the real roots: the tcp/udp rx loops, the
+    sequencer, the reliability tick, and the tcp acceptor."""
+    cindex = native_check.load_cindex()
+    model = native_check.build_model(
+        cindex, native_check.TREE_TUS,
+        [str(native_check.NATIVE / "include")])
+    assert not model.parse_errors
+    roles = {r.role for r in model.roots}
+    assert {"rx", "seq", "rely", "acceptor"} <= roles
+    engines = {r.engine for r in model.roots if r.role == "rx"}
+    assert {"tcp", "udp"} <= engines
+
+
+# ---------------------------------------------------------------------------
+# lock-cycle witness rendering
+# ---------------------------------------------------------------------------
+
+
+@needs_cindex
+def test_lock_cycle_witness_renders_the_cycle():
+    """ACCLN102's diagnostic is a worked example: the mutex cycle plus
+    one held-at-acquisition site per edge."""
+    model = _fixture_model("bad_lock_cycle.cpp")
+    diags = native_check.check_lock_order(model, [])
+    assert len(diags) == 1 and diags[0].code == "ACCLN102"
+    rendered = diags[0].render()
+    # the cycle chain names both mutexes and returns to its start
+    assert "Runtime::call_mu" in rendered
+    assert "Runtime::comp_mu" in rendered
+    assert "->" in rendered
+    # each edge carries its witness site (file:line in a function)
+    assert "flush" in rendered and "requeue" in rendered
+    assert "bad_lock_cycle.cpp" in rendered
+
+
+@needs_cindex
+def test_live_tree_lock_graph_is_acyclic():
+    cindex = native_check.load_cindex()
+    model = native_check.build_model(
+        cindex, native_check.TREE_TUS,
+        [str(native_check.NATIVE / "include")])
+    assert native_check.check_lock_order(model, []) == []
+
+
+# ---------------------------------------------------------------------------
+# seam mode: the `make -C native seamcheck` wrapper needs no libclang
+# ---------------------------------------------------------------------------
+
+
+def test_seam_mode_runs_without_libclang():
+    r = _run("--seam")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_seam_rules_reject_reliability_symbols_textually():
+    diags = native_check.check_seam(
+        {CORPUS / "bad_seam_symbol.cpp": "transport.cpp"})
+    assert diags and all(d.code == "ACCLN104" for d in diags)
+    blob = "\n".join(d.render() for d in diags)
+    assert "crc32c" in blob

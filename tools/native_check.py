@@ -1,0 +1,1477 @@
+#!/usr/bin/env python3
+"""native_check.py — static concurrency certifier for the native runtime.
+
+The C++ twin of tools/accl_lint.py: a libclang pass over the three
+native translation units behind the POE seam
+(native/src/{runtime,transport,reliability}.cpp + headers) that emits
+stable ACCLN1xx diagnostics. The Python linter certifies descriptor
+batches before dispatch; this tool certifies the layer those proofs
+stand on — the threaded C++ runtime itself — at commit time instead of
+debug time (the two worst native bugs to date, PR 14's rx-thread
+blocking retransmit and PR 13's reconfiguration fence, were both
+concurrency hazards found by review/fuzz, not tooling).
+
+Rules (docs/lint.md has the full table + worked examples):
+
+  ACCLN100  infrastructure: a TU failed to parse (never silently skipped)
+  ACCLN101  rx no-blocking: a function that can block UNBOUNDED on a
+            peer (send_all / writev_all flush loops, unbounded
+            condition_variable::wait, poll(-1), kernel connect/accept)
+            is reachable from an rx-thread role. Bounded waits
+            (wait_for / wait_until / poll with a finite timeout) and
+            kernel-bounded datagram sends are allowed — the rule is
+            about PEER-bounded blocking, the PR 14 mutual-wedge class.
+  ACCLN102  lock-order acyclicity: the global lock graph (intra-
+            procedural lock_guard/unique_lock nesting + locks acquired
+            transitively through calls made while holding) must be
+            acyclic. The witness cycle is rendered in the diagnostic.
+            Self-edges (re-acquiring a held std::mutex) are cycles too.
+  ACCLN103  guarded fields: every non-atomic, non-const shared field of
+            the audited structs (accl_rt, TcpPoe, UdpPoe, LocalPoe)
+            must carry an annotation, and every access must honor it:
+              // ACCL_GUARDED_BY(mu)    access only while holding mu
+              // ACCL_INIT_CONST        written only during init roles
+              // ACCL_ROLE_ONLY(role)   accessed only by that role
+            Functions may declare // ACCL_REQUIRES(mu): callers must
+            hold mu (checked) and the body analyzes as holding it.
+  ACCLN104  seam rules: the shell-grep seamcheck absorbed as data —
+            transport.cpp must not include reliability.h nor reference
+            session-side reliability symbols (the POE seam carries
+            already-built frames only).
+  ACCLN105  rx prints: no fprintf/std::cerr reachable from an rx-thread
+            role outside an if gated on the cached debug flag (a chaos
+            soak must never turn the rx loop into a logging loop).
+
+Thread roles are inferred from the real roots, never declared:
+  - lambdas handed to std::thread, classified by the member/variable
+    that owns the thread (rx_threads_/rx_thread_ -> rx, seq_thread ->
+    seq, rely_thread -> rely, fault_threads -> fault, a local
+    `std::thread acceptor(..)` -> acceptor)
+  - public accl_rt_* entry points (create* -> init, destroy -> fini,
+    everything else -> api)
+and propagated over the call graph. Propagation is ENGINE-AWARE: a
+role that enters a Poe engine class (TcpPoe/UdpPoe/LocalPoe) carries
+that engine tag, and virtual Poe calls resolve only to the tagged
+engine's overrides — one runtime holds exactly one engine, so an rx
+role rooted in UdpPoe can never reach TcpPoe::send_frames. Functions
+may restrict which engines' roles enter them with // ACCL_POE(udp,local)
+(e.g. the mem-backed landing path the stream POE never calls).
+
+Site-level waivers: // ACCL_ALLOW(ACCLN101: reason) on the flagged
+line suppresses that diagnostic and is REPORTED in --tree output — a
+waiver is a visible, auditable claim, never a silent hole.
+
+Usage:
+  native_check.py --tree           certify the live native sources
+  native_check.py --corpus [DIR]   replay the fixture corpus (default
+                                   tools/native_lint_corpus/): every
+                                   fixture's diagnosed code set must
+                                   EXACTLY equal its // EXPECT set
+  native_check.py --seam           ACCLN104 only (the `make -C native
+                                   seamcheck` wrapper; no libclang)
+
+Exit status 0 only when every expectation holds — the CI lint job runs
+`native_check.py --corpus --tree` as a gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = REPO / "native"
+DEFAULT_CORPUS = pathlib.Path(__file__).resolve().parent / "native_lint_corpus"
+TREE_TUS = [
+    NATIVE / "src" / "runtime.cpp",
+    NATIVE / "src" / "transport.cpp",
+    NATIVE / "src" / "reliability.cpp",
+]
+
+# ---------------------------------------------------------------------------
+# Rule data (the tool's "registers": every list here is policy, not code)
+# ---------------------------------------------------------------------------
+
+# thread-owning member -> role of the lambda handed to it
+THREAD_MEMBER_ROLES = {
+    "rx_threads_": "rx",
+    "rx_thread_": "rx",
+    "seq_thread": "seq",
+    "rely_thread": "rely",
+    "fault_threads": "fault",
+}
+# local std::thread variables keep their own name as the role
+# (the TCP acceptor); anything unrecognized becomes role "thread"
+
+# Poe engine classes: a role entering one carries its tag, and virtual
+# Poe calls resolve only to the tagged engine (one runtime, one engine)
+ENGINE_TAGS = {"TcpPoe": "tcp", "UdpPoe": "udp", "LocalPoe": "local"}
+
+# in-tree flush loops that block until the PEER drains (ACCLN101)
+BLOCKING_FREE_FNS = {"send_all", "writev_all"}
+# kernel calls that block on a peer (poll handled separately: only the
+# infinite -1 timeout is peer-bounded)
+BLOCKING_SYS_FNS = {"connect", "accept"}
+# roles forbidden to reach blocking sites (the rx loops must always
+# drain their sockets; seq/rely/fault/api are senders and may block)
+NO_BLOCK_ROLES = {"rx"}
+# single-threaded phases: accesses there need no locks (threads either
+# don't exist yet or are already joined)
+INIT_ROLES = {"init"}
+FINI_ROLES = {"fini"}
+
+# structs whose every shared field must be annotated (ACCLN103); corpus
+# fixtures extend this with // ACCL_AUDITED class markers
+AUDITED_CLASSES = {"accl_rt", "TcpPoe", "UdpPoe", "LocalPoe"}
+# field types that are their own synchronization (or the primitives);
+# PoeStats is the transport's all-atomic counter block (transport.h)
+EXEMPT_TYPE_RE = re.compile(
+    r"atomic|mutex|condition_variable|\bthread\b|std::thread|\bPoeStats\b")
+# container methods that mutate (write-classification for ROLE_ONLY /
+# INIT_CONST fields of container type)
+MUTATING_METHODS = {
+    "push_back", "emplace_back", "pop_back", "pop_front", "push_front",
+    "clear", "resize", "erase", "insert", "emplace", "assign", "reserve",
+}
+
+# ACCLN104: the seamcheck grep, as data. `file` matches the basename.
+SEAM_RULES = [
+    {
+        "file": "transport.cpp",
+        "forbid_include": r'#\s*include\s*"reliability',
+        "reason": "the POE seam carries already-built frames: transport "
+                  "must not include reliability internals",
+    },
+    {
+        "file": "transport.cpp",
+        "forbid_symbols": ["crc32c", "frame_crc", "RetxBuf", "RetxFrame",
+                           "HeldFrame", "WantState"],
+        "reason": "CRC and retransmit retention are session-side policy "
+                  "above the seam",
+    },
+]
+
+ANNOT_RE = re.compile(
+    r"ACCL_(GUARDED_BY|REQUIRES|INIT_CONST|ROLE_ONLY|POE|ALLOW|AUDITED)"
+    r"(?:\(([^)]*)\))?")
+EXPECT_RE = re.compile(r"//\s*EXPECT:\s*([A-Z0-9,\s]+)")
+AS_FILE_RE = re.compile(r"//\s*AS_FILE:\s*(\S+)")
+
+
+# ---------------------------------------------------------------------------
+# libclang bring-up
+# ---------------------------------------------------------------------------
+
+def _gcc_include_dirs() -> list[str]:
+    """System C++ include paths from the host g++ (libclang's pip wheel
+    ships no builtin headers, so we hand it gcc's search list)."""
+    try:
+        out = subprocess.run(
+            ["g++", "-E", "-v", "-x", "c++", "-"], input="",
+            capture_output=True, text=True, timeout=30).stderr
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    dirs, active = [], False
+    for ln in out.splitlines():
+        if ln.startswith("#include <...>"):
+            active = True
+        elif ln.startswith("End of search"):
+            active = False
+        elif active and ln.startswith(" "):
+            dirs.append(ln.strip())
+    return dirs
+
+
+def clang_args(extra_includes: list[str] | None = None) -> list[str]:
+    args = ["-x", "c++", "-std=c++17", "-nostdinc", "-nostdinc++"]
+    for d in _gcc_include_dirs():
+        args += ["-I", d]
+    for d in extra_includes or []:
+        args += ["-I", d]
+    return args
+
+
+def load_cindex():
+    try:
+        from clang import cindex
+        cindex.Index.create()
+        return cindex
+    except Exception as e:  # pragma: no cover - environment-specific
+        print(f"native_check: libclang unavailable ({e})", file=sys.stderr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Source annotations (trailing comments on the declaration line or the
+# line above; read straight from the file, not the AST)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FileAnnotations:
+    # line -> list of (kind, arg)
+    by_line: dict[int, list[tuple[str, str]]] = field(default_factory=dict)
+
+    def at(self, line: int, kind: str) -> str | None:
+        """Annotation of `kind` on `line` or the line above; the arg
+        (possibly empty) or None."""
+        for ln in (line, line - 1):
+            for k, a in self.by_line.get(ln, []):
+                if k == kind:
+                    return a
+        return None
+
+    def field_annotation(self, line: int) -> tuple[str, str] | None:
+        """First field annotation on `line`, else on the line above —
+        the decl line always wins, so adjacent fields with different
+        guards never capture each other's annotation."""
+        for ln in (line, line - 1):
+            for k, a in self.by_line.get(ln, []):
+                if k in ("GUARDED_BY", "INIT_CONST", "ROLE_ONLY"):
+                    return (k, a)
+        return None
+
+    def allow(self, line: int, code: str) -> str | None:
+        """ACCL_ALLOW(<code>: reason) waiver covering `line`."""
+        for ln in (line, line - 1):
+            for k, a in self.by_line.get(ln, []):
+                if k == "ALLOW" and a.split(":", 1)[0].strip() == code:
+                    return (a.split(":", 1)[1].strip()
+                            if ":" in a else "(no reason)")
+        return None
+
+
+def read_annotations(path: pathlib.Path) -> FileAnnotations:
+    fa = FileAnnotations()
+    try:
+        text = path.read_text()
+    except OSError:
+        return fa
+    for i, ln in enumerate(text.splitlines(), start=1):
+        if "ACCL_" not in ln:
+            continue
+        comment = ln.split("//", 1)
+        if len(comment) < 2:
+            continue
+        for m in ANNOT_RE.finditer(comment[1]):
+            fa.by_line.setdefault(i, []).append(
+                (m.group(1), (m.group(2) or "").strip()))
+    return fa
+
+
+# ---------------------------------------------------------------------------
+# Model: what one pass over the ASTs extracts
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LockEvent:
+    mutex: str          # canonical: Class::member or bare global name
+    offset: int
+    line: int
+    file: str
+    held: tuple[str, ...]  # mutexes already held at this acquisition
+
+
+@dataclass
+class CallSite:
+    targets: tuple[str, ...]  # candidate callee USRs (virtual -> many)
+    name: str
+    line: int
+    file: str
+    offset: int
+    held: tuple[str, ...]
+
+
+@dataclass
+class BlockSite:
+    what: str           # human description of the blocking primitive
+    line: int
+    file: str
+
+
+@dataclass
+class PrintSite:
+    what: str
+    line: int
+    file: str
+    debug_gated: bool
+
+
+@dataclass
+class FieldAccess:
+    cls: str
+    fld: str
+    line: int
+    file: str
+    held: tuple[str, ...]
+    write: bool
+
+
+@dataclass
+class FuncInfo:
+    usr: str
+    name: str            # display name (Class::method or lambda@file:line)
+    cls: str | None      # enclosing class name ('' for free functions)
+    file: str
+    line: int
+    requires: tuple[str, ...] = ()
+    poe_only: tuple[str, ...] = ()   # ACCL_POE engine restriction
+    calls: list[CallSite] = field(default_factory=list)
+    locks: list[LockEvent] = field(default_factory=list)
+    blocking: list[BlockSite] = field(default_factory=list)
+    prints: list[PrintSite] = field(default_factory=list)
+    accesses: list[FieldAccess] = field(default_factory=list)
+
+
+@dataclass
+class FieldInfo:
+    cls: str
+    name: str
+    type_spelling: str
+    file: str
+    line: int
+    annotation: tuple[str, str] | None   # (kind, arg)
+    exempt: bool
+
+
+@dataclass
+class ThreadRoot:
+    usr: str             # the lambda's synthetic USR
+    role: str
+    engine: str | None
+    file: str
+    line: int
+
+
+@dataclass
+class Model:
+    funcs: dict[str, FuncInfo] = field(default_factory=dict)
+    fields: dict[tuple[str, str], FieldInfo] = field(default_factory=dict)
+    roots: list[ThreadRoot] = field(default_factory=list)
+    # virtual base method USR -> override USRs (by name across hierarchy)
+    overrides: dict[str, list[str]] = field(default_factory=dict)
+    cls_of_usr: dict[str, str] = field(default_factory=dict)
+    audited: set[str] = field(default_factory=set)
+    annotations: dict[str, FileAnnotations] = field(default_factory=dict)
+    parse_errors: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Diag:
+    code: str
+    file: str
+    line: int
+    message: str
+    detail: list[str] = field(default_factory=list)
+
+    def render(self) -> str:
+        head = f"{self.code} {self.file}:{self.line}: {self.message}"
+        return "\n".join([head] + [f"    {d}" for d in self.detail])
+
+
+# ---------------------------------------------------------------------------
+# AST extraction
+# ---------------------------------------------------------------------------
+
+class Extractor:
+    """One walk per TU. Tracks, along the (source-ordered) preorder
+    walk: active lock guards with linear unlock()/lock() toggling,
+    enclosing-if debug gating, assignment-LHS write context, and lambda
+    boundaries (a lambda body is its own function; guards never leak
+    across — the body runs later, under the callee's locks)."""
+
+    def __init__(self, cindex, model: Model, tree_files: set[str]):
+        self.ci = cindex
+        self.K = cindex.CursorKind
+        self.model = model
+        self.tree_files = tree_files
+
+    # -- helpers ------------------------------------------------------------
+
+    def _file_of(self, cursor) -> str | None:
+        f = cursor.location.file
+        return f.name if f else None
+
+    def _in_tree(self, cursor) -> bool:
+        f = self._file_of(cursor)
+        return f is not None and f in self.tree_files
+
+    def _annot(self, cursor) -> FileAnnotations:
+        f = self._file_of(cursor)
+        return self.model.annotations.setdefault(
+            f, read_annotations(pathlib.Path(f))) if f else FileAnnotations()
+
+    def _tokens(self, cursor) -> list[str]:
+        try:
+            return [t.spelling for t in cursor.get_tokens()]
+        except Exception:
+            return []
+
+    def _mutex_name(self, cursor) -> str | None:
+        """Canonical name of the mutex expression inside a guard ctor:
+        Class::member for member mutexes (an indexed tx_mu_[i] vector
+        collapses onto one node — every element orders identically),
+        the bare spelling for globals/locals."""
+        K = self.K
+        for c in cursor.walk_preorder():
+            if c.kind == K.MEMBER_REF_EXPR and c.referenced is not None:
+                par = c.referenced.semantic_parent
+                cls = par.spelling if par is not None else ""
+                return f"{cls}::{c.spelling}" if cls else c.spelling
+            if c.kind == K.DECL_REF_EXPR and c.referenced is not None:
+                if "mutex" in (c.referenced.type.spelling or ""):
+                    return c.spelling
+        return None
+
+    def _callee(self, call):
+        try:
+            return call.referenced
+        except Exception:
+            return None
+
+    def _expand_virtual(self, ref) -> tuple[str, ...]:
+        usr = ref.get_usr()
+        targets = [usr]
+        targets += self.model.overrides.get(usr, [])
+        return tuple(dict.fromkeys(targets))
+
+    # -- pass 1: classes, fields, hierarchy, overrides ----------------------
+
+    def scan_classes(self, tu_cursor):
+        K = self.K
+        bases: dict[str, list[str]] = {}
+        methods: dict[str, list] = {}  # class -> method cursors
+
+        def scan(c):
+            if c.kind in (K.STRUCT_DECL, K.CLASS_DECL) and c.is_definition():
+                if self._in_tree(c):
+                    self._scan_class(c, bases, methods)
+            for ch in c.get_children():
+                if ch.kind in (K.NAMESPACE, K.STRUCT_DECL, K.CLASS_DECL,
+                               K.UNEXPOSED_DECL, K.LINKAGE_SPEC):
+                    scan(ch)
+        scan(tu_cursor)
+
+        # name-based override resolution (this binding exposes no
+        # get_overridden_cursors): derived method overrides any virtual
+        # same-named method of a transitive base
+        def all_bases(cls, seen=None):
+            seen = seen or set()
+            for b in bases.get(cls, []):
+                if b not in seen:
+                    seen.add(b)
+                    all_bases(b, seen)
+            return seen
+
+        virt: dict[tuple[str, str], str] = {}
+        for cls, ms in methods.items():
+            for m in ms:
+                if m.is_virtual_method():
+                    virt[(cls, m.spelling)] = m.get_usr()
+        for cls, ms in methods.items():
+            for m in ms:
+                for b in all_bases(cls):
+                    busr = virt.get((b, m.spelling))
+                    if busr and busr != m.get_usr():
+                        self.model.overrides.setdefault(busr, []).append(
+                            m.get_usr())
+
+    def _scan_class(self, c, bases, methods):
+        K = self.K
+        cls = c.spelling
+        fa = self._annot(c)
+        if fa.at(c.location.line, "AUDITED") is not None:
+            self.model.audited.add(cls)
+        for ch in c.get_children():
+            if ch.kind == K.CXX_BASE_SPECIFIER:
+                for t in ch.get_children():
+                    if t.kind == K.TYPE_REF and t.referenced is not None:
+                        bases.setdefault(cls, []).append(
+                            t.referenced.spelling)
+            elif ch.kind in (K.CXX_METHOD, K.CONSTRUCTOR, K.DESTRUCTOR):
+                methods.setdefault(cls, []).append(ch)
+                self.model.cls_of_usr[ch.get_usr()] = cls
+            elif ch.kind == K.FIELD_DECL:
+                ty = ch.type.spelling or ""
+                fann = fa.field_annotation(ch.location.line)
+                exempt = (bool(EXEMPT_TYPE_RE.search(ty))
+                          or ty.startswith("const ")
+                          or ch.type.is_const_qualified())
+                key = (cls, ch.spelling)
+                if key not in self.model.fields:
+                    self.model.fields[key] = FieldInfo(
+                        cls, ch.spelling, ty, self._file_of(ch) or "?",
+                        ch.location.line, fann, exempt)
+            elif ch.kind in (K.STRUCT_DECL, K.CLASS_DECL) and \
+                    ch.is_definition():
+                self._scan_class(ch, bases, methods)
+
+    # -- pass 2: function bodies -------------------------------------------
+
+    def scan_functions(self, tu_cursor):
+        K = self.K
+
+        def scan(c):
+            if c.kind in (K.FUNCTION_DECL, K.CXX_METHOD, K.CONSTRUCTOR,
+                          K.DESTRUCTOR) and c.is_definition():
+                if self._in_tree(c):
+                    self._scan_function(c)
+                return
+            for ch in c.get_children():
+                scan(ch)
+        scan(tu_cursor)
+
+    def _func_display(self, c) -> str:
+        par = c.semantic_parent
+        cls = par.spelling if par is not None and par.kind in (
+            self.K.STRUCT_DECL, self.K.CLASS_DECL) else None
+        return (f"{cls}::{c.spelling}" if cls else c.spelling), (cls or None)
+
+    def _scan_function(self, c, usr=None, name=None, cls=None):
+        if usr is None:
+            usr = c.get_usr()
+            name, cls = self._func_display(c)
+        if usr in self.model.funcs:
+            return
+        fa = self._annot(c)
+        req = fa.at(c.location.line, "REQUIRES")
+        poe = fa.at(c.location.line, "POE")
+        fi = FuncInfo(
+            usr=usr, name=name, cls=cls,
+            file=self._file_of(c) or "?", line=c.location.line,
+            requires=tuple(s.strip() for s in req.split(",")) if req else (),
+            poe_only=tuple(s.strip() for s in poe.split(",")) if poe else ())
+        self.model.funcs[usr] = fi
+        body = None
+        for ch in c.get_children():
+            if ch.kind == self.K.COMPOUND_STMT:
+                body = ch
+        if body is not None:
+            st = _WalkState(fi, self)
+            st.walk(body)
+
+
+class _WalkState:
+    """Per-function-body walk state (also used for each lambda body,
+    which gets its own FuncInfo and a fresh guard stack)."""
+
+    def __init__(self, fi: FuncInfo, ex: Extractor):
+        self.fi = fi
+        self.ex = ex
+        self.K = ex.K
+        self.guards: list[dict] = []   # {mutex, var, scope_end, released}
+        self.compounds: list[int] = []  # extent.end offsets
+        self.if_conds: list[str] = []
+        self.write_depth = 0
+        self.stack: list = []          # ancestor cursors (spawn detection)
+
+    # ---- held-set bookkeeping
+
+    def held(self, offset: int) -> tuple[str, ...]:
+        out = list(self.fi.requires)
+        for g in self.guards:
+            if g["offset"] <= offset <= g["scope_end"] and not g["released"]:
+                if g["mutex"] not in out:
+                    out.append(g["mutex"])
+        return tuple(out)
+
+    # ---- main dispatch
+
+    def walk(self, node):
+        self.stack.append(node)
+        try:
+            self._walk(node)
+        finally:
+            self.stack.pop()
+
+    def _walk(self, node):
+        K = self.K
+        kind = node.kind
+        if kind == K.LAMBDA_EXPR:
+            self._handle_lambda(node)
+            return
+        if kind == K.COMPOUND_STMT:
+            self.compounds.append(node.extent.end.offset)
+            for ch in node.get_children():
+                self.walk(ch)
+            self.compounds.pop()
+            return
+        if kind == K.IF_STMT:
+            self._handle_if(node)
+            return
+        if kind == K.VAR_DECL:
+            self._maybe_guard(node)
+            for ch in node.get_children():
+                self.walk(ch)
+            return
+        if kind == K.CALL_EXPR:
+            self._handle_call(node)
+            # children still carry member refs / nested calls
+            for ch in node.get_children():
+                self.walk(ch)
+            return
+        if kind == K.BINARY_OPERATOR:
+            self._handle_binop(node)
+            return
+        if kind == K.UNARY_OPERATOR:
+            self._handle_unop(node)
+            return
+        if kind == K.MEMBER_REF_EXPR:
+            self._record_member(node)
+            for ch in node.get_children():
+                self.walk(ch)
+            return
+        if kind == K.DECL_REF_EXPR:
+            self._maybe_cerr(node)
+            return
+        for ch in node.get_children():
+            self.walk(ch)
+
+    # ---- constructs
+
+    def _handle_if(self, node):
+        children = list(node.get_children())
+        if not children:
+            return
+        cond, rest = children[0], children[1:]
+        self.walk(cond)
+        cond_text = " ".join(self.ex._tokens(cond))
+        self.if_conds.append(cond_text)
+        for ch in rest:
+            self.walk(ch)
+        self.if_conds.pop()
+
+    def _maybe_guard(self, node):
+        ty = node.type.spelling or ""
+        if not any(t in ty for t in ("lock_guard", "unique_lock",
+                                     "scoped_lock")):
+            return
+        mu = self.ex._mutex_name(node)
+        if mu is None:
+            return
+        offset = node.location.offset
+        scope_end = self.compounds[-1] if self.compounds else 1 << 60
+        held_now = self.held(offset)
+        self.guards.append(dict(mutex=mu, var=node.spelling, offset=offset,
+                                scope_end=scope_end, released=False))
+        self.fi.locks.append(LockEvent(
+            mutex=mu, offset=offset, line=node.location.line,
+            file=self.ex._file_of(node) or "?", held=held_now))
+
+    def _handle_call(self, node):
+        K = self.K
+        name = node.spelling or ""
+        line = node.location.line
+        offset = node.location.offset
+        file = self.ex._file_of(node) or "?"
+        held = self.held(offset)
+
+        # unique_lock unlock()/lock() toggles on a tracked guard var
+        if name in ("unlock", "lock"):
+            base = self._call_base_name(node)
+            for g in self.guards:
+                if g["var"] and g["var"] == base:
+                    if name == "unlock":
+                        g["released"] = True
+                    else:
+                        g["released"] = False
+                        g["offset"] = min(g["offset"], offset)
+                        self.fi.locks.append(LockEvent(
+                            mutex=g["mutex"], offset=offset, line=line,
+                            file=file,
+                            held=tuple(m for m in held
+                                       if m != g["mutex"])))
+                    return
+
+        # bare mutex .lock()/.unlock() (rare; treated like a guard-less
+        # acquisition for ordering purposes only)
+        ref = self.ex._callee(node)
+
+        # condition_variable wait: unbounded -> blocking
+        if name in ("wait", "wait_for", "wait_until"):
+            base_ty = self._call_base_type(node)
+            if base_ty and "condition_variable" in base_ty:
+                if name == "wait":
+                    self.fi.blocking.append(BlockSite(
+                        "unbounded condition_variable::wait", line, file))
+                return  # cv waits are not call-graph edges we care about
+
+        # poll with a literal -1 timeout
+        if name == "poll" and self._poll_is_infinite(node):
+            self.fi.blocking.append(BlockSite(
+                "poll with infinite (-1) timeout", line, file))
+
+        # fprintf / printf
+        if name in ("fprintf", "printf"):
+            self.fi.prints.append(PrintSite(
+                name, line, file, self._debug_gated()))
+
+        if ref is not None:
+            rname = ref.spelling or name
+            rfile = self.ex._file_of(ref)
+            in_tree = rfile in self.ex.tree_files if rfile else False
+            if rname in BLOCKING_FREE_FNS and \
+                    ref.kind == K.FUNCTION_DECL:
+                self.fi.blocking.append(BlockSite(
+                    f"{rname} flush loop (blocks until the peer drains)",
+                    line, file))
+            elif rname in BLOCKING_SYS_FNS and \
+                    ref.kind == K.FUNCTION_DECL and not in_tree:
+                self.fi.blocking.append(BlockSite(
+                    f"kernel {rname}() (peer-bounded)", line, file))
+            if ref.kind in (K.FUNCTION_DECL, K.CXX_METHOD, K.CONSTRUCTOR,
+                            K.DESTRUCTOR):
+                targets = (self.ex._expand_virtual(ref)
+                           if ref.kind == K.CXX_METHOD and
+                           ref.is_virtual_method()
+                           else (ref.get_usr(),))
+                self.fi.calls.append(CallSite(
+                    targets=targets, name=rname, line=line, file=file,
+                    offset=offset, held=held))
+            # mutating container method on an audited field -> write
+            if name in MUTATING_METHODS:
+                self._record_member_base(node, write=True)
+
+    def _handle_binop(self, node):
+        children = list(node.get_children())
+        op = self._binop_op(node, children)
+        if op and (op == "=" or (op.endswith("=") and
+                                 op not in ("==", "!=", "<=", ">="))):
+            if children:
+                self.write_depth += 1
+                self.walk(children[0])
+                self.write_depth -= 1
+                for ch in children[1:]:
+                    self.walk(ch)
+                return
+        for ch in children:
+            self.walk(ch)
+
+    def _handle_unop(self, node):
+        toks = self.ex._tokens(node)
+        if toks and (toks[0] in ("++", "--") or toks[-1] in ("++", "--")):
+            self.write_depth += 1
+            for ch in node.get_children():
+                self.walk(ch)
+            self.write_depth -= 1
+            return
+        for ch in node.get_children():
+            self.walk(ch)
+
+    def _handle_lambda(self, node):
+        """A lambda is its own function. If it's handed to std::thread
+        (detected from the ancestor chain: a thread-typed local, or a
+        thread ctor / container-emplace / assignment whose target member
+        is one of the configured thread owners), it becomes a thread
+        ROOT with a FRESH lock context; otherwise it's approximated as
+        called where defined (cv.wait predicates, comparators,
+        std::function callbacks) and INHERITS the definition site's
+        held locks as its requires set — a cv.wait predicate runs under
+        the waited lock, and that is where the sequencer touches its
+        queues."""
+        loc = node.location
+        usr = f"lambda@{self.ex._file_of(node)}:{loc.line}:{loc.column}"
+        role = self._thread_role(node)
+        fi = FuncInfo(usr=usr, name=f"{self.fi.name}::lambda@{loc.line}",
+                      cls=self.fi.cls, file=self.ex._file_of(node) or "?",
+                      line=loc.line)
+        self.ex.model.funcs[usr] = fi
+        if role is not None:
+            engine = ENGINE_TAGS.get(self.fi.cls or "")
+            self.ex.model.roots.append(ThreadRoot(
+                usr=usr, role=role, engine=engine,
+                file=fi.file, line=loc.line))
+        else:
+            held_now = self.held(loc.offset)
+            # an explicit // ACCL_REQUIRES(mu) on the lambda overrides
+            # the inherit-at-definition default (for helpers defined
+            # unlocked but only ever invoked under the lock)
+            fa = self.ex._annot(node)
+            req = fa.at(loc.line, "REQUIRES")
+            fi.requires = (tuple(s.strip() for s in req.split(","))
+                           if req else held_now)
+            self.fi.calls.append(CallSite(
+                targets=(usr,), name=fi.name, line=loc.line, file=fi.file,
+                offset=loc.offset, held=held_now))
+        sub = _WalkState(fi, self.ex)
+        for ch in node.get_children():
+            if ch.kind == self.K.COMPOUND_STMT:
+                sub.walk(ch)
+
+    def _thread_role(self, lam) -> str | None:
+        K = self.K
+        saw_thread_ctor = False
+        for node in reversed(self.stack[:-1]):
+            k = node.kind
+            if k == K.COMPOUND_STMT:
+                break  # reached statement level: not a spawn argument
+            if k == K.VAR_DECL and "thread" in (node.type.spelling or ""):
+                return node.spelling or "thread"
+            if k in (K.CALL_EXPR, K.CXX_FUNCTIONAL_CAST_EXPR):
+                nm = node.spelling or ""
+                if nm in ("emplace_back", "push_back", "operator=",
+                          "thread"):
+                    member = self._owner_member(node, lam)
+                    if member in THREAD_MEMBER_ROLES:
+                        return THREAD_MEMBER_ROLES[member]
+                    if nm == "thread":
+                        saw_thread_ctor = True
+                        continue  # operator= / var decl may wrap the ctor
+                    if nm in ("emplace_back", "push_back") and \
+                            member is not None:
+                        return None  # emplace on a non-thread container
+        return "thread" if saw_thread_ctor else None
+
+    def _owner_member(self, node, lam) -> str | None:
+        """First member/var referenced by `node`'s subtree OUTSIDE the
+        lambda itself — the container or member the thread lands in."""
+        K = self.K
+        lam_start = lam.extent.start.offset
+        lam_end = lam.extent.end.offset
+        for c in node.walk_preorder():
+            off = c.location.offset
+            if lam_start <= off <= lam_end:
+                continue
+            if c.kind in (K.MEMBER_REF_EXPR, K.DECL_REF_EXPR) and \
+                    c.spelling and c.spelling in THREAD_MEMBER_ROLES:
+                return c.spelling
+        # fall back: first non-method member ref outside the lambda
+        for c in node.walk_preorder():
+            off = c.location.offset
+            if lam_start <= off <= lam_end:
+                continue
+            if c.kind == K.MEMBER_REF_EXPR and c.referenced is not None \
+                    and c.referenced.kind == K.FIELD_DECL:
+                return c.spelling
+        return None
+
+    # ---- member refs / writes
+
+    def _record_member(self, node):
+        ref = node.referenced
+        if ref is None or ref.kind != self.K.FIELD_DECL:
+            return
+        par = ref.semantic_parent
+        cls = par.spelling if par is not None else ""
+        if cls not in self.ex.model.audited:
+            return
+        offset = node.location.offset
+        self.fi.accesses.append(FieldAccess(
+            cls=cls, fld=node.spelling, line=node.location.line,
+            file=self.ex._file_of(node) or "?", held=self.held(offset),
+            write=self.write_depth > 0))
+
+    def _record_member_base(self, call_node, write: bool):
+        """`field.push_back(..)`: the field member ref under the method
+        member ref is a WRITE access (the plain walk also records it as
+        a read; the write record is the stricter one and both are
+        checked)."""
+        K = self.K
+        for ch in call_node.get_children():
+            if ch.kind == K.MEMBER_REF_EXPR:
+                for base in ch.get_children():
+                    if base.kind == K.MEMBER_REF_EXPR and \
+                            base.referenced is not None and \
+                            base.referenced.kind == K.FIELD_DECL:
+                        par = base.referenced.semantic_parent
+                        cls = par.spelling if par is not None else ""
+                        if cls in self.ex.model.audited:
+                            self.fi.accesses.append(FieldAccess(
+                                cls=cls, fld=base.spelling,
+                                line=base.location.line,
+                                file=self.ex._file_of(base) or "?",
+                                held=self.held(base.location.offset),
+                                write=True))
+                break
+
+    def _maybe_cerr(self, node):
+        if node.spelling == "cerr":
+            self.fi.prints.append(PrintSite(
+                "std::cerr", node.location.line,
+                self.ex._file_of(node) or "?", self._debug_gated()))
+
+    # ---- small probes
+
+    def _debug_gated(self) -> bool:
+        return any(re.search(r"debug", c) for c in self.if_conds)
+
+    def _call_base_name(self, call) -> str | None:
+        K = self.K
+        for ch in call.get_children():
+            if ch.kind == K.MEMBER_REF_EXPR:
+                for b in ch.get_children():
+                    if b.kind in (K.DECL_REF_EXPR, K.MEMBER_REF_EXPR):
+                        return b.spelling
+        return None
+
+    def _call_base_type(self, call) -> str | None:
+        K = self.K
+        for ch in call.get_children():
+            if ch.kind == K.MEMBER_REF_EXPR:
+                for b in ch.get_children():
+                    if b.kind in (K.DECL_REF_EXPR, K.MEMBER_REF_EXPR):
+                        try:
+                            return b.type.spelling
+                        except Exception:
+                            return None
+        return None
+
+    def _poll_is_infinite(self, call) -> bool:
+        args = list(call.get_arguments())
+        if len(args) >= 3:
+            toks = "".join(self.ex._tokens(args[2]))
+            return toks == "-1"
+        return False
+
+    def _binop_op(self, node, children) -> str | None:
+        """Operator token of a BINARY_OPERATOR: the first token after
+        the first child's extent (this binding has no .binary_operator)."""
+        if not children:
+            return None
+        end = children[0].extent.end.offset
+        for t in node.get_tokens():
+            if t.extent.start.offset >= end:
+                return t.spelling
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Model construction
+# ---------------------------------------------------------------------------
+
+def build_model(cindex, tus: list[pathlib.Path],
+                include_dirs: list[str]) -> Model:
+    model = Model()
+    model.audited = set(AUDITED_CLASSES)
+    idx = cindex.Index.create()
+    args = clang_args(include_dirs)
+    resolved_cache: dict[str, str] = {}
+
+    def resolve(name: str) -> str:
+        if name not in resolved_cache:
+            resolved_cache[name] = str(pathlib.Path(name).resolve())
+        return resolved_cache[name]
+
+    # every file under native/ (or the fixture itself) is "in tree":
+    # its definitions enter the model; system headers never do
+    tree_prefixes = [str(NATIVE.resolve())] + \
+        [str(p.resolve()) for p in tus]
+
+    parsed = []
+    for tu_path in tus:
+        tu = idx.parse(str(tu_path.resolve()), args=args)
+        fatal = [str(d) for d in tu.diagnostics if d.severity >= 3]
+        if fatal:
+            model.parse_errors.append(
+                f"{tu_path}: {fatal[0]}")
+            continue
+        parsed.append(tu)
+
+    class _Ex(Extractor):
+        def _file_of(self, cursor):
+            f = cursor.location.file
+            return resolve(f.name) if f else None
+
+        def _in_tree(self, cursor):
+            f = self._file_of(cursor)
+            return f is not None and any(
+                f.startswith(p) for p in tree_prefixes)
+
+    for tu in parsed:
+        ex = _Ex(cindex, model, set())
+        ex.scan_classes(tu.cursor)
+    # AUDITED markers discovered in pass 1 must be visible in pass 2
+    for tu in parsed:
+        ex = _Ex(cindex, model, set())
+        ex.scan_functions(tu.cursor)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# Role propagation (engine-aware)
+# ---------------------------------------------------------------------------
+
+def propagate_roles(model: Model):
+    """BFS of (function, role, engine) states from the thread roots and
+    the C entry points. Returns (roles, parents): roles[usr] = set of
+    (role, engine); parents reconstruct the witness call path."""
+    roles: dict[str, set[tuple[str, str | None]]] = {}
+    parents: dict[tuple, tuple | None] = {}
+    work: list[tuple] = []
+
+    def seed(usr, role, engine, parent=None):
+        key = (usr, role, engine)
+        if key in parents:
+            return
+        parents[key] = parent
+        roles.setdefault(usr, set()).add((role, engine))
+        work.append(key)
+
+    for r in model.roots:
+        seed(r.usr, r.role, r.engine)
+    for usr, fi in model.funcs.items():
+        if fi.cls is None and fi.name.startswith("accl_rt_"):
+            if fi.name.startswith("accl_rt_create"):
+                role = "init"
+            elif fi.name == "accl_rt_destroy":
+                role = "fini"
+            else:
+                role = "api"
+            seed(usr, role, None)
+    # destructors tear down after (or while) threads run: fini role
+    for usr, fi in model.funcs.items():
+        if fi.name.split("::")[-1].startswith("~"):
+            seed(usr, "fini", None)
+
+    while work:
+        key = work.pop()
+        usr, role, engine = key
+        fi = model.funcs.get(usr)
+        if fi is None:
+            continue
+        for cs in fi.calls:
+            for tgt in cs.targets:
+                tf = model.funcs.get(tgt)
+                if tf is None:
+                    continue
+                e2 = engine
+                tag = ENGINE_TAGS.get(tf.cls or "")
+                if tag is not None:
+                    if engine is not None and tag != engine:
+                        continue  # other engine's override: unreachable
+                    e2 = tag
+                if tf.poe_only and e2 is not None and \
+                        e2 not in tf.poe_only:
+                    continue
+                seed(tgt, role, e2, parent=(key, cs))
+    return roles, parents
+
+
+def witness_path(parents, key) -> list[str]:
+    chain = []
+    while key is not None:
+        entry = parents.get(key)
+        usr, role, engine = key
+        chain.append((usr, role, engine,
+                      entry[1] if entry else None))
+        key = entry[0] if entry else None
+    chain.reverse()
+    return chain
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+def _short(path: str) -> str:
+    try:
+        return str(pathlib.Path(path).resolve().relative_to(REPO))
+    except ValueError:
+        return pathlib.Path(path).name
+
+
+def _ann_for(model: Model, file: str) -> FileAnnotations:
+    return model.annotations.setdefault(
+        file, read_annotations(pathlib.Path(file)))
+
+
+def _held_matches(held: tuple[str, ...], mu: str) -> bool:
+    return any(h == mu or h.split("::")[-1] == mu for h in held)
+
+
+def check_rx_reachability(model: Model, roles, parents,
+                          waivers: list[str]) -> list[Diag]:
+    """ACCLN101 (blocking) + ACCLN105 (ungated prints) on rx roles."""
+    diags = []
+    for usr, fi in model.funcs.items():
+        rx_states = [(r, e) for (r, e) in roles.get(usr, ())
+                     if r in NO_BLOCK_ROLES]
+        if not rx_states:
+            continue
+        role, engine = rx_states[0]
+        key = (usr, role, engine)
+        chain = witness_path(parents, key)
+        path_names = [model.funcs[u].name for (u, _, _, _) in chain
+                      if u in model.funcs]
+        root = chain[0]
+        root_fi = model.funcs.get(root[0])
+        root_desc = (f"rx root {root_fi.name} "
+                     f"({_short(root_fi.file)}:{root_fi.line})"
+                     if root_fi else "rx root")
+        for b in fi.blocking:
+            ann = _ann_for(model, b.file)
+            reason = ann.allow(b.line, "ACCLN101")
+            if reason is not None:
+                waivers.append(
+                    f"ACCLN101 waived at {_short(b.file)}:{b.line} "
+                    f"in {fi.name}: {reason}")
+                continue
+            diags.append(Diag(
+                "ACCLN101", _short(b.file), b.line,
+                f"rx-thread role reaches {b.what}",
+                detail=[root_desc,
+                        "path: " + " -> ".join(path_names),
+                        f"blocking site in {fi.name} at "
+                        f"{_short(b.file)}:{b.line}"]))
+        for p in fi.prints:
+            if p.debug_gated:
+                continue
+            ann = _ann_for(model, p.file)
+            reason = ann.allow(p.line, "ACCLN105")
+            if reason is not None:
+                waivers.append(
+                    f"ACCLN105 waived at {_short(p.file)}:{p.line} "
+                    f"in {fi.name}: {reason}")
+                continue
+            diags.append(Diag(
+                "ACCLN105", _short(p.file), p.line,
+                f"{p.what} reachable from rx-thread role outside a "
+                f"debug-gated branch",
+                detail=[root_desc,
+                        "path: " + " -> ".join(path_names)]))
+    return diags
+
+
+def check_lock_order(model: Model, waivers: list[str]) -> list[Diag]:
+    """ACCLN102: global lock-order acyclicity, witness rendered."""
+    # transitive "may acquire" per function (spawned lambdas excluded:
+    # they run on their own thread, not under the caller's locks)
+    acq: dict[str, set[str]] = {u: {ev.mutex for ev in fi.locks}
+                                for u, fi in model.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for u, fi in model.funcs.items():
+            for cs in fi.calls:
+                for tgt in cs.targets:
+                    extra = acq.get(tgt, set()) | set(
+                        model.funcs[tgt].requires
+                        if tgt in model.funcs else ())
+                    if not extra <= acq[u]:
+                        acq[u] |= extra
+                        changed = True
+
+    edges: dict[tuple[str, str], str] = {}
+
+    def add_edge(a, b, site):
+        if a != b and (a, b) in edges:
+            return
+        edges[(a, b)] = site
+
+    for u, fi in model.funcs.items():
+        for ev in fi.locks:
+            for h in ev.held:
+                add_edge(h, ev.mutex,
+                         f"{h} held at {_short(ev.file)}:{ev.line} in "
+                         f"{fi.name} when acquiring {ev.mutex}")
+        for cs in fi.calls:
+            if not cs.held:
+                continue
+            for tgt in cs.targets:
+                tf = model.funcs.get(tgt)
+                if tf is None:
+                    continue
+                inner = acq.get(tgt, set()) | set(tf.requires)
+                for m2 in inner:
+                    for h in cs.held:
+                        if m2 in tf.requires and _held_matches(cs.held, m2):
+                            continue  # caller passes the held lock down
+                        add_edge(h, m2,
+                                 f"{h} held at {_short(cs.file)}:{cs.line} "
+                                 f"in {fi.name} calling {tf.name} "
+                                 f"(which may acquire {m2})")
+
+    # cycle search (DFS with colors); self-edges are cycles of length 1
+    graph: dict[str, list[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    for (a, b), site in sorted(edges.items()):
+        if a == b:
+            return [Diag("ACCLN102", "native", 0,
+                         f"lock self-cycle on {a}",
+                         detail=[site])]
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(n):
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(graph.get(n, [])):
+            if color.get(m, 0) == 1:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, 0) == 0:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        stack.pop()
+        color[n] = 2
+        return None
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            cyc = dfs(n)
+            if cyc:
+                detail = [" -> ".join(cyc)]
+                for a, b in zip(cyc, cyc[1:]):
+                    detail.append(edges[(a, b)])
+                return [Diag("ACCLN102", "native", 0,
+                             "lock-order cycle", detail=detail)]
+    return []
+
+
+def check_guarded_fields(model: Model, roles,
+                         waivers: list[str]) -> list[Diag]:
+    """ACCLN103: annotation coverage + access discipline + REQUIRES
+    call-site proof."""
+    diags = []
+    # 1. every non-exempt field of an audited struct carries an annotation
+    for (cls, fld), f in sorted(model.fields.items()):
+        if cls not in model.audited or f.exempt:
+            continue
+        if f.annotation is None:
+            ann = _ann_for(model, f.file)
+            reason = ann.allow(f.line, "ACCLN103")
+            if reason is not None:
+                waivers.append(
+                    f"ACCLN103 waived at {_short(f.file)}:{f.line} "
+                    f"({cls}::{fld}): {reason}")
+                continue
+            diags.append(Diag(
+                "ACCLN103", _short(f.file), f.line,
+                f"shared field {cls}::{fld} has no ACCL_GUARDED_BY / "
+                f"ACCL_INIT_CONST / ACCL_ROLE_ONLY annotation "
+                f"(type: {f.type_spelling})"))
+
+    # 2. every access honors the annotation
+    for usr, fi in model.funcs.items():
+        rset = {r for (r, _) in roles.get(usr, ())}
+        if not rset:
+            continue  # unreachable from any root: nothing to prove
+        single = rset <= (INIT_ROLES | FINI_ROLES)
+        for acc in fi.accesses:
+            f = model.fields.get((acc.cls, acc.fld))
+            if f is None or f.exempt or f.annotation is None or \
+                    acc.cls not in model.audited:
+                continue
+            kind, arg = f.annotation
+            ok = True
+            why = ""
+            if kind == "GUARDED_BY":
+                ok = single or _held_matches(acc.held, arg)
+                why = (f"requires {arg}; held: "
+                       f"{list(acc.held) or 'nothing'}")
+            elif kind == "INIT_CONST":
+                ok = (not acc.write) or rset <= INIT_ROLES
+                why = "init-const field written outside the init phase"
+            elif kind == "ROLE_ONLY":
+                allowed = {s.strip() for s in arg.split(",")}
+                ok = rset <= (allowed | INIT_ROLES | FINI_ROLES)
+                why = (f"restricted to role(s) {sorted(allowed)}; "
+                       f"accessed from {sorted(rset)}")
+            if ok:
+                continue
+            ann = _ann_for(model, acc.file)
+            reason = ann.allow(acc.line, "ACCLN103")
+            if reason is not None:
+                waivers.append(
+                    f"ACCLN103 waived at {_short(acc.file)}:{acc.line} "
+                    f"({acc.cls}::{acc.fld} in {fi.name}): {reason}")
+                continue
+            diags.append(Diag(
+                "ACCLN103", _short(acc.file), acc.line,
+                f"{'write to' if acc.write else 'access to'} "
+                f"{acc.cls}::{acc.fld} in {fi.name} violates "
+                f"ACCL_{kind}", detail=[why,
+                                        f"roles: {sorted(rset)}"]))
+
+        # 3. calling an ACCL_REQUIRES function without the lock
+        for cs in fi.calls:
+            for tgt in cs.targets:
+                # a lambda's synthetic definition-site edge is not an
+                # invocation: its REQUIRES binds real call sites, which
+                # resolve through operator() and are checked via the
+                # body's held-set, not here
+                if tgt.startswith("lambda@"):
+                    continue
+                tf = model.funcs.get(tgt)
+                if tf is None or not tf.requires:
+                    continue
+                for mu in tf.requires:
+                    if single or _held_matches(cs.held, mu) or \
+                            mu in fi.requires:
+                        continue
+                    ann = _ann_for(model, cs.file)
+                    reason = ann.allow(cs.line, "ACCLN103")
+                    if reason is not None:
+                        waivers.append(
+                            f"ACCLN103 waived at "
+                            f"{_short(cs.file)}:{cs.line} "
+                            f"(call {tf.name}): {reason}")
+                        continue
+                    diags.append(Diag(
+                        "ACCLN103", _short(cs.file), cs.line,
+                        f"{fi.name} calls {tf.name} without holding "
+                        f"{mu} (declared ACCL_REQUIRES({mu}))",
+                        detail=[f"held: {list(cs.held) or 'nothing'}"]))
+    return diags
+
+
+def check_seam(files: dict[pathlib.Path, str]) -> list[Diag]:
+    """ACCLN104 over {path: effective-basename} (fixtures may pose as a
+    real TU via // AS_FILE). Pure text — no libclang needed."""
+    diags = []
+    for path, as_name in files.items():
+        try:
+            lines = path.read_text().splitlines()
+        except OSError:
+            continue
+        for rule in SEAM_RULES:
+            if rule["file"] != as_name:
+                continue
+            inc = rule.get("forbid_include")
+            syms = rule.get("forbid_symbols", [])
+            sym_re = re.compile(
+                r"\b(" + "|".join(map(re.escape, syms)) + r")\b") \
+                if syms else None
+            for i, ln in enumerate(lines, start=1):
+                code = ln.split("//", 1)[0]
+                if inc and re.search(inc, code):
+                    diags.append(Diag(
+                        "ACCLN104", _short(str(path)), i,
+                        f"seam violation: {rule['reason']}",
+                        detail=[ln.strip()]))
+                elif sym_re and sym_re.search(code):
+                    diags.append(Diag(
+                        "ACCLN104", _short(str(path)), i,
+                        f"seam violation: session-side symbol "
+                        f"'{sym_re.search(code).group(1)}' in "
+                        f"{as_name} ({rule['reason']})",
+                        detail=[ln.strip()]))
+    return diags
+
+
+def run_rules(model: Model, seam_files: dict[pathlib.Path, str],
+              waivers: list[str]) -> list[Diag]:
+    diags: list[Diag] = []
+    for err in model.parse_errors:
+        diags.append(Diag("ACCLN100", "native", 0,
+                          f"translation unit failed to parse: {err}"))
+    if not model.parse_errors:
+        roles, parents = propagate_roles(model)
+        diags += check_rx_reachability(model, roles, parents, waivers)
+        diags += check_lock_order(model, waivers)
+        diags += check_guarded_fields(model, roles, waivers)
+    diags += check_seam(seam_files)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+def run_tree(cindex, verbose: bool = False) -> int:
+    model = build_model(cindex, TREE_TUS,
+                        [str(NATIVE / "include")])
+    waivers: list[str] = []
+    seam = {p: p.name for p in TREE_TUS}
+    diags = run_rules(model, seam, waivers)
+    for d in diags:
+        print(d.render())
+    for w in waivers:
+        print(f"  [waiver] {w}")
+    n_roles = len(model.roots)
+    print(f"native_check --tree: {len(TREE_TUS)} TUs, "
+          f"{len(model.funcs)} functions, {n_roles} thread roots, "
+          f"{len(waivers)} waiver(s), {len(diags)} diagnostic(s)")
+    return 1 if diags else 0
+
+
+def run_corpus(cindex, corpus_dir: pathlib.Path,
+               verbose: bool = False) -> int:
+    fixtures = sorted(corpus_dir.glob("*.cpp"))
+    if not fixtures:
+        print(f"no fixtures under {corpus_dir}", file=sys.stderr)
+        return 1
+    bad = 0
+    n_reject = 0
+    for fx in fixtures:
+        text = fx.read_text()
+        want: set[str] = set()
+        for m in EXPECT_RE.finditer(text):
+            want |= {c.strip() for c in m.group(1).split(",")
+                     if c.strip()}
+        as_m = AS_FILE_RE.search(text)
+        as_name = as_m.group(1) if as_m else fx.name
+        model = build_model(cindex, [fx], [str(NATIVE / "include")])
+        waivers: list[str] = []
+        diags = run_rules(model, {fx: as_name}, waivers)
+        got = {d.code for d in diags}
+        ok = got == want
+        if want:
+            n_reject += 1
+        status = "ok" if ok else "MISMATCH"
+        kind = ("expect " + ",".join(sorted(want))) if want else "clean"
+        print(f"  {fx.name}: {kind} -> "
+              f"{','.join(sorted(got)) or 'clean'} [{status}]")
+        if not ok:
+            bad += 1
+            for d in diags:
+                print("    " + d.render().replace("\n", "\n    "))
+    print(f"corpus: {len(fixtures)} fixtures "
+          f"({n_reject} known-bad, {len(fixtures) - n_reject} good), "
+          f"{bad} mismatch(es)")
+    return 1 if bad else 0
+
+
+def run_seam_only() -> int:
+    diags = check_seam({p: p.name for p in TREE_TUS})
+    for d in diags:
+        print(d.render())
+    if not diags:
+        print("seamcheck: transport.cpp is clean of reliability "
+              "internals")
+    return 1 if diags else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--tree", action="store_true",
+                    help="certify the live native sources")
+    ap.add_argument("--corpus", nargs="?", const=str(DEFAULT_CORPUS),
+                    default=None, metavar="DIR",
+                    help="replay the fixture corpus (default "
+                         "tools/native_lint_corpus/)")
+    ap.add_argument("--seam", action="store_true",
+                    help="ACCLN104 include/symbol rules only (the "
+                         "`make -C native seamcheck` wrapper)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.seam and not (args.tree or args.corpus):
+        return run_seam_only()
+    if not (args.tree or args.corpus or args.seam):
+        ap.print_help()
+        return 2
+
+    cindex = load_cindex()
+    if cindex is None:
+        print("native_check: FAIL (libclang is required for --tree/"
+              "--corpus; --seam runs without it)", file=sys.stderr)
+        return 1
+
+    rc = 0
+    if args.corpus:
+        rc |= run_corpus(cindex, pathlib.Path(args.corpus), args.verbose)
+    if args.tree:
+        rc |= run_tree(cindex, args.verbose)
+    if args.seam and (args.tree or args.corpus):
+        rc |= run_seam_only()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+// EXPECT: ACCLN102
+//
+// Classic AB/BA deadlock: the sequencer flushes completions holding
+// call_mu then comp_mu; the waiter re-queues holding comp_mu then
+// call_mu. Each order alone is fine — the CYCLE in the global lock
+// graph is the bug, and the diagnostic renders it as a witness.
+#include <mutex>
+
+struct Runtime {
+  std::mutex call_mu;
+  std::mutex comp_mu;
+  int pending = 0;    // ACCL_GUARDED_BY(call_mu)
+  int completed = 0;  // ACCL_GUARDED_BY(comp_mu)
+
+  void flush() {  // call_mu -> comp_mu
+    std::lock_guard<std::mutex> g(call_mu);
+    pending--;
+    std::lock_guard<std::mutex> h(comp_mu);
+    completed++;
+  }
+
+  void requeue() {  // comp_mu -> call_mu: closes the cycle
+    std::lock_guard<std::mutex> g(comp_mu);
+    completed--;
+    std::lock_guard<std::mutex> h(call_mu);
+    pending++;
+  }
+};

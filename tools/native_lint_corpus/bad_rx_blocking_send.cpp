@@ -1,0 +1,31 @@
+// EXPECT: ACCLN101
+//
+// The PR 14 bug, reduced: the rx thread handles a NACK by
+// RETRANSMITTING INLINE through the blocking send path. If the peer's
+// socket is full because the peer is itself blocked sending to us,
+// neither rx loop ever drains — the mutual-wedge liveness hazard the
+// rx no-blocking rule exists to forbid.
+#include <thread>
+#include <vector>
+
+static bool send_all(int fd, const void *p, unsigned n) {
+  (void)fd; (void)p; (void)n;  // flush loop elided: the NAME is the contract
+  return true;
+}
+
+struct Runtime {
+  std::vector<std::thread> rx_threads_;
+
+  void retransmit(unsigned seqn) { send_all(3, &seqn, sizeof seqn); }
+
+  void rx_loop() {
+    for (;;) {
+      unsigned nack_seqn = 0;
+      retransmit(nack_seqn);  // blocking send ON the rx thread
+    }
+  }
+
+  void start() {
+    rx_threads_.emplace_back([this] { rx_loop(); });
+  }
+};

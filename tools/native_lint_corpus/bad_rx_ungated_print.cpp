@@ -1,0 +1,22 @@
+// EXPECT: ACCLN105
+//
+// An unconditional fprintf on the rx path: under a chaos soak every
+// dropped frame becomes a write(2) on the hot loop. Diagnostics from
+// rx roles must sit behind the cached debug flag.
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+struct Runtime {
+  std::vector<std::thread> rx_threads_;
+
+  void rx_loop() {
+    for (;;) {
+      std::fprintf(stderr, "rx: frame dropped\n");  // ungated
+    }
+  }
+
+  void start() {
+    rx_threads_.emplace_back([this] { rx_loop(); });
+  }
+};

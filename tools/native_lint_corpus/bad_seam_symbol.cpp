@@ -1,0 +1,19 @@
+// EXPECT: ACCLN104
+// AS_FILE: transport.cpp
+//
+// A transport TU reaching for session-side reliability internals: the
+// POE seam carries already-built frames only, so CRC (and retransmit
+// retention) must never leak below it.
+#if 0
+#include "reliability.h"
+#endif
+
+unsigned crc32c(unsigned seed, const void *p, unsigned n);
+
+static unsigned checksum_frame(const void *p, unsigned n) {
+  return crc32c(0u, p, n);
+}
+
+unsigned frame_checksum_entry(const void *p, unsigned n) {
+  return checksum_frame(p, n);
+}

@@ -1,0 +1,11 @@
+// EXPECT: ACCLN103
+//
+// An audited struct with a bare shared field: no ACCL_GUARDED_BY /
+// ACCL_INIT_CONST / ACCL_ROLE_ONLY claim means no proof obligation was
+// even stated — the honest-audit half of the rule.
+#include <mutex>
+
+struct Counters {  // ACCL_AUDITED
+  std::mutex mu;
+  long landed = 0;
+};

@@ -1,0 +1,14 @@
+// EXPECT: ACCLN103
+//
+// A guarded field touched without its lock from a live entry point:
+// the annotation is a claim, and every access must prove it.
+#include <mutex>
+
+struct Counters {  // ACCL_AUDITED
+  std::mutex mu;
+  long landed = 0;  // ACCL_GUARDED_BY(mu)
+};
+
+extern "C" void accl_rt_poke(Counters *c) {
+  c->landed++;  // api role, mu not held
+}

@@ -1,0 +1,20 @@
+// (clean twin of bad_rx_ungated_print: the same fprintf behind the
+// cached debug flag is fine — that is what the flag is for.)
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+struct Runtime {
+  std::vector<std::thread> rx_threads_;
+  bool debug_on = false;  // ACCL_INIT_CONST
+
+  void rx_loop() {
+    for (;;) {
+      if (debug_on) std::fprintf(stderr, "rx: frame dropped\n");
+    }
+  }
+
+  void start() {
+    rx_threads_.emplace_back([this] { rx_loop(); });
+  }
+};

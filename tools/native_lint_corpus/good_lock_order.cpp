@@ -1,0 +1,24 @@
+// (clean twin of bad_lock_cycle: both paths honor the one global
+// order call_mu -> comp_mu, so the lock graph is acyclic.)
+#include <mutex>
+
+struct Runtime {
+  std::mutex call_mu;
+  std::mutex comp_mu;
+  int pending = 0;    // ACCL_GUARDED_BY(call_mu)
+  int completed = 0;  // ACCL_GUARDED_BY(comp_mu)
+
+  void flush() {
+    std::lock_guard<std::mutex> g(call_mu);
+    pending--;
+    std::lock_guard<std::mutex> h(comp_mu);
+    completed++;
+  }
+
+  void requeue() {
+    std::lock_guard<std::mutex> g(call_mu);
+    pending++;
+    std::lock_guard<std::mutex> h(comp_mu);
+    completed--;
+  }
+};

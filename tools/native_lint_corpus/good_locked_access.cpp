@@ -1,0 +1,13 @@
+// (clean twin of bad_unlocked_access: the same entry point takes the
+// declared lock before touching the field.)
+#include <mutex>
+
+struct Counters {  // ACCL_AUDITED
+  std::mutex mu;
+  long landed = 0;  // ACCL_GUARDED_BY(mu)
+};
+
+extern "C" void accl_rt_poke(Counters *c) {
+  std::lock_guard<std::mutex> g(c->mu);
+  c->landed++;
+}

@@ -1,0 +1,44 @@
+// (clean twin of bad_rx_blocking_send: the rx thread only QUEUES the
+// retransmit; a sender role performs the blocking send. The same
+// send_all site is fine there — the rule is about rx roles.)
+#include <mutex>
+#include <thread>
+#include <vector>
+
+static bool send_all(int fd, const void *p, unsigned n) {
+  (void)fd; (void)p; (void)n;
+  return true;
+}
+
+struct Runtime {
+  std::vector<std::thread> rx_threads_;
+  std::thread rely_thread;
+  std::mutex rely_mu;
+  std::vector<unsigned> retx_q;  // ACCL_GUARDED_BY(rely_mu)
+
+  void rx_loop() {
+    for (;;) {
+      unsigned nack_seqn = 0;
+      std::lock_guard<std::mutex> g(rely_mu);
+      retx_q.push_back(nack_seqn);  // queue, never send
+    }
+  }
+
+  void rely_loop() {
+    for (;;) {
+      unsigned seqn;
+      {
+        std::lock_guard<std::mutex> g(rely_mu);
+        if (retx_q.empty()) continue;
+        seqn = retx_q.back();
+        retx_q.pop_back();
+      }
+      send_all(3, &seqn, sizeof seqn);  // sender role: may block
+    }
+  }
+
+  void start() {
+    rx_threads_.emplace_back([this] { rx_loop(); });
+    rely_thread = std::thread([this] { rely_loop(); });
+  }
+};

@@ -1,0 +1,9 @@
+// AS_FILE: transport.cpp
+// (clean twin of bad_seam_symbol: a transport TU that stays below the
+// seam — raw byte movement, no reliability symbols, no CRC.)
+#include <cstring>
+
+bool copy_frame(void *dst, const void *src, unsigned n) {
+  std::memcpy(dst, src, n);
+  return true;
+}
