@@ -727,7 +727,8 @@ class ACCL:
     # ------------------------------------------------------------------ #
 
     def sequence(self, comm: Communicator | None = None,
-                 lint: str = "error") -> "SequenceRecorder":
+                 lint: str = "error",
+                 persistent=()) -> "SequenceRecorder":
         """Start recording a call sequence: collective/copy/combine calls
         on the returned recorder queue descriptors host-side (nothing
         executes), then `run()` lowers the WHOLE batch into one compiled
@@ -749,7 +750,15 @@ class ACCL:
         "warn" logs the diagnostics and proceeds, "off" opts out, and
         "deep" adds the exhaustive-interleaving tier (wildcard races
         and schedule-dependent deadlocks over every legal match order,
-        ACCL205/206 — budgeted, enforced like "error")."""
+        ACCL205/206 — budgeted, enforced like "error").
+
+        `persistent` declares DEVICE-RESIDENT STATE buffers: buffers
+        whose tails carry results from one dispatch of the compiled
+        program to the next (a KV cache, an optimizer state), refreshed
+        partial-width inside the batch by design. The hazard pass
+        waives ACCL101 (read wider than the in-sequence producer wrote)
+        for exactly those buffers — every other diagnostic, including
+        WAR/WAW ordering and the static width check, still applies."""
         if lint not in ("error", "warn", "off", "deep"):
             raise ValueError(
                 f"lint must be 'error'|'warn'|'off'|'deep', got {lint!r}")
@@ -757,7 +766,8 @@ class ACCL:
             raise NotImplementedError(
                 f"{type(self.cclo).__name__} does not support call "
                 "sequences")
-        return SequenceRecorder(self, comm, lint=lint)
+        return SequenceRecorder(self, comm, lint=lint,
+                                persistent=persistent)
 
     def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
@@ -901,6 +911,8 @@ class ACCL:
         dev.write(CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT,
                   tuning.alltoall_compress_min_count)
         dev.write(CCLOAddr.OVERLAP_MIN_COUNT, tuning.overlap_min_count)
+        dev.write(CCLOAddr.SYNTH_LATENCY_MAX_COUNT,
+                  tuning.synth_latency_max_count)
 
     def autotune(self, link=None, timing_model_path=None,
                  tier: str = "emulator",
@@ -1048,10 +1060,13 @@ class SequenceRecorder:
     and barrier cannot ride a sequence (host-paired / payload-free)."""
 
     def __init__(self, accl: ACCL, comm: Communicator | None = None,
-                 lint: str = "error"):
+                 lint: str = "error", persistent=()):
         self._accl = accl
         self._comm = comm
         self._lint = lint
+        # declared device-resident state buffers (ACCL101 waiver) — kept
+        # as addresses: that's the layer the hazard pass renames from
+        self._persistent = frozenset(b.address for b in persistent)
         self.calls: list[CallOptions] = []
         self._reads: list[BaseBuffer] = []  # per-step operand buffers
         self._writes: list[BaseBuffer] = []  # per-step result buffers
@@ -1230,7 +1245,8 @@ class SequenceRecorder:
             accl._stage_in(sync_in, from_device)
             Log.debug("sequence of %d: %s", len(self.calls),
                       "+".join(o.scenario.name for o in self.calls))
-            req = accl.cclo.start_sequence(self.calls, lint=self._lint)
+            req = accl.cclo.start_sequence(self.calls, lint=self._lint,
+                                           persistent=self._persistent)
             ret = accl._complete(req, sync_out, to_device, run_async)
             if get_tracer().active:
                 sp.set(n_steps=len(self.calls),
@@ -1264,8 +1280,9 @@ class SequenceProgram:
         self._sync_in, self._sync_out = recorder._sync_sets()
         self.n_steps = len(recorder.calls)
         self._ops = "+".join(o.scenario.name for o in recorder.calls)
-        self._prepared = accl.cclo.prepare_sequence(recorder.calls,
-                                                    lint=recorder._lint)
+        self._prepared = accl.cclo.prepare_sequence(
+            recorder.calls, lint=recorder._lint,
+            persistent=recorder._persistent)
 
     @property
     def plans(self):

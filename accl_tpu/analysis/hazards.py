@@ -68,7 +68,9 @@ class _Access:
 
 def _accesses(steps, world):
     """Resolve each step's read/write prefix accesses under canonical
-    renaming. Returns (reads, writes, n_bufs): per-step access lists."""
+    renaming. Returns (reads, writes, rename): per-step access lists
+    plus the address -> canonical-index map itself (callers translate
+    address-keyed annotations like `persistent_addrs` through it)."""
     rename: dict[int, int] = {}
 
     def idx(addr: int) -> int:
@@ -90,7 +92,7 @@ def _accesses(steps, world):
                                   opts.data_type))
         else:
             writes.append(None)
-    return reads, writes, len(rename)
+    return reads, writes, rename
 
 
 def _reachability(n: int, edges: set[tuple[int, int]]) -> list[set[int]]:
@@ -116,6 +118,7 @@ def analyze_dataflow(
     ring_steps: frozenset[int] | set[int] = frozenset(),
     buffer_widths: dict[int, int] | None = None,
     arith_table: dict | None = None,
+    persistent_addrs: frozenset[int] | set[int] = frozenset(),
 ) -> list[Diagnostic]:
     """Run the RAW/WAR/WAW + dtype-flow hazard pass over a batch of
     CallOptions. `ring_steps` are indices the sequence builder chains
@@ -125,9 +128,21 @@ def analyze_dataflow(
     of a bare descriptor stream); `arith_table` is the ACTIVE arithmetic
     configuration the batch will lower under (an ACCL built with a
     custom table lints against ITS lanes, not the defaults — omit for
-    bare-descriptor replay, where the default table is the lane set)."""
+    bare-descriptor replay, where the default table is the lane set).
+
+    `persistent_addrs` declares DEVICE-RESIDENT STATE buffers (by
+    address): buffers whose tail bytes are carried from one dispatch of
+    the program to the next by contract (a KV cache, an optimizer
+    state). For those buffers a read wider than its in-sequence
+    producer's write is the declared steady-state pattern — the stale
+    tail is last dispatch's result, not a mis-recorded count — so
+    ACCL101 is waived for them. Nothing else is: WAR/WAW ordering,
+    dtype flow, and the static width check still apply in full, so the
+    annotation cannot hide a clobber, only a deliberate partial-width
+    refresh."""
     diags: list[Diagnostic] = []
-    reads, writes, _ = _accesses(steps, world)
+    reads, writes, rename = _accesses(steps, world)
+    persistent = {rename[a] for a in persistent_addrs if a in rename}
     n = len(list(steps))
     table = arith_table if arith_table is not None else DEFAULT_ARITH_CONFIG
 
@@ -161,7 +176,7 @@ def analyze_dataflow(
             if w is None:
                 continue  # reads pre-sequence contents: external input
             edges.add((w.step, k))
-            if acc.elems > w.elems:
+            if acc.elems > w.elems and acc.buf not in persistent:
                 wider = widest_write.get(acc.buf)
                 stale = ("bytes never written in this sequence"
                          if wider is None or wider.elems <= w.elems

@@ -83,11 +83,15 @@ class SequenceLinter:
         plans=None,
         *,
         buffer_widths: dict[int, int] | None = None,
+        persistent_addrs: frozenset[int] | set[int] = frozenset(),
     ) -> list[Diagnostic]:
         """Run the configured passes over a batch of CallOptions.
         `plans` (one Plan per step, from plan.select_algorithm) enables
         the deep protocol pass; `buffer_widths` (address -> registered
-        element width) enables the static underflow check."""
+        element width) enables the static underflow check;
+        `persistent_addrs` declares device-resident state buffers whose
+        partial-width refresh pattern waives ACCL101 (see
+        hazards.analyze_dataflow)."""
         steps = list(steps)
         diags = validate_steps(steps, self.world)
         if any(d.code in ("ACCL404", "ACCL403") for d in diags):
@@ -99,6 +103,7 @@ class SequenceLinter:
             ring_steps=self.ring_steps(steps),
             buffer_widths=buffer_widths,
             arith_table=self.arith_table,
+            persistent_addrs=persistent_addrs,
         )
         if self.use_pallas_ring:
             timeline = ring_slot_timeline(

@@ -363,6 +363,7 @@ class TuningParams:
         hier_allreduce_min_count: int = 0,
         alltoall_compress_min_count: int = 0,
         overlap_min_count: int = 0,
+        synth_latency_max_count: int = 0,
     ):
         self.gather_flat_tree_max_fanin = gather_flat_tree_max_fanin
         self.gather_flat_tree_max_count = gather_flat_tree_max_count
@@ -388,6 +389,19 @@ class TuningParams:
         self.synth_allreduce_max_count = synth_allreduce_max_count
         self.synth_allgather_max_count = synth_allgather_max_count
         self.synth_reduce_scatter_max_count = synth_reduce_scatter_max_count
+        # Latency-window synthesized-schedule crossover: exact fp32
+        # allreduce payloads up to this many bytes run the committed
+        # LATENCY-GRID library entry (synthesis.SIZE_GRID_LAT, the
+        # 1-64 KiB decode regime where the alpha term is the product)
+        # when one covers the cell — checked BEFORE the bandwidth-
+        # biased std synth window, so a minimum-step schedule that
+        # only wins the small-payload floor can be shipped without
+        # widening the std register past its calibration. 0 — the
+        # default — keeps selection bit-for-bit unchanged;
+        # ACCL.autotune sets it from timing.tuning_crossovers'
+        # synth_latency_max_bytes, the same measured-selection posture
+        # as every other register.
+        self.synth_latency_max_count = synth_latency_max_count
         # Hierarchical-allreduce crossover (sequencer/hierarchical.py):
         # on a device that declares a two-tier topology, allreduce
         # payloads of AT LEAST this many bytes run the striped two-tier
@@ -484,6 +498,11 @@ class TuningParams:
                 max_count_cap),
             synth_reduce_scatter_max_count=min(
                 int(cross.get("synth_reduce_scatter_max_bytes", 0)),
+                max_count_cap),
+            # same MAX-register posture as the synth trio: 0 = no
+            # latency-grid entry or never wins on this link
+            synth_latency_max_count=min(
+                int(cross.get("synth_latency_max_bytes", 0)),
                 max_count_cap),
             # 0 is meaningful here too: no per-tier calibration / no
             # topology / hierarchical never wins on these links. This

@@ -67,6 +67,16 @@ class CCLOAddr:
     # default) keeps selection bit-for-bit the serial form. Set by
     # ACCL.autotune from the calibrated crossover.
     OVERLAP_MIN_COUNT = 0x1FAC
+    # Latency-window synthesized-schedule crossover (sequencer/
+    # synthesis.py, SIZE_GRID_LAT): exact fp32 allreduce payloads up to
+    # this many bytes run the committed latency-grid hop-DAG (minimum-
+    # step exchange/doubling members scored on the 1-64 KiB grid where
+    # the alpha term dominates) when one covers the cell — a MAX
+    # threshold like the synth registers, but scoped to the small-
+    # payload decode regime and checked BEFORE the bandwidth-biased
+    # std window. 0 (the default) keeps selection bit-for-bit
+    # unchanged. Set by ACCL.autotune from the calibrated crossover.
+    SYNTH_LATENCY_MAX_COUNT = 0x1FA8
     EGR_RX_BUF_SIZE = 0x4
     NUM_EGR_RX_BUFS = 0x0
     # Start of the dynamically-laid-out region (communicators, arith
@@ -74,7 +84,7 @@ class CCLOAddr:
     DYNAMIC_BASE = 0x200
     # End of the dynamic region: the lowest-addressed register above
     # (keep in sync when adding registers).
-    DYNAMIC_END = 0x1FAC
+    DYNAMIC_END = 0x1FA8
 
 
 # The hardware id this framework reports, with capability bits analogous

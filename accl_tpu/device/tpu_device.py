@@ -176,6 +176,9 @@ class TPUDevice(CCLODevice):
                 CCLOAddr.ALLTOALL_COMPRESS_MIN_COUNT),
             # and 0 = stripe-overlapped allreduce off (serial form)
             overlap_min_count=rd(CCLOAddr.OVERLAP_MIN_COUNT),
+            # and 0 = latency-window synthesized schedules off
+            synth_latency_max_count=rd(
+                CCLOAddr.SYNTH_LATENCY_MAX_COUNT),
         )
 
     # -- communicator resolution (comm_addr -> rank group) -----------------
@@ -481,7 +484,8 @@ class TPUDevice(CCLODevice):
 
     # -- call sequences (device-resident descriptor batches) ---------------
 
-    def start_sequence(self, options_list, lint: str = "error") -> BaseRequest:
+    def start_sequence(self, options_list, lint: str = "error",
+                       persistent=frozenset()) -> BaseRequest:
         """Execute a recorded batch of call descriptors as ONE compiled
         device program (sequencer.sequence.SequencePlan): a single
         dispatch for the whole chain, intermediate results threaded
@@ -497,12 +501,17 @@ class TPUDevice(CCLODevice):
         budgeted) on top of "error" enforcement. Results are cached
         under the same composite signature the compiled program is —
         keyed per tier, so a re-recorded batch re-lints nothing and
-        the default tier never pays for the deep one."""
-        return self.dispatch_sequence(
-            self.prepare_sequence(options_list, lint))
+        the default tier never pays for the deep one.
 
-    def prepare_sequence(self, options_list,
-                         lint: str = "error") -> "_PreparedSequence":
+        `persistent` (buffer addresses) declares device-resident state
+        the batch refreshes partial-width by design — the hazard pass
+        waives ACCL101 for those buffers only (docs/lint.md)."""
+        return self.dispatch_sequence(
+            self.prepare_sequence(options_list, lint,
+                                  persistent=persistent))
+
+    def prepare_sequence(self, options_list, lint: str = "error",
+                         persistent=frozenset()) -> "_PreparedSequence":
         """The resolve half of `start_sequence`: wire-register rewrite,
         per-step plan selection, lint gate, dataflow resolution and
         compile — everything whose result is a pure function of the
@@ -552,7 +561,8 @@ class TPUDevice(CCLODevice):
         if lint != "off":
             with tracer.span("lint", cat="phase", track="device") as sp:
                 sp.set(signature=sig, tier=lint)
-                self._lint_batch(desc, tuple(plans), ctx, lint)
+                self._lint_batch(desc, tuple(plans), ctx, lint,
+                                 persistent=frozenset(persistent))
 
         with tracer.span("compile", cat="phase", track="device") as sp:
             sp.set(signature=sig)
@@ -639,7 +649,8 @@ class TPUDevice(CCLODevice):
                             ts_ns=now, dur_ns=0, args=step_args)
         return req
 
-    def _lint_batch(self, desc, plans, ctx, mode: str) -> None:
+    def _lint_batch(self, desc, plans, ctx, mode: str,
+                    persistent: frozenset = frozenset()) -> None:
         """The opt-out static gate in front of compile_sequence: lint
         diagnostics are cached by the batch's composite signature (the
         same canonical renaming the compile cache keys on), so steady
@@ -651,16 +662,23 @@ class TPUDevice(CCLODevice):
         widths = {}
         canon: list[int] = []  # widths in canonical (renamed) order, so
         # the cache can never alias two batches whose buffers differ
+        rename: dict[int, int] = {}  # addr -> canonical index, for the
+        # persistent-annotation part of the key (addresses are arena-
+        # unique, so the raw set would defeat cross-buffer cache hits)
         for opts in desc.steps:
             for addr in (opts.addr_0, opts.addr_1, opts.addr_2):
+                if addr and addr not in rename:
+                    rename[addr] = len(rename)
                 buf = self.buffers.get(addr)
                 if addr and buf is not None and addr not in widths:
                     widths[addr] = buf.shape[-1]
                     canon.append(widths[addr])
         deep = mode == "deep"
+        canon_persist = tuple(sorted(
+            rename[a] for a in persistent if a in rename))
         key = (desc.signature(), plans, ctx.world, tuple(canon),
                ctx.compiler.use_pallas_ring,
-               ctx.compiler.pallas_ring_overlap, deep)
+               ctx.compiler.pallas_ring_overlap, canon_persist, deep)
         diags = self._lint_cache.get(key)
         if diags is None:
             linter = SequenceLinter(
@@ -675,7 +693,8 @@ class TPUDevice(CCLODevice):
                 arith_table=ctx.compiler.arith_table,
             )
             diags = tuple(linter.lint(desc.steps, plans,
-                                      buffer_widths=widths))
+                                      buffer_widths=widths,
+                                      persistent_addrs=persistent))
             self._lint_cache[key] = diags
         enforce(diags, mode)
 

@@ -17,12 +17,20 @@ from .transformer import (  # noqa: F401,E402
     init_kv_cache,
     init_params,
     make_decode_step,
+    make_decode_step_program,
     make_forward,
     make_train_step,
+    record_decode_step,
+    run_decode_step_eager,
 )
 from .moe import (  # noqa: F401
     MoEConfig,
     init_moe_params,
     make_moe_forward,
     make_moe_train_step,
+)
+from .serve import (  # noqa: F401
+    DecodeRequest,
+    DecodeServer,
+    generate,
 )

@@ -887,6 +887,393 @@ def run_train_step_eager(accl, cfg: TransformerConfig, buffers):
     return accl._last_request
 
 
+# ---------------------------------------------------------------------------
+# Device-resident decode step: N layers of KV-cached single-token
+# attention + MLP, each closed by a TP partial-sum allreduce, fused
+# into ONE recorded descriptor batch (the record-once/dispatch-many
+# seam serving interactive traffic — ROADMAP item 4's inference half)
+# ---------------------------------------------------------------------------
+
+# kernel-stream id base for the decode step's consumers: attention for
+# layer l registers at base + 2l, its MLP at base + 2l + 1, and the
+# final logits head at base + 2*n_layers (distinct from
+# MOE_EXPERT_STREAM=11 and TRAIN_GRAD_STREAM=21)
+DECODE_STREAM_BASE = 40
+
+
+def decode_attn_stream(layer: int) -> int:
+    return DECODE_STREAM_BASE + 2 * layer
+
+
+def decode_mlp_stream(layer: int) -> int:
+    return DECODE_STREAM_BASE + 2 * layer + 1
+
+
+def decode_logits_stream(cfg: TransformerConfig) -> int:
+    return DECODE_STREAM_BASE + 2 * cfg.n_layers
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeDims:
+    """Flat-buffer geometry of the fused decode step. The facade world
+    is the TENSOR-PARALLEL world: each rank's state buffer carries its
+    kv-head slice of the cache, and the two allreduces per layer are
+    the tp partial-sum reductions of the sharded model."""
+
+    batch: int
+    max_len: int
+    d_model: int
+    vocab: int
+    heads_local: int
+    kv_heads_local: int
+    ff_local: int
+    # [x (B*D) | pos (B) | k-cache | v-cache], per rank
+    n_state: int
+    # [x (B*D) | pos (B)] on the way in, logits (B*V) on the way out —
+    # one width serves both, so the x/pos prefix survives in the tail
+    n_out: int
+
+
+def decode_dims(cfg: TransformerConfig, world: int, batch: int,
+                max_len: int) -> DecodeDims:
+    for name, dim in (("n_heads", cfg.n_heads),
+                      ("kv_heads", cfg.kv_heads), ("d_ff", cfg.d_ff)):
+        if dim % world:
+            raise ValueError(
+                f"decode facade world {world} must divide {name}={dim}")
+    if jnp.dtype(cfg.dtype) != jnp.float32:
+        raise ValueError("the fused decode step rides fp32 rank buffers")
+    kvl = cfg.kv_heads // world
+    b_d = batch * cfg.d_model
+    return DecodeDims(
+        batch=batch, max_len=max_len, d_model=cfg.d_model,
+        vocab=cfg.vocab,
+        heads_local=cfg.n_heads // world, kv_heads_local=kvl,
+        ff_local=cfg.d_ff // world,
+        n_state=b_d + batch + 2 * batch * max_len * kvl * cfg.head_dim,
+        n_out=max(batch * cfg.vocab, b_d + batch),
+    )
+
+
+def _rope_slots(x, pos, theta: float):
+    """Per-slot rotary: (B, 1, H, D) rotated by per-slot absolute
+    positions `pos` (B,) — the batched-decode form of _rope (same fp32
+    half-split math), one position per batch row instead of one shared
+    (T,) vector, so concurrent requests at different depths share one
+    compiled step."""
+    D = x.shape[-1]
+    assert D % 2 == 0, "rope needs an even head_dim"
+    half = D // 2
+    inv_freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (B, half)
+    cos = jnp.cos(ang)[:, None, None, :]
+    sin = jnp.sin(ang)[:, None, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def make_decode_attn_consumer(cfg: TransformerConfig, lyr: dict,
+                              dims: DecodeDims, world: int,
+                              axis_name: str = "ccl"):
+    """Layer attention as a RES_STREAM consumer over the rank's flat
+    state [x, pos, kv-cache]: rmsnorm + the rank's q/kv head slice
+    (selected by axis_index, so ONE traced callable serves every rank),
+    per-slot RoPE, per-slot cache append at pos, masked full-length
+    grouped attention, and the rank's wo partial product — landing
+    [o_partial, pos, new kv-cache] in the result buffer. The FULL layer
+    weights close over the endpoint as program constants, like the
+    train step's fwd+bwd consumer."""
+    B, T, D = dims.batch, dims.max_len, dims.d_model
+    hd = cfg.head_dim
+    hl, kvl = dims.heads_local, dims.kv_heads_local
+    groups = cfg.n_heads // cfg.kv_heads
+    wq = jnp.asarray(lyr["wq"])
+    wkv = jnp.asarray(lyr["wkv"])
+    wo = jnp.asarray(lyr["wo"])
+    ln1 = jnp.asarray(lyr["ln1"])
+
+    def consumer(state):
+        me = lax.axis_index(axis_name)
+        x = state[:B * D].reshape(B, 1, D)
+        pos = state[B * D:B * D + B].astype(jnp.int32)
+        kv = state[B * D + B:].reshape(2, B, T, kvl, hd)
+        ck, cv = kv[0], kv[1]
+        wq_r = lax.dynamic_slice_in_dim(wq, me * hl, hl, axis=1)
+        wkv_r = lax.dynamic_slice_in_dim(wkv, me * kvl, kvl, axis=2)
+        wo_r = lax.dynamic_slice_in_dim(wo, me * hl, hl, axis=0)
+        h = _rmsnorm(x, ln1)
+        q = jnp.einsum("btd,dhk->bthk", h, wq_r)
+        kvp = jnp.einsum("btd,dchk->btchk", h, wkv_r)
+        k_new, v_new = kvp[:, :, 0], kvp[:, :, 1]
+        if cfg.rope:
+            q = _rope_slots(q, pos, cfg.rope_theta)
+            k_new = _rope_slots(k_new, pos, cfg.rope_theta)
+        upd = lambda c, n, p: lax.dynamic_update_slice_in_dim(  # noqa: E731
+            c, n, p, axis=0)
+        ck = jax.vmap(upd)(ck, k_new, pos)
+        cv = jax.vmap(upd)(cv, v_new, pos)
+        qg = q.reshape(B, 1, kvl, groups, hd)
+        scores = jnp.einsum("bqhgk,bthk->bhgt", qg, ck) / np.sqrt(hd)
+        mask = (jnp.arange(T)[None, None, None, :]
+                > pos[:, None, None, None])
+        scores = jnp.where(mask, -jnp.inf, scores.astype(jnp.float32))
+        attn = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        ctx = jnp.einsum("bhgt,bthk->bhgk", attn, cv)
+        o_partial = jnp.einsum("bthk,hkd->btd",
+                               ctx.reshape(B, 1, hl, hd), wo_r)
+        return jnp.concatenate([
+            o_partial.reshape(-1).astype(state.dtype),
+            pos.astype(state.dtype),
+            jnp.stack([ck, cv]).reshape(-1).astype(state.dtype),
+        ])
+
+    return consumer
+
+
+def make_decode_mlp_consumer(cfg: TransformerConfig, lyr: dict,
+                             dims: DecodeDims, world: int,
+                             axis_name: str = "ccl"):
+    """Layer MLP as a RES_STREAM consumer over the flat post-attention
+    residual x2 (B*D): ln2 + the rank's gelu MLP ff slice — the same
+    math as _mlp_half's local half, emitting the down-projection
+    partial sum the next allreduce closes."""
+    B, D = dims.batch, dims.d_model
+    ffl = dims.ff_local
+    w_up = jnp.asarray(lyr["w_up"])
+    w_down = jnp.asarray(lyr["w_down"])
+    ln2 = jnp.asarray(lyr["ln2"])
+
+    def consumer(x2_flat):
+        me = lax.axis_index(axis_name)
+        x = x2_flat.reshape(B, 1, D)
+        h = _rmsnorm(x, ln2)
+        w_up_r = lax.dynamic_slice_in_dim(w_up, me * ffl, ffl, axis=1)
+        w_down_r = lax.dynamic_slice_in_dim(w_down, me * ffl, ffl, axis=0)
+        up = jax.nn.gelu(jnp.einsum("btd,df->btf", h, w_up_r))
+        down_partial = jnp.einsum("btf,fd->btd", up, w_down_r)
+        return down_partial.reshape(-1).astype(x2_flat.dtype)
+
+    return consumer
+
+
+def make_decode_logits_consumer(cfg: TransformerConfig, params: dict,
+                                dims: DecodeDims):
+    """Final rmsnorm + unembed projection over the last layer's
+    residual prefix, zero-padded to the n_out row width (the replicated
+    head: every rank computes identical logits, the host reads row 0)."""
+    B, D, V = dims.batch, dims.d_model, dims.vocab
+    n_out = dims.n_out
+    unembed = jnp.asarray(params["unembed"])
+
+    def consumer(xp):
+        x = xp[:B * D].reshape(B, 1, D)
+        x = _rmsnorm(x, jnp.ones((D,), x.dtype))
+        logits = jnp.einsum("btd,dv->btv", x, unembed)
+        flat = logits.reshape(-1).astype(xp.dtype)
+        pad = n_out - B * V
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        return flat
+
+    return consumer
+
+
+@dataclasses.dataclass
+class DecodeBuffers:
+    """The fused decode step's rank buffers (each (world, n) fp32).
+    `state[l]` persists layer l's kv cache across dispatches in its
+    tail — only its [x, pos] prefix is re-staged per step — so the
+    cache never crosses the host boundary in the steady state."""
+
+    dims: DecodeDims
+    xp: object  # [x, pos] in / logits landing width (n_out)
+    logits: object  # final logits (n_out)
+    state: list  # per-layer [x, pos, kv] (n_state)
+    attn_sum: object  # allreduced attention output (B*D)
+    x2: object  # post-attention residual (B*D)
+    mlp_partial: object  # MLP consumer output (B*D)
+    mlp_sum: object  # allreduced MLP output (B*D)
+
+    @property
+    def persistent(self) -> tuple:
+        """The buffers whose tails are device-resident dispatch-to-
+        dispatch state: the per-layer [x, pos, kv] states (the kv cache
+        rides behind the refreshed [x, pos] prefix) and xp (pos rides
+        behind each layer's B*D-wide residual write). Declared on the
+        recorded sequence so the hazard pass can hold every OTHER
+        buffer to the full ACCL101 contract."""
+        return (self.xp, *self.state)
+
+
+def create_decode_buffers(accl, cfg: TransformerConfig, batch: int,
+                          max_len: int) -> DecodeBuffers:
+    dims = decode_dims(cfg, accl.world, batch, max_len)
+    b_d = batch * cfg.d_model
+    return DecodeBuffers(
+        dims=dims,
+        xp=accl.create_buffer(dims.n_out, np.float32),
+        logits=accl.create_buffer(dims.n_out, np.float32),
+        state=[accl.create_buffer(dims.n_state, np.float32)
+               for _ in range(cfg.n_layers)],
+        attn_sum=accl.create_buffer(b_d, np.float32),
+        x2=accl.create_buffer(b_d, np.float32),
+        mlp_partial=accl.create_buffer(b_d, np.float32),
+        mlp_sum=accl.create_buffer(b_d, np.float32),
+    )
+
+
+def register_decode_consumers(accl, cfg: TransformerConfig, params: dict,
+                              dims: DecodeDims):
+    for l, lyr in enumerate(params["layers"]):
+        accl.register_stream_consumer(
+            decode_attn_stream(l),
+            make_decode_attn_consumer(cfg, lyr, dims, accl.world,
+                                      accl.axis_name))
+        accl.register_stream_consumer(
+            decode_mlp_stream(l),
+            make_decode_mlp_consumer(cfg, lyr, dims, accl.world,
+                                     accl.axis_name))
+    accl.register_stream_consumer(
+        decode_logits_stream(cfg),
+        make_decode_logits_consumer(cfg, params, dims))
+
+
+def _decode_layer_steps(seq_or_accl, cfg, buffers: DecodeBuffers,
+                        layer: int, *, eager: bool):
+    """The 7 descriptors of one decode layer — ONE list shared by the
+    recorded and eager forms so the two cannot diverge:
+
+      1. copy(xp -> state[l], B*D+B): stage [x, pos] into the state
+         prefix (the kv tail survives — partial-width prefix write);
+      2. copy(state[l] -> state[l], n_state) through the ATTN consumer:
+         [x, pos, kv] -> [o_partial, pos, new kv] IN PLACE — the
+         appended cache persists where it lives, no shuttle buffer
+         (and no WAR hazard for a reordering executor to trip on);
+      3. allreduce(state[l] -> attn_sum, B*D, SUM): the tp partial-sum
+         reduction over the o projections (reads the state prefix);
+      4. combine(SUM, xp, attn_sum -> x2, B*D): the residual add;
+      5. copy(x2 -> mlp_partial, B*D) through the MLP consumer;
+      6. allreduce(mlp_partial -> mlp_sum, B*D, SUM);
+      7. combine(SUM, x2, mlp_sum -> xp, B*D): layer output back into
+         xp's PREFIX — pos rides untouched in the tail for layer l+1.
+    """
+    d = buffers.dims
+    b_d = d.batch * d.d_model
+    kw = (dict(from_device=True, to_device=True) if eager else {})
+    s = seq_or_accl
+    if eager:
+        s.copy(buffers.xp, buffers.state[layer], b_d + d.batch,
+               from_device=(layer > 0), to_device=True)
+        s.copy_to_stream(buffers.state[layer], d.n_state,
+                         res_stream=decode_attn_stream(layer),
+                         dstbuf=buffers.state[layer], **kw)
+    else:
+        s.copy(buffers.xp, buffers.state[layer], b_d + d.batch)
+        s.copy(buffers.state[layer], buffers.state[layer], d.n_state,
+               res_stream=decode_attn_stream(layer))
+    s.allreduce(buffers.state[layer], buffers.attn_sum, b_d,
+                ReduceFunction.SUM, **kw)
+    s.combine(b_d, ReduceFunction.SUM, buffers.xp, buffers.attn_sum,
+              buffers.x2, **kw)
+    if eager:
+        s.copy_to_stream(buffers.x2, b_d,
+                         res_stream=decode_mlp_stream(layer),
+                         dstbuf=buffers.mlp_partial, **kw)
+    else:
+        s.copy(buffers.x2, buffers.mlp_partial, b_d,
+               res_stream=decode_mlp_stream(layer))
+    s.allreduce(buffers.mlp_partial, buffers.mlp_sum, b_d,
+                ReduceFunction.SUM, **kw)
+    s.combine(b_d, ReduceFunction.SUM, buffers.x2, buffers.mlp_sum,
+              buffers.xp, **kw)
+
+
+def record_decode_step(accl, cfg: TransformerConfig, params: dict, *,
+                       batch: int, max_len: int, lint: str = "error",
+                       buffers: DecodeBuffers | None = None):
+    """Record the KV-cached single-token decode step as ONE descriptor
+    batch over `accl`'s (tensor-parallel) axis: n_layers x (attention
+    consumer + tp allreduce + MLP consumer + tp allreduce) + the logits
+    head, 7*n_layers + 1 descriptors in one dispatch. Returns
+    (recorder, buffers); `recorder.compile()` freezes the steady-state
+    SequenceProgram, and the same descriptors issued eagerly
+    (`run_decode_step_eager`) are the dispatch-per-layer twin —
+    bitwise-identical at fp32 (the sequence-vs-eager contract,
+    fuzz-pinned)."""
+    if buffers is None:
+        buffers = create_decode_buffers(accl, cfg, batch, max_len)
+    d = buffers.dims
+    register_decode_consumers(accl, cfg, params, d)
+    seq = accl.sequence(lint=lint, persistent=buffers.persistent)
+    for layer in range(cfg.n_layers):
+        _decode_layer_steps(seq, cfg, buffers, layer, eager=False)
+    seq.copy(buffers.xp, buffers.logits, d.n_out,
+             res_stream=decode_logits_stream(cfg))
+    return seq, buffers
+
+
+def make_decode_step_program(accl, cfg: TransformerConfig, params: dict,
+                             *, batch: int, max_len: int,
+                             lint: str = "error",
+                             buffers: DecodeBuffers | None = None):
+    """The steady-state fused decode step: record once, compile once,
+    dispatch ONE program per token (the SequenceProgram seam the train
+    step rides, serving-side). The caller's loop is `write_decode_inputs
+    -> program.run() -> read_decode_logits`."""
+    seq, buffers = record_decode_step(accl, cfg, params, batch=batch,
+                                      max_len=max_len, lint=lint,
+                                      buffers=buffers)
+    return seq.compile(), buffers
+
+
+def run_decode_step_eager(accl, cfg: TransformerConfig,
+                          buffers: DecodeBuffers):
+    """The dispatch-per-layer twin: the SAME 7*n_layers + 1 descriptors
+    the fused batch records, issued eagerly — every layer pays its
+    dispatch seams while intermediates stay on-device (the same honest
+    baseline shape as run_train_step_eager). Bitwise-identical to the
+    fused program at fp32 (fuzz-pinned)."""
+    for layer in range(len(buffers.state)):
+        _decode_layer_steps(accl, cfg, buffers, layer, eager=True)
+    d = buffers.dims
+    accl.copy_to_stream(buffers.xp, d.n_out,
+                        res_stream=decode_logits_stream(cfg),
+                        dstbuf=buffers.logits, from_device=True)
+    return accl._last_request
+
+
+def write_decode_inputs(buffers: DecodeBuffers, params: dict, tokens,
+                        pos):
+    """Stage one step's inputs: embed `tokens` (B,) at per-slot
+    positions `pos` (B,) into every rank row of the xp buffer — the
+    decode loop's host half (identical rows: the embedding is
+    replicated, exactly like the sharded model's)."""
+    d = buffers.dims
+    x0 = np.asarray(params["embed"])[np.asarray(tokens, np.int64)]
+    row = np.zeros(d.n_out, np.float32)
+    row[:d.batch * d.d_model] = x0.reshape(-1)
+    row[d.batch * d.d_model:d.batch * d.d_model + d.batch] = (
+        np.asarray(pos, np.float32))
+    buffers.xp.host[:] = row[None]
+
+
+def read_decode_logits(buffers: DecodeBuffers, *,
+                       sync: bool = False) -> np.ndarray:
+    """The step's logits (B, V) from rank row 0 (replicated head).
+    Pass sync=True after `program.run(to_device=True)` — the
+    steady-state dispatch form that keeps the kv caches device-resident
+    and syncs ONLY the logits buffer back (the eager twin's final
+    copy_to_stream already lands host-side)."""
+    d = buffers.dims
+    if sync:
+        buffers.logits.sync_from_device()
+    return np.asarray(
+        buffers.logits.host[0][:d.batch * d.vocab],
+        np.float32).reshape(d.batch, d.vocab)
+
+
 def demo_batch(cfg, mesh, batch=4, seq=64, seed=0):
     rng = np.random.default_rng(seed)
     tokens = rng.integers(0, cfg.vocab, (batch, seq)).astype(np.int32)

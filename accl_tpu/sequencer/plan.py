@@ -342,6 +342,29 @@ def select_algorithm(
                         return synth_plan
             return hier_plan
 
+    # Latency-window synthesized schedules (synthesis.SIZE_GRID_LAT):
+    # exact uncompressed unstreamed allreduce payloads inside the
+    # SYNTH_LATENCY_MAX_COUNT window run the committed latency-grid
+    # hop-DAG — the minimum-step members scored on the 1-64 KiB decode
+    # grid where the alpha term dominates. Checked BEFORE the std
+    # synth window: the lat register is derived contiguous-from-bottom
+    # on the fine grid, so inside it the lat entry is the calibrated
+    # winner even where the coarser std window also claims the cell.
+    # Register 0 (the default) skips this branch entirely — selection
+    # is bit-for-bit the established behavior.
+    if (scenario == Operation.allreduce
+            and tuning.synth_latency_max_count
+            and 0 < bytes_count <= tuning.synth_latency_max_count
+            and stream == StreamFlags.NO_STREAM
+            and compression == CompressionFlags.NO_COMPRESSION):
+        from . import synthesis
+
+        key = synthesis.select_entry(scenario, world_size, bytes_count,
+                                     grid="lat")
+        if key is not None:
+            return Plan(Protocol.EAGER, Algorithm.SYNTHESIZED,
+                        count, 1, wire_dtype=wire, synth_key=key)
+
     # Synthesized schedules (sequencer/synthesis.py): payloads inside a
     # synth crossover register run the search-produced hop-DAG for this
     # (op, world) when the committed library carries a certified entry

@@ -163,6 +163,8 @@ __all__ = [
     "hand_written_best",
     "hand_written_tiered_best",
     "SIZE_GRID",
+    "SIZE_GRID_LAT",
+    "grid_for",
 ]
 
 # the ops a synthesized schedule can implement today
@@ -171,6 +173,21 @@ SYNTH_OPS = (Operation.allreduce, Operation.allgather,
 
 # predicted-score grid: payload bytes per (world, size) cell
 SIZE_GRID = tuple(1 << k for k in range(10, 25, 2))  # 1 KB .. 16 MB
+
+# the latency grid: every power of two across the 1-64 KiB decode
+# regime, where the alpha term — not bytes — is the product. Entries
+# searched on this grid carry grid="lat" and a "_lat" key suffix; they
+# live behind SYNTH_LATENCY_MAX_COUNT, never the std synth registers,
+# so a minimum-step schedule that only wins the small-payload floor
+# cannot widen the bandwidth-calibrated windows.
+SIZE_GRID_LAT = tuple(1 << k for k in range(10, 17))  # 1 KB .. 64 KB
+
+
+def grid_for(spec: "SynthSpec") -> tuple[int, ...]:
+    """The scoring grid a spec's window is defined over — the ONE
+    resolution rule shared by search/--export, verify_library, and
+    timing.tuning_crossovers."""
+    return SIZE_GRID_LAT if spec.grid == "lat" else SIZE_GRID
 
 
 class SynthesisError(Exception):
@@ -208,6 +225,7 @@ class SynthSpec:
     wire: str = ""  # "" = payload dtype on the wire, "int8" = quantized
     tiers: tuple[int, ...] = ()  # (inner_world, outer_world) | () flat
     outer_distances: tuple[int, ...] = ()
+    grid: str = "std"  # "std" = SIZE_GRID window, "lat" = SIZE_GRID_LAT
 
     @property
     def scenario(self) -> Operation:
@@ -223,6 +241,8 @@ class SynthSpec:
         if self.tiers:
             d["tiers"] = list(self.tiers)
             d["outer_distances"] = list(self.outer_distances)
+        if self.grid != "std":
+            d["grid"] = self.grid
         return d
 
     @classmethod
@@ -233,7 +253,8 @@ class SynthSpec:
                    wire=str(d.get("wire", "")),
                    tiers=tuple(int(x) for x in d.get("tiers", ())),
                    outer_distances=tuple(
-                       int(x) for x in d.get("outer_distances", ())))
+                       int(x) for x in d.get("outer_distances", ())),
+                   grid=str(d.get("grid", "std")))
 
 
 def _spec_key(op: str, world: int, family: str,
@@ -1239,7 +1260,7 @@ def _narrow_contiguous(wins: list[int], size_grid: tuple[int, ...],
 
 def score_window(link: Any, spec: SynthSpec, *,
                  elem_bytes: int = 4,
-                 size_grid: tuple[int, ...] = SIZE_GRID,
+                 size_grid: tuple[int, ...] | None = None,
                  aggregate: bool = False,
                  log: Callable[[str], None] | None = None,
                  ) -> tuple[tuple[int, int] | None,
@@ -1248,8 +1269,13 @@ def score_window(link: Any, spec: SynthSpec, *,
     hand-written prediction (strict inequality wins) and narrow the win
     set to its longest CONTIGUOUS grid run. The ONE window rule shared
     by search/--export and verify_library — a scoring change lands here
-    or nowhere. Returns (window or None, per-cell predictions)."""
+    or nowhere. `size_grid` defaults to the spec's OWN grid
+    (`grid_for`: SIZE_GRID_LAT for grid="lat" entries), so a lat
+    entry's window re-scores on the cells it was searched over.
+    Returns (window or None, per-cell predictions)."""
     say = log or (lambda m: None)
+    if size_grid is None:
+        size_grid = grid_for(spec)
     wins: list[int] = []
     predicted: dict[int, tuple[float, float]] = {}
     op = Operation[spec.op]
@@ -1309,12 +1335,14 @@ def score_window_tiered(tier_links: Any, spec: SynthSpec, *,
 
 
 def search(op: Operation, world: int, link: Any, *,
-           elem_bytes: int = 4, size_grid: tuple[int, ...] = SIZE_GRID,
+           elem_bytes: int = 4,
+           size_grid: tuple[int, ...] | None = None,
            aggregate: bool = False,
            log: Callable[[str], None] | None = None,
            beam: int | None = None,
            tiers: tuple[int, int] | None = None,
            tier_links: Any = None,
+           grid: str = "std",
            ) -> list[SearchResult]:
     """The full synthesize -> score -> prune -> certify loop for one
     (op, world) — flat by default, or the factored space for one
@@ -1334,6 +1362,15 @@ def search(op: Operation, world: int, link: Any, *,
     Winners are returned in enumeration order with their contiguous
     winning windows."""
     say = log or (lambda m: None)
+    if grid not in ("std", "lat"):
+        raise SynthesisError(f"unknown scoring grid {grid!r}")
+    if grid == "lat" and tiers is not None:
+        raise SynthesisError(
+            "the latency grid scores FLAT candidates only: tiered "
+            "windows are per-tier predictions selected through the "
+            "hier register, not the latency window")
+    if size_grid is None:
+        size_grid = SIZE_GRID_LAT if grid == "lat" else SIZE_GRID
     if tiers is not None and op != Operation.allreduce:
         raise SynthesisError(
             f"the tiered families implement allreduce only; a tiered "
@@ -1348,6 +1385,12 @@ def search(op: Operation, world: int, link: Any, *,
                        dict[int, tuple[float, float]], float]] = []
     cands = (enumerate_tiered_candidates(world, tiers)
              if tiers is not None else enumerate_candidates(op, world))
+    if grid == "lat":
+        # the same candidate space re-scored on the latency grid: keys
+        # get a "_lat" suffix so a member can ship BOTH a bandwidth
+        # window and a latency window without colliding in the library
+        cands = (dataclasses.replace(s, key=s.key + "_lat", grid="lat")
+                 for s in cands)
     for spec in cands:
         if spec.tiers:
             window, predicted = score_window_tiered(
@@ -1447,12 +1490,16 @@ def library() -> dict[str, LibraryEntry]:
 
 def select_entry(op: Operation, world: int, payload_bytes: int,
                  wire: str = "",
-                 tiers: tuple[int, ...] = ()) -> str | None:
+                 tiers: tuple[int, ...] = (),
+                 grid: str = "std") -> str | None:
     """The library entry `plan.select_algorithm` should use for this
     cell, or None. `tiers=()` (the default) matches only FLAT entries —
     the synth registers' uniform-link windows; `tiers=(inner, outer)`
     matches only the tiered entries of that exact factoring (the
     HIER_ALLREDUCE_MIN_COUNT window's predicted-time arbitration).
+    `grid="std"` (the default) matches only SIZE_GRID entries;
+    `grid="lat"` matches only the latency-grid entries behind
+    SYNTH_LATENCY_MAX_COUNT — the two windows never cross-select.
     Among matching entries the one whose predicted winning window
     contains the payload wins; ties break to the narrower window (the
     more specialized schedule), then key order — all deterministic."""
@@ -1460,7 +1507,7 @@ def select_entry(op: Operation, world: int, payload_bytes: int,
     for entry in library().values():
         s = entry.spec
         if (s.op != op.name or s.world != world or s.wire != wire
-                or s.tiers != tuple(tiers)):
+                or s.tiers != tuple(tiers) or s.grid != grid):
             continue
         lo, hi = entry.win_bytes
         if not (lo <= payload_bytes <= hi):

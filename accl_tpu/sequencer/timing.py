@@ -921,7 +921,8 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                          ("reduce_scatter", Operation.reduce_scatter)):
         entries = [e for e in _synth.library().values()
                    if e.spec.op == op_key and e.spec.world == P
-                   and not e.spec.wire and not e.spec.tiers]
+                   and not e.spec.wire and not e.spec.tiers
+                   and e.spec.grid == "std"]
         best_bytes = 0
         if entries:
             sbytes = 1 << 10
@@ -937,6 +938,34 @@ def tuning_crossovers(params: LinkParams, *, world: int = 8,
                     best_bytes = sbytes
                 sbytes *= 2
         synth_regs[f"synth_{op_key}_max_bytes"] = best_bytes
+
+    # Latency-window synthesized-schedule crossover: the end of the
+    # CONTIGUOUS-FROM-BOTTOM winning run of the committed latency-grid
+    # allreduce entries (synthesis.SIZE_GRID_LAT, 1-64 KiB — the
+    # decode regime where the alpha term dominates) against the same
+    # hand-written zoo. A MAX register like the synth trio, but the
+    # scan STOPS at the first losing cell instead of keeping the
+    # largest win: select_algorithm treats every payload under the
+    # register as latency-window territory, so a loss below a win must
+    # not be overclaimed. 0 = no lat entry or the smallest cell loses
+    # — the register stays off and selection is bit-for-bit unchanged.
+    lat_entries = [e for e in _synth.library().values()
+                   if e.spec.op == "allreduce" and e.spec.world == P
+                   and not e.spec.wire and not e.spec.tiers
+                   and e.spec.grid == "lat"]
+    lat_best = 0
+    for sbytes in (_synth.SIZE_GRID_LAT if lat_entries else ()):
+        cnt = max(sbytes // elem_bytes, 1)
+        t_synth = min(
+            _synth.predict_spec(params, e.spec, cnt, elem_bytes)
+            for e in lat_entries)
+        t_hand = _synth.hand_written_best(
+            params, Operation.allreduce, cnt, elem_bytes, P,
+            rx_buf_bytes=rx_buf_bytes)
+        if t_synth >= t_hand:
+            break  # a loss ends the contiguous-from-bottom window
+        lat_best = sbytes
+    synth_regs["synth_latency_max_bytes"] = lat_best
 
     # Quantized-alltoall crossover: the start of the CONTIGUOUS winning
     # suffix — the smallest alltoall payload (descriptor bytes_count =

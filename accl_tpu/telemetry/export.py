@@ -50,7 +50,37 @@ EVENT_SCHEMA: dict = {
                     "properties": {
                         "counters": {"type": "object"},
                         "gauges": {"type": "object"},
-                        "histograms": {"type": "object"},
+                        # per-series histogram rows are fully typed:
+                        # the quantile keys MUST mirror
+                        # metrics.QUANTILES via metrics.quantile_key
+                        # (test_metrics pins the two against each
+                        # other), so adding a quantile without typing
+                        # it here fails CI instead of shipping an
+                        # untyped tail readout in every trace
+                        "histograms": {
+                            "type": "object",
+                            "additionalProperties": {
+                                "type": "array",
+                                "items": {
+                                    "type": "object",
+                                    "required": ["labels", "count",
+                                                 "sum", "window"],
+                                    "properties": {
+                                        "labels": {"type": "object"},
+                                        "count": {"type": "integer"},
+                                        "sum": {"type": "number"},
+                                        "window": {"type": "integer"},
+                                        "min": {"type": "number"},
+                                        "max": {"type": "number"},
+                                        "p50": {"type": "number"},
+                                        "p95": {"type": "number"},
+                                        "p99": {"type": "number"},
+                                        "p99_9": {"type": "number"},
+                                    },
+                                    "additionalProperties": False,
+                                },
+                            },
+                        },
                     },
                 },
                 "drift_sentinel": {

@@ -10,8 +10,8 @@ observers) — never at trace-drain time, so the numbers are live even
 when nobody ever exports a trace:
 
   - a **streaming metrics registry**: counters, gauges, and bounded
-    streaming-quantile histograms (p50/p95/p99 over a sliding sample
-    window plus exact cumulative count/sum/min/max) keyed by
+    streaming-quantile histograms (p50/p95/p99/p99.9 over a sliding
+    sample window plus exact cumulative count/sum/min/max) keyed by
     ``(op, algorithm, protocol, world)`` labels, with Prometheus-style
     text exposition (``expose_text``) and a JSON snapshot that rides
     the SPAN v1 trace meta (``Tracer.to_trace`` embeds it);
@@ -49,7 +49,19 @@ from .export import measured_seconds, median as _median
 LABEL_KEYS = ("op", "algorithm", "protocol", "world")
 
 DEFAULT_HISTOGRAM_WINDOW = 512
-QUANTILES = (0.5, 0.95, 0.99)
+# p99.9 rides the same 512-sample window as the rest: nearest-rank over
+# 512 samples makes it the window maximum until ~1000 samples would fit,
+# which is exactly the honest tail readout an interactive-serving gate
+# wants (the worst step seen in the last window, stabilizing as windows
+# grow) — not a fabricated interpolation past the data
+QUANTILES = (0.5, 0.95, 0.99, 0.999)
+
+
+def quantile_key(q: float) -> str:
+    """Snapshot/JSON key for a quantile: p50, p95, p99, p99_9 — the
+    fractional part joins with '_' so 0.999 cannot collide with 0.99
+    (int(q*100) maps both to 99)."""
+    return "p" + f"{q * 100:g}".replace(".", "_")
 
 LabelsKey = tuple[tuple[str, str], ...]
 
@@ -95,7 +107,7 @@ class Gauge:
 class Histogram:
     """Bounded streaming-quantile histogram: exact cumulative
     count/sum/min/max plus a sliding window of the last `window`
-    samples from which p50/p95/p99 are computed on demand. Bounded by
+    samples from which p50/p95/p99/p99.9 are computed on demand. Bounded by
     construction — an always-on series can never grow past its window
     no matter how long the process lives."""
 
@@ -137,7 +149,7 @@ class Histogram:
             out["min"] = self.min
             out["max"] = self.max
             for q in QUANTILES:
-                out[f"p{int(q * 100)}"] = _quantile(xs, q)
+                out[quantile_key(q)] = _quantile(xs, q)
         return out
 
 

@@ -84,6 +84,54 @@ def test_histogram_empty_snapshot_is_well_typed():
     assert snap == {"count": 0, "sum": 0.0, "window": 0}
 
 
+def test_p99_9_is_window_max_nearest_rank():
+    """The serving-SLO tail row: over the 512-sample default window,
+    nearest-rank p99.9 (ceil(0.999 * 512) = 512) IS the window max —
+    the honest worst-observed-step readout, keyed p99_9 so it can
+    never collide with p99 (int(q*100) maps both to 99)."""
+    from accl_tpu.telemetry.metrics import quantile_key
+
+    assert quantile_key(0.999) == "p99_9"
+    assert quantile_key(0.99) == "p99"
+    h = Histogram()  # default window: 512
+    for i in range(1000):
+        h.observe(float(i))
+    snap = h.snapshot()
+    assert snap["window"] == 512
+    assert snap["p99_9"] == 999.0 == snap["max"]
+    assert snap["p99"] <= snap["p99_9"]
+    # exposed in Prometheus text as quantile="0.999"
+    reg = MetricsRegistry()
+    reg.histogram("accl_serve_step_seconds", mode="fused").observe(0.25)
+    assert ('accl_serve_step_seconds{mode="fused",quantile="0.999"} 0.25'
+            in reg.expose_text().splitlines())
+
+
+def test_event_schema_pins_registry_quantile_keys():
+    """The embedded-trace-meta schema and the live registry must agree
+    on the histogram row shape: every QUANTILES key (via quantile_key)
+    appears as a typed schema property, the schema admits a real
+    snapshot row, and additionalProperties=False means a quantile
+    added to one side without the other fails here."""
+    from accl_tpu.telemetry.export import EVENT_SCHEMA
+    from accl_tpu.telemetry.metrics import QUANTILES, quantile_key
+
+    row_schema = (EVENT_SCHEMA["properties"]["meta"]["properties"]
+                  ["metrics"]["properties"]["histograms"]
+                  ["additionalProperties"]["items"])
+    props = set(row_schema["properties"])
+    qkeys = {quantile_key(q) for q in QUANTILES}
+    assert qkeys <= props, f"schema missing {qkeys - props}"
+    assert row_schema["additionalProperties"] is False
+    extra = props - qkeys - {"labels", "count", "sum", "window",
+                             "min", "max"}
+    assert not extra, f"schema rows carry unpinned keys {extra}"
+    h = Histogram()
+    h.observe(1.0)
+    row = {"labels": {"op": "allreduce"}, **h.snapshot()}
+    assert set(row) <= props
+
+
 def test_prometheus_exposition_format():
     reg = MetricsRegistry()
     reg.counter("accl_calls_total", op="allreduce",

@@ -1,0 +1,266 @@
+"""Fused decode-step + continuous-batching serving tests (the
+latency-floor inference path): the whole decode step — N layers of
+attention/MLP consumers and their TP allreduces plus the logits head —
+runs as ONE recorded SequenceProgram over device-resident KV caches,
+and must be bitwise-identical to the dispatch-per-layer eager twin and
+agree with the full-context training forward; the serving layer's
+continuous batching must be bitwise-equal to sequential per-request
+decode under ragged join/leave; and the SYNTH_LATENCY_MAX_COUNT
+register that routes the step's small allreduces must round-trip
+through exchange memory and leave selection bit-for-bit unchanged at
+register 0."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from accl_tpu.accl import ACCL
+from accl_tpu.constants import (
+    DEFAULT_EAGER_RX_BUF_SIZE,
+    DEFAULT_MAX_EAGER_SIZE,
+    Operation,
+    ReduceFunction,
+    TuningParams,
+    from_numpy_dtype,
+)
+from accl_tpu.descriptor import CallOptions
+from accl_tpu.errors import LintError
+from accl_tpu.models import serve
+from accl_tpu.models import transformer as trf
+from accl_tpu.parallel import make_mesh
+from accl_tpu.sequencer import synthesis
+from accl_tpu.sequencer.plan import Algorithm, select_algorithm
+
+CFG = trf.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_kv_heads=2,
+                            n_layers=2, d_ff=64)
+WORLD = 2
+B, T = 2, 12
+
+
+def _mesh(world=WORLD):
+    return Mesh(np.array(jax.devices()[:world]), ("ccl",))
+
+
+def _params_np(seed=0):
+    return jax.tree.map(np.asarray, trf.init_params(CFG, jax.random.key(seed)))
+
+
+def _fused(params_np, batch=B, max_len=T):
+    accl = ACCL(_mesh())
+    prog, buffers = trf.make_decode_step_program(accl, CFG, params_np,
+                                                 batch=batch,
+                                                 max_len=max_len)
+    return prog, buffers
+
+
+def _eager(params_np, batch=B, max_len=T):
+    accl = ACCL(_mesh())
+    buffers = trf.create_decode_buffers(accl, CFG, batch, max_len)
+    trf.register_decode_consumers(accl, CFG, params_np, buffers.dims)
+    return accl, buffers
+
+
+def test_fused_vs_eager_fuzz_bitwise():
+    """30-seed fuzz: the one-dispatch fused step and the eager
+    layer-by-layer twin produce BITWISE-equal logits on random tokens
+    at random (per-slot ragged) positions. Both sides share identical
+    cache-state evolution, so seeds chain without resets — exactly the
+    long-running serving process."""
+    params_np = _params_np()
+    prog, bf = _fused(params_np)
+    accl_e, be = _eager(params_np)
+    for seed in range(30):
+        rng = np.random.default_rng(52000 + seed)
+        toks = rng.integers(1, CFG.vocab, B)
+        pos = rng.integers(0, T, B)
+        trf.write_decode_inputs(bf, params_np, toks, pos)
+        prog.run(to_device=True)
+        lf = trf.read_decode_logits(bf, sync=True)
+        trf.write_decode_inputs(be, params_np, toks, pos)
+        trf.run_decode_step_eager(accl_e, CFG, be)
+        le = trf.read_decode_logits(be)
+        np.testing.assert_array_equal(
+            lf, le, err_msg=f"seed {seed}: fused != eager (bitwise)")
+
+
+def test_fused_decode_matches_full_forward_oracle():
+    """KV-cache correctness: decoding a sequence token by token through
+    the fused program reproduces the full-context training forward
+    (make_forward) position by position — the cache IS the context."""
+    params = trf.init_params(CFG, jax.random.key(1))
+    params_np = jax.tree.map(np.asarray, params)
+    prog, bf = _fused(params_np)
+    toks = np.random.default_rng(7).integers(1, CFG.vocab, (B, T)) \
+        .astype(np.int32)
+    omesh = make_mesh({"dp": 1, "sp": 1, "tp": WORLD},
+                      devices=jax.devices()[:WORLD])
+    ref = np.asarray(trf.make_forward(CFG, omesh)(
+        trf.shard_params(params, CFG, omesh), toks))
+    for t in range(T):
+        trf.write_decode_inputs(bf, params_np, toks[:, t],
+                                np.full(B, t, np.int64))
+        prog.run(to_device=True)
+        lf = trf.read_decode_logits(bf, sync=True)
+        np.testing.assert_allclose(lf, ref[:, t], rtol=2e-4, atol=2e-4,
+                                   err_msg=f"position {t}")
+
+
+def test_batched_equals_sequential_ragged_join_leave():
+    """Continuous batching parity: ragged prompts multiplexed over
+    fewer slots than requests (forced join/leave churn mid-stream)
+    generate the SAME tokens as draining each request alone through the
+    same program — and as the eager server."""
+    params_np = _params_np(seed=2)
+    rng = np.random.default_rng(5)
+    prompts = [list(map(int, rng.integers(1, CFG.vocab,
+                                          int(rng.integers(1, 5)))))
+               for _ in range(5)]
+
+    def run(mode, sequential):
+        srv = serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                                 batch=3, max_len=T, mode=mode)
+        if sequential:
+            outs = []
+            for p in prompts:
+                outs.extend(serve.generate(srv, [p], 4))
+            return outs
+        return serve.generate(srv, prompts, 4)
+
+    batched = run("fused", sequential=False)
+    assert batched == run("fused", sequential=True), \
+        "batched != sequential (join/leave churn leaked between slots)"
+    assert batched == run("eager", sequential=False), \
+        "fused server != eager server"
+    assert all(len(g) == 4 for g in batched)
+
+
+def test_serve_slot_reuse_needs_no_cache_reset():
+    """A slot's next occupant starts at pos 0 and the causal mask hides
+    the previous occupant's stale cache tail: one slot serving two
+    requests back to back matches two fresh single-request servers."""
+    params_np = _params_np(seed=3)
+    srv = serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                             batch=1, max_len=T)
+    a = serve.generate(srv, [[5, 9, 2]], 4)[0]
+    b = serve.generate(srv, [[7, 1]], 4)[0]  # reuses the dirty slot
+    fresh = serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                               batch=1, max_len=T)
+    assert b == serve.generate(fresh, [[7, 1]], 4)[0]
+    fresh2 = serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                                batch=1, max_len=T)
+    assert a == serve.generate(fresh2, [[5, 9, 2]], 4)[0]
+
+
+def test_serve_rejects_bad_requests():
+    params_np = _params_np()
+    srv = serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                             batch=1, max_len=8)
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit([], 2)
+    with pytest.raises(ValueError, match="vocab"):
+        srv.submit([CFG.vocab], 2)
+    with pytest.raises(ValueError, match="max_len"):
+        srv.submit([1, 2, 3], 8)
+    with pytest.raises(ValueError, match="mode"):
+        serve.DecodeServer(ACCL(_mesh()), CFG, params_np,
+                           batch=1, max_len=8, mode="speculative")
+
+
+def test_decode_lint_requires_persistent_annotation(monkeypatch):
+    """The fused step's cross-dispatch KV reads are admitted ONLY
+    through the explicit persistent annotation: strip it and the linter
+    rejects the recording (ACCL101 — reads wider than any in-sequence
+    producer wrote), proving the waiver is scoped, not a lint hole."""
+    monkeypatch.setattr(trf.DecodeBuffers, "persistent",
+                        property(lambda self: ()))
+    with pytest.raises(LintError):
+        trf.make_decode_step_program(ACCL(_mesh()), CFG, _params_np(),
+                                     batch=B, max_len=T)
+
+
+def test_decode_dims_validation():
+    with pytest.raises(ValueError):
+        trf.decode_dims(CFG, 3, B, T)  # 3 does not divide heads/ff
+    bad = dataclasses.replace(CFG, dtype="bfloat16")
+    with pytest.raises(ValueError):
+        trf.decode_dims(bad, WORLD, B, T)
+
+
+# -- the SYNTH_LATENCY_MAX_COUNT register ------------------------------
+
+_SEL_KW = dict(max_eager_size=DEFAULT_MAX_EAGER_SIZE,
+               eager_rx_buf_size=DEFAULT_EAGER_RX_BUF_SIZE)
+
+
+def _lat_worlds():
+    """Worlds with committed latency-grid entries."""
+    return sorted({e.spec.world for e in synthesis.library().values()
+                   if e.spec.grid == "lat"})
+
+
+def test_latency_register_round_trip_through_exchange_memory():
+    """The register survives the facade -> exchange-memory -> device
+    tuning() round trip, and inside its window the full facade plan
+    resolution returns a latency-grid entry."""
+    from accl_tpu.device.tpu_device import TPUDevice
+
+    world = WORLD
+    dev = TPUDevice(_mesh(world))
+    accl = ACCL(device=dev)
+    accl.configure_tuning_parameters(
+        TuningParams(synth_latency_max_count=16384))
+    assert dev.tuning().synth_latency_max_count == 16384
+    count = 2048  # 8 KiB: inside the window
+    plan, _, _ = dev._resolve_step(
+        CallOptions(scenario=Operation.allreduce, count=count,
+                    function=int(ReduceFunction.SUM),
+                    data_type=from_numpy_dtype(np.dtype(np.float32))),
+        dev._comm_ctx(0))
+    assert plan.algorithm == Algorithm.SYNTHESIZED
+    assert synthesis.entry_for_key(plan.synth_key).spec.grid == "lat"
+
+
+def test_register_zero_selection_bit_for_bit_unchanged():
+    """Register 0 (the default) must leave selection IDENTICAL to the
+    pre-register behavior at every latency-grid size and beyond — the
+    established compatibility pin for new crossover registers — and in
+    particular must never pick a latency-grid entry."""
+    explicit_zero = TuningParams(synth_latency_max_count=0)
+    for world in _lat_worlds():
+        for nbytes in (*synthesis.SIZE_GRID_LAT, 128 * 1024, 1 << 20):
+            count = nbytes // 4
+            a = select_algorithm(Operation.allreduce, count, 4, world,
+                                 tuning=TuningParams.default(), **_SEL_KW)
+            b = select_algorithm(Operation.allreduce, count, 4, world,
+                                 tuning=explicit_zero, **_SEL_KW)
+            assert a == b, f"w{world}/{nbytes}B: register-0 drifted"
+            if a.algorithm == Algorithm.SYNTHESIZED:
+                spec = synthesis.entry_for_key(a.synth_key).spec
+                assert spec.grid != "lat", \
+                    f"w{world}/{nbytes}B: lat entry leaked past register 0"
+
+
+def test_latency_register_window_scopes_selection():
+    """With the register open, selection changes ONLY inside the
+    window: sizes above it match register-0 plans field-for-field."""
+    reg = 16384
+    lat = TuningParams(synth_latency_max_count=reg)
+    for world in _lat_worlds():
+        hits = 0
+        for nbytes in (*synthesis.SIZE_GRID_LAT, 128 * 1024):
+            count = nbytes // 4
+            a = select_algorithm(Operation.allreduce, count, 4, world,
+                                 tuning=lat, **_SEL_KW)
+            b = select_algorithm(Operation.allreduce, count, 4, world,
+                                 tuning=TuningParams.default(), **_SEL_KW)
+            if nbytes > reg:
+                assert a == b, \
+                    f"w{world}/{nbytes}B: selection moved OUTSIDE window"
+            elif a.algorithm == Algorithm.SYNTHESIZED and \
+                    synthesis.entry_for_key(a.synth_key).spec.grid == "lat":
+                hits += 1
+        assert hits > 0, f"w{world}: window admitted no lat entry"

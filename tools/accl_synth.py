@@ -122,10 +122,15 @@ def run_search(args, export: bool) -> bool:
           f"beta {link.beta / 1e9:.3f} GB/s")
     n_winners = 0
     written: set[str] = set()
+    grid = getattr(args, "grid", "std")
+    if grid == "lat" and args.tiers:
+        raise SystemExit("--grid lat scores flat candidates only "
+                         "(tiered windows live behind the hier "
+                         "register, not the latency window)")
     for world in args.worlds:
         for op_name in args.ops:
             results = synthesis.search(OPS[op_name], world, link,
-                                       beam=args.beam,
+                                       beam=args.beam, grid=grid,
                                        log=lambda m: print("  " + m))
             for res in results:
                 n_winners += 1
@@ -160,7 +165,9 @@ def run_search(args, export: bool) -> bool:
         # fail it forever with advice (--export) that otherwise could
         # not resolve the failure. Out-of-scope entries (ops/worlds/
         # factorings not searched this run) are kept untouched — a
-        # flat search never prunes tiered entries and vice versa.
+        # flat search never prunes tiered entries and vice versa, and
+        # a std-grid search never prunes latency-grid entries (nor the
+        # reverse) — the two windows are scored on different grids.
         op_names = {OPS[o].name for o in args.ops}
         searched_tiers = set(tier_specs)
         for p in sorted(synthesis.library_dir().glob("*.json")):
@@ -171,7 +178,8 @@ def run_search(args, export: bool) -> bool:
             in_scope = (
                 (spec.tiers and tuple(spec.tiers) in searched_tiers)
                 or (not spec.tiers and spec.op in op_names
-                    and spec.world in args.worlds))
+                    and spec.world in args.worlds
+                    and spec.grid == grid))
             if in_scope:
                 p.unlink()
                 print(f"  pruned {_rel(p)} "
@@ -193,7 +201,7 @@ def run_score(args) -> bool:
           f"{'hand_us':>10s}  verdict")
     for key, entry in sorted(entries.items()):
         s = entry.spec
-        for nbytes in synthesis.SIZE_GRID:
+        for nbytes in synthesis.grid_for(s):
             count = max(nbytes // 4, 1)
             if s.tiers:
                 # per-tier scoring against the striped composition —
@@ -229,6 +237,11 @@ def main(argv=None) -> int:
                     choices=sorted(OPS))
     ap.add_argument("--tiers", nargs="+", default=None, metavar="LxP",
                     help="factored topologies to search, e.g. 2x4 4x4")
+    ap.add_argument("--grid", default="std", choices=["std", "lat"],
+                    help="scoring grid for flat searches: std = the "
+                         "1 KiB-16 MiB bandwidth grid, lat = the "
+                         "1-64 KiB latency grid behind "
+                         "SYNTH_LATENCY_MAX_COUNT")
     ap.add_argument("--beam", type=int, default=None,
                     help="certify only the N best predicted advantages")
     ap.add_argument("--timing-model", default=str(DEFAULT_MODEL))

@@ -225,6 +225,68 @@ def section_flagship(out: list[str]) -> None:
         out.append("")
 
 
+def section_serving(out: list[str]) -> None:
+    """The interactive-serving lane (`bench.py --serve-gate` verdict):
+    fused-vs-eager decode step, continuous-batching throughput/tail,
+    the calibrated lat-cell selection, and the shaped-WAN soak.
+    CPU-emulator numbers — the framework's own seams, not hardware."""
+    p = LOG / "serve_gate.json"
+    out.append("## Interactive serving — KV-decode step "
+               "(`serve_gate.json`)\n")
+    if not p.exists():
+        out.append("*absent — no serve-gate run committed*\n")
+        return
+    try:
+        d = json.loads(p.read_text())
+    except ValueError:
+        out.append("*unreadable*\n")
+        return
+    parity = d.get("parity", {})
+    tail = d.get("step_tail_ms", {})
+    wan = d.get("wan_step_tail_ms", {})
+    lat = d.get("lat_cell", {})
+    fails = d.get("fails", [])
+    out.append(
+        f"**Headline:** fused one-dispatch decode step "
+        f"{d.get('fused_ms_per_step', '?')} ms vs eager "
+        f"layer-by-layer {d.get('eager_ms_per_step', '?')} ms = "
+        f"**{d.get('fused_speedup', '?')}x** (floor "
+        f"{d.get('fused_speedup_floor', '?')}x), "
+        f"{d.get('tokens_per_s', '?')} tokens/s at "
+        f"{d.get('batch_slots', '?')} slots. Platform: "
+        f"{d.get('platform', '?')} — functional regime, not a "
+        "hardware claim.\n")
+    out.append("| Lane | Result |\n|---|---|")
+    out.append(f"| parity batched==sequential | "
+               f"{parity.get('batched_eq_sequential', '?')} |")
+    out.append(f"| parity fused==eager | "
+               f"{parity.get('fused_eq_eager', '?')} |")
+    out.append(f"| step tail p50 / p99 / p99.9 (ms) | "
+               f"{tail.get('p50', '?')} / {tail.get('p99', '?')} / "
+               f"{tail.get('p99_9', '?')} |")
+    if lat:
+        out.append(
+            f"| lat cell ({lat.get('nbytes', '?')} B, window "
+            f"{_fmt_bytes(int(lat.get('window_bytes', 0) or 0))}) | "
+            f"`{lat.get('key', '?')}` predicted "
+            f"{lat.get('predicted_lat_us', '?')} us vs hand "
+            f"{lat.get('predicted_hand_us', '?')} us; measured "
+            f"(memcpy mesh, unvarnished) {lat.get('measured_lat_us', '?')}"
+            f" us vs register-0 {lat.get('measured_reg0_us', '?')} us "
+            f"({lat.get('reg0_algorithm', '?')}) |")
+    out.append(f"| shaped-WAN soak p50 / p99 / p99.9 (ms/step) | "
+               f"{wan.get('p50', '?')} / {wan.get('p99', '?')} / "
+               f"{wan.get('p99_9', '?')} (p99 ceiling "
+               f"{d.get('wan_p99_ceiling_s', '?')} s) |")
+    out.append(f"| gate verdict | "
+               f"{'FAIL: ' + '; '.join(fails) if fails else 'pass'} |")
+    out.append("")
+    out.append("The lat-cell measured column is the dispatch-structure "
+               "cost on the memcpy-wire mesh (no per-hop alpha there); "
+               "the selection win is gated on the calibrated-link "
+               "prediction. See docs/serving.md.\n")
+
+
 def section_rt_stats(out: list[str]) -> None:
     """Sequencer counter evidence (tools/rt_stats_sweep.py) and what it
     established about the emulator's cost structure."""
@@ -344,6 +406,7 @@ def main() -> int:
     section_trajectory(out)
     section_tpu(out)
     section_flagship(out)
+    section_serving(out)
     section_emulator(out)
     section_rt_stats(out)
     section_timing(out)
