@@ -94,6 +94,10 @@ class ACCL:
         # model-derived deadline post-completion (one perf_counter pair
         # + a cached policy lookup; None = zero overhead)
         self._resilience: ResilienceManager | None = None
+        # lazily-built cross-program interference certifier (see
+        # certify_concurrent): long-lived so its per-pair verdict cache
+        # spans admissions of a stable tenant set
+        self._interference = None
         # placeholder rank buffers backing the buffer-less stream forms
         # (reference send/recv/copy overloads that take only a dataType,
         # accl.hpp:190,278,349): one per (count, dtype), reused
@@ -769,6 +773,57 @@ class ACCL:
         return SequenceRecorder(self, comm, lint=lint,
                                 persistent=persistent)
 
+    def certify_concurrent(self, programs, mode: str = "error"):
+        """Prove a set of compiled SequencePrograms safe to dispatch
+        CONCURRENTLY: pairwise non-interference over their footprint
+        summaries (O(N^2) dict-sized checks), escalating a pair to the
+        bounded cross-program product model check only when its
+        summaries overlap (analysis/interference.py, ACCL601-604).
+
+        A clean verdict means any interleaving of the set is equivalent
+        to its serial composition — the admission criterion the
+        multi-tenant sequencer (ROADMAP item 1) checks certificates
+        against. On success every program is stamped with the set's
+        certificate id (`SequenceProgram.certificate`), which then
+        rides its dispatch spans so the flight recorder can name the
+        admitted set a wedged dispatch belonged to.
+
+        `programs` may mix SequenceProgram handles and raw
+        ProgramFootprint summaries (a remote tenant's shipped
+        footprint). `mode` follows the lint gate: "error" raises
+        LintError on findings, "warn" logs them, "off" skips
+        enforcement; all modes return the diagnostic list. Verdicts are
+        cached per pair on this ACCL, keyed by the two composite
+        signatures."""
+        from .analysis.diagnostics import enforce
+        from .analysis.interference import (InterferenceCertifier,
+                                            ProgramFootprint,
+                                            certificate_id)
+
+        if self._interference is None:
+            self._interference = InterferenceCertifier()
+        footprints = []
+        handles = []
+        for p in programs:
+            if isinstance(p, ProgramFootprint):
+                footprints.append(p)
+                continue
+            fp = getattr(p, "footprint", None)
+            if fp is None:
+                raise ValueError(
+                    f"{type(p).__name__} carries no interference "
+                    "footprint (pass SequenceProgram handles or "
+                    "ProgramFootprint summaries)")
+            footprints.append(fp)
+            handles.append(p)
+        diags = self._interference.certify(footprints)
+        if not diags:
+            cert = certificate_id(footprints)
+            for h in handles:
+                h._prepared.cert = cert
+        enforce(diags, mode)
+        return diags
+
     def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
         multi-communicator support: the firmware caches the addressed
@@ -1289,6 +1344,26 @@ class SequenceProgram:
         """The per-step Plans the batch resolved to (frozen)."""
         return self._prepared.plans
 
+    @property
+    def signature(self):
+        """Composite-signature digest of the recorded batch: the
+        compile/lint cache key and the interference-verdict cache key
+        half — available whether or not a tracer was live at compile."""
+        return self._prepared.sig
+
+    @property
+    def footprint(self):
+        """The program's interference summary (ProgramFootprint), the
+        input to ACCL.certify_concurrent."""
+        return getattr(self._prepared, "footprint", None)
+
+    @property
+    def certificate(self):
+        """Certificate id of the pairwise-clean concurrent set this
+        program was last admitted into (None until certify_concurrent
+        passes it)."""
+        return getattr(self._prepared, "cert", None)
+
     def run(self, *, from_device=False, to_device=False, run_async=False):
         """Dispatch the compiled batch over the bound buffers' current
         contents; same sync semantics as SequenceRecorder.run()."""
@@ -1305,4 +1380,7 @@ class SequenceProgram:
                 sig = getattr(req, "signature", None)
                 if sig is not None:
                     sp.set(signature=sig)
+                cert = getattr(req, "interference_cert", None)
+                if cert is not None:
+                    sp.set(interference_cert=cert)
             return ret

@@ -25,6 +25,9 @@ diagnostics with stable codes (docs/lint.md has the full table):
                 schedule synthesis
   semantics.py  contribution-set abstract interpretation proving each
                 batch computes its DECLARED collective (ACCL501-504)
+  interference.py cross-program non-interference: footprint summaries
+                per program, O(N^2) pairwise certification with bounded
+                product-modelcheck escalation  (ACCL601-604)
   linter.py     the SequenceLinter orchestrator + lint_sequence()
 
 Wired in three places: the opt-out `lint=` stage in `ACCL.sequence()`
@@ -44,6 +47,15 @@ from .modelcheck import (  # noqa: F401
     diagnose_programs,
 )
 from .hopdag import HopDag  # noqa: F401
+from .interference import (  # noqa: F401
+    InterferenceCertifier,
+    ProgramFootprint,
+    TrafficSummary,
+    certificate_id,
+    certify_concurrent,
+    footprint_from_rank_programs,
+    footprint_from_steps,
+)
 from .protocol import (  # noqa: F401
     ANY_SRC,
     Event,

@@ -10,6 +10,9 @@ same identifiers (docs/lint.md holds the user-facing table):
   ACCL4xx  descriptor validation (shape, dtype, root, communicator)
   ACCL5xx  semantic defects: the batch's final contribution sets differ
            from the declared collective (semantics.py)
+  ACCL6xx  cross-program interference: two INDIVIDUALLY certified
+           programs that are not safe to dispatch concurrently
+           (interference.py)
 
 Severity semantics: an `error` is a batch the analyzer can prove wrong
 on SOME shipping executor (stale reads, deadlock, slot cross-talk,
@@ -93,6 +96,21 @@ CODES: dict[str, tuple[str, str, str]] = {
     "ACCL504": ("stale-read", "error",
                 "a hop forwards a region before its producer wrote it "
                 "(program-order violation in the hop DAG)"),
+    "ACCL601": ("cross-program-overlap", "error",
+                "two concurrent programs touch the same buffer region "
+                "or stream endpoint with at least one writer: their "
+                "interleaving is not equivalent to serial composition"),
+    "ACCL602": ("cross-program-tag-collision", "error",
+                "traffic of one program is matchable by another on a "
+                "shared communicator (e.g. a wildcard recv in program A "
+                "can steal a send posted by program B)"),
+    "ACCL603": ("cross-program-slot-collision", "error",
+                "two concurrent programs claim the same collective_id "
+                "ring slot with no cross-program ordering"),
+    "ACCL604": ("summary-unliftable", "error",
+                "a program's interference footprint could not be "
+                "extracted or composed: the pair is UNVERIFIED, which "
+                "must never read as certified"),
 }
 
 

@@ -107,6 +107,14 @@ class TPUDevice(CCLODevice):
         # threads (match-or-enqueue on send, match-or-park on recv) and
         # by waiter threads firing timeouts (unpark)
         self._recv_mu = threading.Lock()
+        # XLA's CPU cross-module collectives rendezvous per device SET,
+        # not per executable: two collective programs launched
+        # concurrently over the same mesh interleave their participants
+        # in one rendezvous and deadlock. The emulated CCLO has a single
+        # sequencer anyway, so executable launches serialize here —
+        # concurrent dispatches interleave at PROGRAM granularity, the
+        # exact model certify_concurrent proves order-equivalence for.
+        self._launch_mu = threading.Lock()
         self._pending_recvs: dict[tuple, list[ParkedRecvRequest]] = {}
         # Kernel-stream endpoints (strm != 0 routing, SURVEY.md §3.4).
         from ..ops.streams import StreamRegistry
@@ -442,7 +450,9 @@ class TPUDevice(CCLODevice):
             if scen == Operation.combine:
                 args.append(self._rows_to_submesh(op1.device, ctx, in_n))
 
-        out = fn(*args)
+        with self._launch_mu:  # one collective executable in flight
+            out = fn(*args)
+            jax.block_until_ready(out)
 
         def place(req):
             if res is not None and scen != Operation.barrier:
@@ -539,15 +549,17 @@ class TPUDevice(CCLODevice):
         tracer = get_tracer()
         # the composite signature tags every phase/step span, so one
         # batch's record -> lint -> compile -> dispatch pipeline can be
-        # followed across tracks in the exported trace. A content digest,
-        # not hash(): enum hashes are PYTHONHASHSEED-salted, and the
+        # followed across tracks in the exported trace, and it keys the
+        # per-pair interference-verdict cache. A content digest, not
+        # hash(): enum hashes are PYTHONHASHSEED-salted, and the
         # signature must match across runs so archived traces correlate.
-        sig = None
-        if tracer.active:
-            import hashlib
+        # Computed unconditionally — a program prepared with tracing OFF
+        # must still dispatch with its signature (a tracer enabled later,
+        # and certify_concurrent, both need it).
+        import hashlib
 
-            sig = hashlib.sha256(
-                repr(desc.signature()).encode()).hexdigest()[:16]
+        sig = hashlib.sha256(
+            repr(desc.signature()).encode()).hexdigest()[:16]
         with tracer.span("record", cat="phase", track="device") as sp:
             sp.set(signature=sig, n_steps=len(desc.steps))
             plans = []
@@ -575,8 +587,21 @@ class TPUDevice(CCLODevice):
                         f"sequence needs {need} elements in buffer "
                         f"{addr:#x}, which holds {have}")
             fn = ctx.compiler.compile_sequence(seq)
+        # the interference summary rides every prepared program — pure
+        # Python over the descriptors (the exact-event thunk defers any
+        # tracing to an escalated pair), so extraction is O(steps)
+        from ..analysis.interference import footprint_from_steps
+
+        footprint = footprint_from_steps(
+            desc.steps, ctx.world,
+            persistent=frozenset(persistent),
+            use_pallas_ring=ctx.compiler.use_pallas_ring,
+            pallas_ring_overlap=ctx.compiler.pallas_ring_overlap,
+            plans=tuple(plans), axis_name=self.axis_name,
+            signature=sig)
         return _PreparedSequence(desc=desc, plans=tuple(plans), seq=seq,
-                                 fn=fn, bufs=bufs, ctx=ctx, sig=sig)
+                                 fn=fn, bufs=bufs, ctx=ctx, sig=sig,
+                                 footprint=footprint)
 
     def dispatch_sequence(self, prepared: "_PreparedSequence") -> BaseRequest:
         """The dispatch half of `start_sequence`: run a prepared batch's
@@ -591,6 +616,11 @@ class TPUDevice(CCLODevice):
         tracer = get_tracer()
         with tracer.span("dispatch", cat="phase", track="device") as sp:
             sp.set(signature=sig)
+            if prepared.cert is not None:
+                # a certify_concurrent-stamped tenant: the flight
+                # recorder can name which admitted set this dispatch
+                # belonged to when it wedges
+                sp.set(interference_cert=prepared.cert)
             args = []
             for addr in seq.buffer_addrs:
                 buf = bufs[addr]
@@ -602,7 +632,12 @@ class TPUDevice(CCLODevice):
                 else:
                     args.append(self._rows_to_submesh(arr, ctx,
                                                       arr.shape[-1]))
-            outs = fn(*args)
+            # serialize the launch (see _launch_mu): async dispatch must
+            # not let a second tenant's collectives enter the rendezvous
+            # before this program's have all arrived, so block inside
+            with self._launch_mu:
+                outs = fn(*args)
+                jax.block_until_ready(outs)
 
         out_bufs = [bufs[a] for a in seq.out_addrs]
 
@@ -616,6 +651,12 @@ class TPUDevice(CCLODevice):
                     buf.device = self._scatter_rows(buf.device, ctx, out)
 
         req = SequenceRequest(list(outs), list(plans), on_complete=place)
+        # the signature names the program on the request whether or not
+        # a tracer is live — telemetry attached later (or a debugger
+        # poking a wedged request) must still see which program owns it
+        req.signature = sig
+        if prepared.cert is not None:
+            req.interference_cert = prepared.cert
         if tracer.active:
             # per-step marker spans: the fused program executes the steps
             # inside ONE dispatch, so each step carries its timing.predict
@@ -625,7 +666,6 @@ class TPUDevice(CCLODevice):
             # plans), so they are computed once per handle, not per
             # dispatch (the re-resolution cost prepare/dispatch splits
             # out must not sneak back in through telemetry).
-            req.signature = sig
             if prepared.preds is None:
                 prepared.preds = [self._predict_call(o, p, ctx.world)
                                   for o, p in zip(desc.steps, plans)]
@@ -980,9 +1020,10 @@ class _PreparedSequence:
     contents flow in)."""
 
     __slots__ = ("desc", "plans", "seq", "fn", "bufs", "ctx", "sig",
-                 "preds")
+                 "preds", "footprint", "cert")
 
-    def __init__(self, desc, plans, seq, fn, bufs, ctx, sig):
+    def __init__(self, desc, plans, seq, fn, bufs, ctx, sig,
+                 footprint=None):
         self.desc = desc
         self.plans = plans
         self.seq = seq
@@ -994,6 +1035,12 @@ class _PreparedSequence:
         # first traced dispatch and reused (pure function of the frozen
         # steps + plans)
         self.preds = None
+        # the cross-program interference summary (analysis/interference
+        # ProgramFootprint) and, once ACCL.certify_concurrent admits
+        # this program into a pairwise-clean set, the certificate id
+        # naming that set — threaded through dispatch spans/requests
+        self.footprint = footprint
+        self.cert = None
 
 
 class _CommCtx:

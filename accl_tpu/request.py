@@ -144,9 +144,13 @@ class SequenceRequest(TPURequest):
         super().__init__("sequence", outputs, on_complete=on_complete)
         self.plans = list(plans)
         self.num_steps = len(self.plans)
-        # set by the device when tracing: content hash of the recorded
-        # descriptor batch, the cache key the dispatch tests read
+        # set by the device on every dispatch (tracing or not): content
+        # hash of the recorded descriptor batch — the compile/lint cache
+        # key, the interference-verdict cache key half, and the span tag
         self.signature: str | None = None
+        # certificate id of the pairwise-clean tenant set this program
+        # was admitted into by ACCL.certify_concurrent, if any
+        self.interference_cert: str | None = None
         # exactly one device dispatch happened for the whole batch — the
         # observable inversion the sequence layer exists for (bench.py's
         # sequence_fused_vs_eager row and the cache-hit test read this)

@@ -60,6 +60,26 @@ def step_out_elems(options, world: int) -> int:
         else options.count
 
 
+def step_accesses(
+    options: Any, world: int
+) -> tuple[list[tuple[int, int]], list[tuple[int, int]]]:
+    """(reads, writes) of one step as (address, prefix elems) pairs —
+    the exact access model the hazard pass reasons over (every
+    sequence-able op touches a PREFIX region at offset 0: the wide
+    in/out rule above is the only width variation), shared with the
+    cross-program footprint extractor (analysis/interference.py) so the
+    two layers can never disagree on what a step touches."""
+    reads: list[tuple[int, int]] = []
+    if options.addr_0:
+        reads.append((options.addr_0, step_in_elems(options, world)))
+    if options.addr_1:
+        reads.append((options.addr_1, options.count))
+    writes: list[tuple[int, int]] = []
+    if options.addr_2:
+        writes.append((options.addr_2, step_out_elems(options, world)))
+    return reads, writes
+
+
 @dataclasses.dataclass(frozen=True)
 class _Step:
     """One lowered stage: its descriptor/plan plus the resolved dataflow

@@ -461,6 +461,57 @@ def measure_lint_overhead(jax, world, n_elems=8192, iters=20):
     return lint_sec, record_compile, lint_sec / record_compile
 
 
+def measure_interference_overhead(jax, world, n_elems=8192, iters=20):
+    """The cross-program footprint layer's cost against the
+    record+compile time it rides: footprint extraction happens inside
+    EVERY prepare_sequence, and certify_concurrent's pairwise check is
+    what a multi-tenant admission pays per proposed set. Times (a) a
+    cold footprint_from_steps over the smoke chain's descriptors plus
+    (b) an uncached pairwise certify of two disjoint such programs
+    (fresh certifier each iter — the cached path is ~a dict hit and
+    would measure nothing). Returns (layer_sec, record_compile_sec,
+    ratio); the smoke gate asserts ratio < 0.05, same budget as the
+    lint stage — summaries must stay invisible next to the compile."""
+    from jax.sharding import Mesh
+
+    from accl_tpu import ReduceFunction
+    from accl_tpu.accl import ACCL
+    from accl_tpu.analysis.interference import (InterferenceCertifier,
+                                                footprint_from_steps)
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("ccl",))
+    accl = ACCL(mesh)
+    n = (n_elems // world) * world
+    chunk = n // world
+
+    def record_chain():
+        a = accl.create_buffer(n)
+        b = accl.create_buffer(chunk)
+        c = accl.create_buffer(n)
+        t0 = time.perf_counter()
+        seq = accl.sequence(lint="off")
+        seq.reduce_scatter(a, b, chunk, ReduceFunction.SUM)
+        seq.allgather(b, c, chunk)
+        seq.bcast(c, n, 0)
+        steps = list(seq.calls)
+        seq.run(from_device=True, to_device=True).wait()
+        return steps, time.perf_counter() - t0
+
+    steps_a, record_compile = record_chain()
+    steps_b, _ = record_chain()  # disjoint buffers: the clean fast path
+
+    def layer():
+        fa = footprint_from_steps(steps_a, world, label="A")
+        fb = footprint_from_steps(steps_b, world, label="B")
+        cert = InterferenceCertifier()  # cold cache: full pairwise cost
+        diags = cert.certify([fa, fb])
+        assert not diags and cert.escalations == 0
+
+    layer()  # warm imports
+    layer_sec = min(_time_wall(layer) for _ in range(iters))
+    return layer_sec, record_compile, layer_sec / record_compile
+
+
 def _time_wall(fn):
     t = time.perf_counter()
     fn()
@@ -3660,6 +3711,12 @@ def _smoke_main():
     print(f"  lint stage {lint_sec*1e6:8.1f} us vs record+compile "
           f"{rc_sec*1e3:8.1f} ms ({lint_ratio*100:.3f}%)",
           file=sys.stderr)
+    intf_sec, intf_rc, intf_ratio = measure_interference_overhead(jax, world)
+    rows.append(("interference_footprint_overhead", 0, intf_sec,
+                 intf_ratio, 1.0, True))
+    print(f"  footprint+certify {intf_sec*1e6:8.1f} us vs record+compile "
+          f"{intf_rc*1e3:8.1f} ms ({intf_ratio*100:.3f}%)",
+          file=sys.stderr)
     # disabled-telemetry overhead against the fused chain this very run
     # measured — instrumentation must be free when off (shared gate:
     # telemetry_disabled_gate, same constants as bench.py --trace)
@@ -3713,6 +3770,14 @@ def _smoke_main():
     if lint_ratio >= 0.05:
         print(f"FAIL: lint stage costs {lint_ratio*100:.1f}% of "
               "record+compile time (>= 5% budget)", file=sys.stderr)
+        sys.exit(1)
+    # ... and so must the cross-program footprint layer: extraction
+    # rides every prepare_sequence and the pairwise certify fronts
+    # multi-tenant admission (same 5% budget as the lint stage)
+    if intf_ratio >= 0.05:
+        print(f"FAIL: footprint extraction + pairwise certify costs "
+              f"{intf_ratio*100:.1f}% of record+compile time "
+              "(>= 5% budget)", file=sys.stderr)
         sys.exit(1)
     # the telemetry gate: the disabled tracing path fronts EVERY facade
     # call, so its cost must stay invisible (shared budget with --trace)

@@ -1,0 +1,462 @@
+"""Cross-program interference certifier (analysis/interference.py).
+
+Three layers of evidence that `certify_concurrent` proves what it
+claims — any interleaving of a certified set is equivalent to its
+serial composition:
+
+  1. unit: each ACCL6xx verdict fires on its defect class and ONLY
+     there (summary tier exact for memory/streams/slots, escalation
+     tier refutes coarse tag overlaps or confirms them with the
+     offending cross-program match pair);
+  2. facade: footprints ride every compiled SequenceProgram, verdicts
+     cache per signature pair, certificates stamp the admitted set and
+     surface through the dispatch telemetry (the satellite-3 fix:
+     signatures flow with tracing OFF too);
+  3. dynamics: a 30-seed two-thread fuzz against the serial-composition
+     oracle on the 8-dev mesh and the native local world — a
+     certified-clean pair agrees bitwise, a seeded ACCL601 mutation
+     provably diverges (order-dependent final state).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCL, ReduceFunction
+from accl_tpu.analysis.interference import (
+    InterferenceCertifier,
+    certificate_id,
+    footprint_from_rank_programs,
+    footprint_from_steps,
+)
+from accl_tpu.analysis.protocol import recv, send
+from accl_tpu.constants import TAG_ANY
+from accl_tpu.errors import LintError
+
+
+def _mk_steps(accl, n, in_buf, out_buf, count=None):
+    """One recorded allreduce in_buf -> out_buf as a compiled program."""
+    seq = accl.sequence()
+    seq.allreduce(in_buf, out_buf, count or n, ReduceFunction.SUM)
+    return seq.compile()
+
+
+def _ring(n_ranks, tag, count=4):
+    """A clean tag-`tag` ring exchange as per-rank event programs."""
+    return [
+        [send((r + 1) % n_ranks, tag, count),
+         recv((r - 1) % n_ranks, tag, count)]
+        for r in range(n_ranks)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# unit: summary tier
+# ---------------------------------------------------------------------------
+
+
+def _steps_fp(accl, bufs_steps, label, **kw):
+    """Footprint of a recorded (never compiled) descriptor batch."""
+    seq = accl.sequence()
+    for op, args in bufs_steps:
+        getattr(seq, op)(*args)
+    fp = footprint_from_steps(seq.calls, accl.world, label=label, **kw)
+    seq._ran = True  # consume: this recorder never runs
+    return fp
+
+
+@pytest.fixture(scope="module")
+def accl8(mesh8):
+    return ACCL(mesh8)
+
+
+def test_disjoint_pair_summary_clean(accl8):
+    a_in, a_out, b_in, b_out = (accl8.create_buffer(64, np.float32)
+                                for _ in range(4))
+    fa = _steps_fp(accl8, [("allreduce",
+                            (a_in, a_out, 16, ReduceFunction.SUM))], "A")
+    fb = _steps_fp(accl8, [("allreduce",
+                            (b_in, b_out, 16, ReduceFunction.SUM))], "B")
+    c = InterferenceCertifier()
+    assert c.certify([fa, fb]) == []
+    assert c.escalations == 0  # summaries alone decided the pair
+
+
+def test_write_write_overlap_rejects_601(accl8):
+    a_in, shared, b_in = (accl8.create_buffer(64, np.float32)
+                          for _ in range(3))
+    fa = _steps_fp(accl8, [("allreduce",
+                            (a_in, shared, 16, ReduceFunction.SUM))], "A")
+    fb = _steps_fp(accl8, [("allreduce",
+                            (b_in, shared, 16, ReduceFunction.SUM))], "B")
+    c = InterferenceCertifier()
+    diags = c.certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL601"]
+    assert "write/write" in diags[0].message
+    assert c.escalations == 0
+
+
+def test_read_write_overlap_rejects_601(accl8):
+    a_in, a_out, b_out = (accl8.create_buffer(64, np.float32)
+                          for _ in range(3))
+    fa = _steps_fp(accl8, [("allreduce",
+                            (a_in, a_out, 16, ReduceFunction.SUM))], "A")
+    # B READS A's output buffer: write/read across the boundary
+    fb = _steps_fp(accl8, [("allreduce",
+                            (a_out, b_out, 16, ReduceFunction.SUM))], "B")
+    diags = InterferenceCertifier().certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL601"]
+    assert "write/read" in diags[0].message
+
+
+def test_shared_stream_endpoint_rejects_601(accl8):
+    from accl_tpu.models.moe import MOE_EXPERT_STREAM
+
+    bufs = [accl8.create_buffer(256, np.float32) for _ in range(4)]
+    fa = _steps_fp(accl8, [("copy", (bufs[0], bufs[1], 16))], "A")
+    fb = _steps_fp(accl8, [("copy", (bufs[2], bufs[3], 16))], "B")
+    assert InterferenceCertifier().certify([fa, fb]) == []
+    # same two tenants, now both riding the expert stream
+    sa = accl8.sequence()
+    sa.copy(bufs[0], bufs[1], 16, res_stream=MOE_EXPERT_STREAM)
+    sb = accl8.sequence()
+    sb.copy(bufs[2], bufs[3], 16, res_stream=MOE_EXPERT_STREAM)
+    fa = footprint_from_steps(sa.calls, accl8.world, label="A")
+    fb = footprint_from_steps(sb.calls, accl8.world, label="B")
+    sa._ran = sb._ran = True
+    diags = InterferenceCertifier().certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL601"]
+    assert "stream endpoint" in diags[0].message
+
+
+def test_ring_slot_collision_rejects_603(accl8):
+    a_in, a_out, b_in, b_out = (accl8.create_buffer(64, np.float32)
+                                for _ in range(4))
+    mk = lambda i, o, label: _steps_fp(  # noqa: E731
+        accl8, [("allreduce", (i, o, 16, ReduceFunction.SUM))], label,
+        use_pallas_ring=True)
+    diags = InterferenceCertifier().certify([mk(a_in, a_out, "A"),
+                                             mk(b_in, b_out, "B")])
+    assert [d.code for d in diags] == ["ACCL603"]
+
+
+def test_unliftable_rejects_604_loudly():
+    broken = footprint_from_steps([object()], 4, label="broken")
+    assert broken.unliftable is not None
+    good = footprint_from_rank_programs(_ring(4, 3), 4, label="good")
+    diags = InterferenceCertifier().certify([good, broken])
+    assert [d.code for d in diags] == ["ACCL604"]
+    assert "UNVERIFIED" in diags[0].message
+
+
+def test_world_mismatch_escalation_rejects_604():
+    # coarse tag overlap across DIFFERENT worlds: the product cannot be
+    # composed, and that must reject, never silently pass
+    fa = footprint_from_rank_programs(_ring(2, 5), 2, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 5), 4, label="B")
+    diags = InterferenceCertifier().certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL604"]
+
+
+# ---------------------------------------------------------------------------
+# unit: escalation tier
+# ---------------------------------------------------------------------------
+
+
+def test_wildcard_steal_escalates_to_602_with_match_pair():
+    fa = footprint_from_rank_programs(
+        [[recv(1, TAG_ANY, 4)], [send(0, 3, 4)]], 2, label="A")
+    fb = footprint_from_rank_programs(
+        [[recv(1, 9, 4)], [send(0, 9, 4)]], 2, label="B")
+    c = InterferenceCertifier()
+    diags = c.certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL602"]
+    assert c.escalations == 1
+    # the offending cross-program pair is rendered in the message
+    assert "matchable by" in diags[0].message
+    assert "tag ANY" in diags[0].message
+
+
+def test_escalation_refutes_coarse_overlap():
+    # A's wildcard recv makes the SUMMARY overlap, but B's traffic
+    # points entirely away from it: the product model check refutes the
+    # pair and it certifies clean — with exactly one escalation paid
+    fa = footprint_from_rank_programs(
+        [[recv(1, TAG_ANY, 4)], [send(0, 3, 4)]], 2, label="A")
+    fb = footprint_from_rank_programs(
+        [[send(1, 9, 4)], [recv(0, 9, 4)]], 2, label="B")
+    c = InterferenceCertifier()
+    assert c.certify([fa, fb]) == []
+    assert c.escalations == 1
+
+
+def test_disjoint_exact_tags_stay_summary_only():
+    fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")
+    c = InterferenceCertifier()
+    assert c.certify([fa, fb]) == []
+    assert c.escalations == 0
+
+
+def test_shared_collective_signature_rejects_602():
+    from accl_tpu.analysis.protocol import coll
+
+    fa = footprint_from_rank_programs(
+        [[coll("allreduce", 16, 0)] for _ in range(4)], 4, label="A")
+    fb = footprint_from_rank_programs(
+        [[coll("allreduce", 16, 0)] for _ in range(4)], 4, label="B")
+    diags = InterferenceCertifier().certify([fa, fb])
+    assert [d.code for d in diags] == ["ACCL602"]
+    assert "coll" in diags[0].message
+
+
+def test_verdict_cache_hits_by_signature_pair():
+    fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")
+    c = InterferenceCertifier()
+    c.certify([fa, fb])
+    assert c.pairs_checked == 1
+    # same pair, either order: pure cache hits
+    c.certify([fb, fa])
+    c.check_pair(fa, fb)
+    assert c.pairs_checked == 1
+
+
+def test_certificate_id_is_order_independent():
+    fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")
+    assert certificate_id([fa, fb]) == certificate_id([fb, fa])
+    assert certificate_id([fa, fb]) != certificate_id([fa, fa])
+
+
+# ---------------------------------------------------------------------------
+# facade: footprints, certificates, telemetry (the satellite-3 fix)
+# ---------------------------------------------------------------------------
+
+
+def test_program_signature_exposed_without_tracing(mesh8):
+    from accl_tpu import telemetry
+
+    assert not telemetry.get_tracer().enabled
+    accl = ACCL(mesh8)
+    a, b = (accl.create_buffer(64, np.float32) for _ in range(2))
+    prog = _mk_steps(accl, 16, a, b)
+    # the satellite-3 defect: these were None whenever the program was
+    # prepared with tracing off, leaving wedged dispatches nameless
+    assert prog.signature is not None
+    assert prog.footprint is not None
+    assert prog.footprint.signature is not None
+    assert prog.certificate is None  # not yet admitted
+
+
+def test_certify_concurrent_stamps_certificates(mesh8):
+    accl = ACCL(mesh8)
+    a_in, a_out, b_in, b_out = (accl.create_buffer(64, np.float32)
+                                for _ in range(4))
+    pa = _mk_steps(accl, 16, a_in, a_out)
+    pb = _mk_steps(accl, 16, b_in, b_out)
+    assert accl.certify_concurrent([pa, pb]) == []
+    assert pa.certificate is not None
+    assert pa.certificate == pb.certificate
+    assert pa.certificate == certificate_id([pa.footprint, pb.footprint])
+    assert accl._interference.escalations == 0
+
+
+def test_certify_concurrent_rejects_overlap_and_leaves_unstamped(mesh8):
+    accl = ACCL(mesh8)
+    a_in, shared, b_in = (accl.create_buffer(64, np.float32)
+                          for _ in range(3))
+    pa = _mk_steps(accl, 16, a_in, shared)
+    pb = _mk_steps(accl, 16, b_in, shared)
+    with pytest.raises(LintError) as ei:
+        accl.certify_concurrent([pa, pb])
+    assert {d.code for d in ei.value.diagnostics} == {"ACCL601"}
+    assert pa.certificate is None and pb.certificate is None
+    # mode="warn" reports without raising
+    diags = accl.certify_concurrent([pa, pb], mode="warn")
+    assert {d.code for d in diags} == {"ACCL601"}
+
+
+def test_dispatch_spans_carry_signature_and_certificate(mesh8):
+    from accl_tpu import telemetry
+
+    accl = ACCL(mesh8)
+    a_in, a_out, b_in, b_out = (accl.create_buffer(64, np.float32)
+                                for _ in range(4))
+    # prepared with tracing OFF — the regression the satellite fixes
+    pa = _mk_steps(accl, 16, a_in, a_out)
+    pb = _mk_steps(accl, 16, b_in, b_out)
+    accl.certify_concurrent([pa, pb])
+    tr = telemetry.get_tracer()
+    tr.clear()
+    tr.enable()
+    try:
+        pa.run()
+        spans = tr.snapshot()
+    finally:
+        tr.clear()
+        tr.disable()
+    disp = next(s for s in spans
+                if s["cat"] == "phase" and s["name"] == "dispatch")
+    assert disp["args"]["signature"] == pa.signature
+    assert disp["args"]["interference_cert"] == pa.certificate
+    seq = next(s for s in spans if s["cat"] == "sequence")
+    assert seq["args"]["signature"] == pa.signature
+    assert seq["args"]["interference_cert"] == pa.certificate
+
+
+def test_mixed_program_and_raw_footprint_inputs(mesh8):
+    accl = ACCL(mesh8)
+    a_in, a_out = (accl.create_buffer(64, np.float32) for _ in range(2))
+    pa = _mk_steps(accl, 16, a_in, a_out)
+    remote = footprint_from_rank_programs(_ring(8, 3), 8, label="remote")
+    assert accl.certify_concurrent([pa, remote]) == []
+    assert pa.certificate is not None  # handles get stamped
+    with pytest.raises(ValueError, match="no interference footprint"):
+        accl.certify_concurrent([pa, object()])
+
+
+# ---------------------------------------------------------------------------
+# dynamics: the two-thread fuzz against the serial-composition oracle
+# ---------------------------------------------------------------------------
+
+N_SEEDS = 30
+COUNT = 64
+
+
+def test_two_thread_fuzz_matches_serial_oracle_mesh(mesh8):
+    """30 seeds: a summary-certified-disjoint pair dispatched from two
+    threads agrees BITWISE with its serial composition, every seed —
+    the dynamic half of the non-interference proof."""
+    accl = ACCL(mesh8)
+    world = accl.world
+    a_in, a_out, b_in, b_out = (accl.create_buffer(COUNT, np.float32)
+                                for _ in range(4))
+    pa = _mk_steps(accl, COUNT, a_in, a_out)
+    pb = _mk_steps(accl, COUNT, b_in, b_out)
+    assert accl.certify_concurrent([pa, pb]) == []
+    assert accl._interference.escalations == 0
+
+    for seed in range(N_SEEDS):
+        rng = np.random.default_rng(seed)
+        xa = rng.standard_normal((world, COUNT)).astype(np.float32)
+        xb = rng.standard_normal((world, COUNT)).astype(np.float32)
+        # serial-composition oracle
+        a_in.write(xa.copy())
+        b_in.write(xb.copy())
+        pa.run()
+        pb.run()
+        oracle_a = np.array(a_out.host, copy=True)
+        oracle_b = np.array(b_out.host, copy=True)
+        # concurrent dispatch from two threads
+        a_in.write(xa.copy())
+        b_in.write(xb.copy())
+        a_out.write(np.zeros_like(oracle_a))
+        b_out.write(np.zeros_like(oracle_b))
+        errs = []
+
+        def drive(prog):
+            try:
+                prog.run()
+            except Exception as e:  # pragma: no cover - diagnostic aid
+                errs.append(e)
+
+        ts = [threading.Thread(target=drive, args=(p,))
+              for p in (pa, pb)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        np.testing.assert_array_equal(a_out.host, oracle_a)
+        np.testing.assert_array_equal(b_out.host, oracle_b)
+
+
+def test_seeded_601_mutation_provably_diverges(mesh8):
+    """The other direction: a pair the certifier REJECTS (ACCL601) is
+    genuinely order-dependent — its two serial compositions disagree
+    bitwise on the shared buffer for every fuzz seed, so no concurrent
+    interleaving can be equivalent to 'the' serial composition."""
+    accl = ACCL(mesh8)
+    world = accl.world
+    a_in, b_in, shared = (accl.create_buffer(COUNT, np.float32)
+                          for _ in range(3))
+    pa = _mk_steps(accl, COUNT, a_in, shared)
+    pb = _mk_steps(accl, COUNT, b_in, shared)
+    with pytest.raises(LintError) as ei:
+        accl.certify_concurrent([pa, pb])
+    assert {d.code for d in ei.value.diagnostics} == {"ACCL601"}
+
+    for seed in range(N_SEEDS):
+        rng = np.random.default_rng(1000 + seed)
+        xa = rng.standard_normal((world, COUNT)).astype(np.float32)
+        xb = rng.standard_normal((world, COUNT)).astype(np.float32)
+        a_in.write(xa.copy())
+        b_in.write(xb.copy())
+        pa.run()
+        pb.run()
+        ab = np.array(shared.host, copy=True)  # A;B -> sum(xb)
+        a_in.write(xa.copy())
+        b_in.write(xb.copy())
+        pb.run()
+        pa.run()
+        ba = np.array(shared.host, copy=True)  # B;A -> sum(xa)
+        assert not np.array_equal(ab, ba), \
+            f"seed {seed}: rejected pair is order-independent?"
+
+
+def test_two_thread_fuzz_matches_serial_oracle_local_world():
+    """The native-transport leg: two tag-disjoint ring exchanges per
+    rank, driven from two threads, agree bitwise with their serial
+    composition on the in-process POE — after the SAME footprints
+    certify clean statically (summaries alone)."""
+    from accl_tpu.device.emu_device import EmuWorld
+
+    n = 2
+    count = 64
+    fa = footprint_from_rank_programs(_ring(n, 3, count), n, label="A")
+    fb = footprint_from_rank_programs(_ring(n, 9, count), n, label="B")
+    c = InterferenceCertifier()
+    assert c.certify([fa, fb]) == []
+    assert c.escalations == 0
+
+    w = EmuWorld(n, transport="local")
+    try:
+        for seed in range(N_SEEDS):
+            rng = np.random.default_rng(seed)
+            xa = rng.standard_normal((n, count)).astype(np.float32)
+            xb = rng.standard_normal((n, count)).astype(np.float32)
+
+            def exchange(rank, i, x, tag):
+                out = np.zeros(count, np.float32)
+                rank.send(x[i].copy(), count, dst=(i + 1) % n, tag=tag)
+                rank.recv(out, count, src=(i - 1) % n, tag=tag)
+                return out
+
+            def serial(rank, i):
+                ra = exchange(rank, i, xa, 3)
+                rb = exchange(rank, i, xb, 9)
+                return ra, rb
+
+            def concurrent(rank, i):
+                res = [None, None]
+
+                def drive(slot, x, tag):
+                    res[slot] = exchange(rank, i, x, tag)
+
+                ts = [threading.Thread(target=drive, args=(0, xa, 3)),
+                      threading.Thread(target=drive, args=(1, xb, 9))]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return tuple(res)
+
+            oracle = w.run(serial)
+            got = w.run(concurrent)
+            for r in range(n):
+                np.testing.assert_array_equal(got[r][0], oracle[r][0])
+                np.testing.assert_array_equal(got[r][1], oracle[r][1])
+    finally:
+        w.close()

@@ -23,10 +23,19 @@ Three modes, combinable:
                    corpus replay enforces "expect_semantic" exactly
   --sample N       deterministically subsample the --schedules sweep
                    to ~N configs (the CI slice for the deep tier)
+  --interference   cross-program pair sweep (ACCL601-604): pairwise-
+                   certify concurrent footprints over every shipped
+                   schedule family (disjoint arenas must certify clean
+                   via summaries ALONE — zero escalations), adversarial
+                   overlap/slot/steal/unliftable rows must reject with
+                   their exact codes, and the recorded MoE / decode /
+                   train-step program pairs must certify clean or
+                   reject with a stable ACCL6xx (never ACCL604)
   FILE...          lint individual fixture files
 
 Exit status is 0 only when every expectation holds — the CI lint job
-runs `accl_lint.py --corpus --schedules` (default tier) and
+runs `accl_lint.py --corpus --schedules` (default tier),
+`accl_lint.py --interference --corpus`, and
 `accl_lint.py --deep --corpus --schedules --sample N` as gates.
 
 Fixture schema (JSON):
@@ -51,6 +60,19 @@ Fixture schema (JSON):
                          linter/model checker ALONE pass them) and the
                          semantic certifier checks the DAG against the
                          declared collective ("expect_semantic")
+  kind "concurrent":     "tenants": list of sub-fixtures (each of kind
+                         "sequence" or "rank_programs", same schema as
+                         above plus optional "title"/"world"/
+                         "use_pallas_ring"/"overlap"/"persistent");
+                         each tenant is lifted to its ProgramFootprint
+                         and the set is pairwise-certified
+                         (analysis/interference.py, ACCL601-604).
+                         "expect" is enforced EXACTLY (set equality —
+                         a cross-program fixture must reject with its
+                         precise codes, no more, no less); optional
+                         "expect_escalations" pins the product-
+                         modelcheck escalation count (0 proves the
+                         summary-only fast path)
   all kinds:             "expect": diagnostic codes that MUST surface
                          ([] = the batch must lint clean), "title";
                          "expect_semantic": ACCL5xx codes the semantic
@@ -65,8 +87,13 @@ import sys
 
 # the deep pass traces schedule bodies under jax's abstract evaluation;
 # keep that off any real accelerator (and quiet) regardless of where
-# the CLI runs — must happen before anything imports jax
+# the CLI runs — must happen before anything imports jax. The
+# --interference model-pair sweep records real programs over an 8-way
+# virtual mesh, so ask for the devices up front (a user-set XLA_FLAGS
+# wins; the sweep adapts to whatever device count materializes).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -159,6 +186,45 @@ def _fixture_budget(fx: dict) -> Budget:
     return Budget()
 
 
+def _programs_from_fixture(fx: dict) -> list:
+    def peer_of(e: dict) -> int:
+        p = e.get("peer", -1)
+        return ANY_SRC if p in ("any", "ANY") else int(p)
+
+    return [
+        [Event(e["kind"], peer_of(e),
+               int(e.get("tag", TAG_ANY)), int(e.get("count", 0)),
+               int(e.get("comm", 0)), e.get("op", ""))
+         for e in prog]
+        for prog in fx["programs"]
+    ]
+
+
+def _tenant_footprint(t: dict, i: int, default_world: int):
+    """Lift one "concurrent" sub-fixture to its ProgramFootprint —
+    through the SAME extractors the device attaches at compile time, so
+    the corpus replays exactly what certify_concurrent sees."""
+    from accl_tpu.analysis.interference import (
+        footprint_from_rank_programs, footprint_from_steps)
+
+    kind = t.get("kind", "sequence")
+    world = int(t.get("world", default_world))
+    label = t.get("title", f"tenant{i}")
+    if kind == "sequence":
+        steps = [_step_from_dict(d) for d in t["steps"]]
+        plans = tuple(_default_plan(o, world) for o in steps)
+        return footprint_from_steps(
+            steps, world,
+            persistent=frozenset(int(a) for a in t.get("persistent", ())),
+            use_pallas_ring=bool(t.get("use_pallas_ring", False)),
+            pallas_ring_overlap=bool(t.get("overlap", True)),
+            plans=plans, label=label)
+    if kind == "rank_programs":
+        return footprint_from_rank_programs(
+            _programs_from_fixture(t), world, label=label)
+    raise ValueError(f"unknown tenant kind {kind!r}")
+
+
 def lint_fixture(fx: dict, deep: bool = False) -> list:
     """Run one fixture through the analyzer; returns Diagnostics.
     `deep=True` (the CLI's --deep) forces the exhaustive-interleaving
@@ -185,17 +251,7 @@ def lint_fixture(fx: dict, deep: bool = False) -> list:
         plans = [_default_plan(o, world) for o in steps]
         return linter.lint(steps, plans, buffer_widths=widths)
     if kind == "rank_programs":
-        def peer_of(e: dict) -> int:
-            p = e.get("peer", -1)
-            return ANY_SRC if p in ("any", "ANY") else int(p)
-
-        programs = [
-            [Event(e["kind"], peer_of(e),
-                   int(e.get("tag", TAG_ANY)), int(e.get("count", 0)),
-                   int(e.get("comm", 0)), e.get("op", ""))
-             for e in prog]
-            for prog in fx["programs"]
-        ]
+        programs = _programs_from_fixture(fx)
         diags = simulate(programs,
                          blocking_sends=bool(fx.get("blocking_sends",
                                                     True)))
@@ -233,6 +289,30 @@ def lint_fixture(fx: dict, deep: bool = False) -> list:
             diags = list(diags) + semantics_mod.certify(
                 dag, spec, opts.scenario.name)
         return diags
+    if kind == "concurrent":
+        from accl_tpu.analysis.interference import InterferenceCertifier
+
+        # every tenant must certify ALONE first — cross-program
+        # fixtures demonstrate defects only the pairwise tier sees, so
+        # a tenant failing its own single-program passes is a broken
+        # fixture, not an interference finding
+        solo = []
+        for i, t in enumerate(fx["tenants"]):
+            solo += lint_fixture({"world": fx.get("world", 4), **t},
+                                 deep=deep)
+        if solo:
+            return solo
+        certifier = InterferenceCertifier(budget=_fixture_budget(fx))
+        fps = [_tenant_footprint(t, i, int(fx.get("world", 4)))
+               for i, t in enumerate(fx["tenants"])]
+        diags = certifier.certify(fps)
+        want_esc = fx.get("expect_escalations")
+        if want_esc is not None and certifier.escalations != int(want_esc):
+            raise AssertionError(
+                f"expected {want_esc} product-modelcheck escalations, "
+                f"certifier took {certifier.escalations} (the summary-"
+                "only fast path is part of this fixture's contract)")
+        return diags
     raise ValueError(f"unknown fixture kind {kind!r}")
 
 
@@ -261,6 +341,16 @@ def run_fixture_file(path: pathlib.Path,
                    if ok else
                    f"EXPECTED semantic {sorted(set(expect_sem))} got "
                    f"{got5} (other codes: {sorted(set(rest))})")
+    elif fx.get("kind") == "concurrent":
+        # cross-program fixtures are EXACT: the pairwise certifier must
+        # emit precisely the expected code set — a fixture built to
+        # reject ACCL602 surfacing a stray ACCL601 means the footprint
+        # regions drifted, and that must fail the replay
+        ok = sorted({c for c in got}) == sorted(set(expect))
+        verdict = ((f"rejected with exactly {sorted(set(got))}"
+                    if expect else "clean") if ok else
+                   f"EXPECTED exactly {sorted(set(expect))} got "
+                   f"{sorted(set(got))}")
     elif expect:
         missing = [c for c in expect if c not in got]
         ok = not missing
@@ -589,6 +679,193 @@ def run_schedules(deep: bool = False, sample: int = 0,
     return ok
 
 
+def run_interference() -> bool:
+    """The cross-program pair sweep (the --interference gate):
+
+    1. footprints over every shipped schedule family with DISJOINT
+       buffer arenas pairwise-certify clean via summaries alone — the
+       escalation counter must stay 0 (the O(N^2)-cheap fast path the
+       multi-tenant admission control relies on);
+    2. adversarial rows reject with their EXACT codes: a shared-region
+       pair ACCL601, a pallas-ring slot pair ACCL603, a wildcard-steal
+       rank-program pair ACCL602, an unliftable footprint ACCL604;
+    3. the recorded MoE / decode / train-step programs (REAL recorders
+       over a virtual mesh, no XLA compile) pairwise-certify clean or
+       reject with a stable ACCL6xx — never ACCL604: every shipped
+       program family must be liftable."""
+    import time as _time
+
+    from accl_tpu.analysis.interference import (
+        InterferenceCertifier, footprint_from_rank_programs,
+        footprint_from_steps)
+    from accl_tpu.analysis.protocol import recv, send
+
+    t0 = _time.monotonic()
+    ok = True
+
+    # -- 1. disjoint-arena family sweep: summaries alone, zero
+    #       escalations ------------------------------------------------
+    families = [
+        ("allreduce", [dict(op="allreduce", count=4096)]),
+        ("quantized", [dict(op="allreduce", count=8192,
+                            compress="int8")]),
+        ("rs_ag", [dict(op="reduce_scatter", count=1024),
+                   dict(op="allgather", count=1024)]),
+        ("alltoall", [dict(op="alltoall", count=512)]),
+        ("alltoallv", [dict(op="alltoall", count=300)]),
+        ("bcast_gather", [dict(op="bcast", count=256),
+                          dict(op="gather", count=256)]),
+        ("hier", [dict(op="allreduce", count=8192)]),
+        ("decode_like", [dict(op="copy", count=64),
+                         dict(op="allreduce", count=64),
+                         dict(op="combine", count=64)]),
+        ("train_like", [dict(op="copy", count=2048),
+                        dict(op="allreduce", count=2048),
+                        dict(op="combine", count=2048)]),
+    ]
+
+    def arena_steps(rows: list, base: int, world: int):
+        steps = []
+        nxt = [base]
+
+        def alloc() -> int:
+            nxt[0] += 0x100000
+            return nxt[0]
+
+        for row in rows:
+            d = dict(row)
+            d["addr_0"] = alloc()
+            if d["op"] == "combine":
+                d["addr_1"] = alloc()
+            d["addr_2"] = alloc()
+            if d["op"] == "alltoall" and d["count"] == 300:
+                # alltoallv footprint rides the same descriptor shape;
+                # peer_counts don't change the prefix access model
+                pass
+            steps.append(_step_from_dict(d))
+        return steps
+
+    n_pairs = 0
+    for world in (2, 4, 8):
+        certifier = InterferenceCertifier()
+        fps = []
+        for i, (name, rows) in enumerate(families):
+            steps = arena_steps(rows, 0x10000000 * (i + 1), world)
+            plans = tuple(_default_plan(o, world) for o in steps)
+            fps.append(footprint_from_steps(
+                steps, world, plans=plans, label=f"{name}@{world}"))
+        bad = [fp for fp in fps if fp.unliftable is not None]
+        if bad:
+            ok = False
+            for fp in bad:
+                print(f" FAIL {fp.label}: unliftable footprint "
+                      f"({fp.unliftable})")
+        diags = certifier.certify(fps)
+        n_pairs += certifier.pairs_checked
+        if diags:
+            ok = False
+            for d in diags:
+                print(f" FAIL disjoint sweep world={world}: {d}")
+        if certifier.escalations:
+            ok = False
+            print(f" FAIL disjoint sweep world={world}: "
+                  f"{certifier.escalations} escalations (summary-only "
+                  "fast path violated)")
+
+    # -- 2. adversarial rows: exact codes ------------------------------
+    def expect_exact(title: str, fps, codes: set) -> None:
+        nonlocal ok
+        got = {d.code for d in InterferenceCertifier().certify(fps)}
+        if got != codes:
+            ok = False
+            print(f" FAIL {title}: expected exactly {sorted(codes)}, "
+                  f"got {sorted(got)}")
+
+    world = 4
+    a = arena_steps([dict(op="allreduce", count=256)], 0x10000000, world)
+    b = arena_steps([dict(op="allreduce", count=256)], 0x20000000, world)
+    shared = arena_steps([dict(op="allreduce", count=256)], 0x10000000,
+                         world)
+    mk = lambda s, label, **kw: footprint_from_steps(  # noqa: E731
+        s, world, plans=tuple(_default_plan(o, world) for o in s),
+        label=label, **kw)
+    expect_exact("overlap pair", [mk(a, "A"), mk(shared, "B")],
+                 {"ACCL601"})
+    expect_exact("slot pair",
+                 [mk(a, "A", use_pallas_ring=True),
+                  mk(b, "B", use_pallas_ring=True)], {"ACCL603"})
+    steal_a = footprint_from_rank_programs(
+        [[recv(1, TAG_ANY, 4)], [send(0, 3, 4)]], 2, label="A")
+    steal_b = footprint_from_rank_programs(
+        [[recv(1, 9, 4)], [send(0, 9, 4)]], 2, label="B")
+    expect_exact("steal pair", [steal_a, steal_b], {"ACCL602"})
+    broken = footprint_from_steps([object()], world, label="broken")
+    expect_exact("unliftable pair", [mk(a, "A"), broken], {"ACCL604"})
+
+    # -- 3. recorded model-program pairs (real recorders, no compile) --
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from accl_tpu import ACCL
+    from accl_tpu.models import moe as moe_mod
+    from accl_tpu.models import transformer as trf
+
+    # world 4: the tp decode step needs world | n_heads, and 4 is the
+    # widest the tiny sweep config supports (the footprint layer itself
+    # is world-agnostic — worlds 2-8 are covered by the sweep above)
+    n_dev = min(4, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("ccl",))
+    accl = ACCL(mesh)
+
+    def rec_footprint(rec, label: str):
+        return footprint_from_steps(
+            rec.calls, accl.world, persistent=rec._persistent,
+            label=label)
+
+    model_fps = []
+    for tag in ("moe", "moe2"):
+        disp, mid, out = (accl.create_buffer(1024, np.float32)
+                          for _ in range(3))
+        seq = accl.sequence()
+        seq.alltoall(disp, mid, 128,
+                     res_stream=moe_mod.MOE_EXPERT_STREAM)
+        seq.alltoall(mid, out, 128)
+        model_fps.append(rec_footprint(seq, tag))
+    cfg = trf.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64)
+    params = trf.init_params(cfg, jax.random.key(0))
+    rec, _ = trf.record_decode_step(accl, cfg, params, batch=2,
+                                    max_len=8)
+    model_fps.append(rec_footprint(rec, "decode"))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab,
+                          (accl.world, 1, 8)).astype(np.int32)
+    rec, _ = trf.record_train_step(accl, cfg, tokens,
+                                   np.roll(tokens, -1, axis=2))
+    model_fps.append(rec_footprint(rec, "train"))
+
+    certifier = InterferenceCertifier()
+    for i in range(len(model_fps)):
+        for j in range(i + 1, len(model_fps)):
+            fa, fb = model_fps[i], model_fps[j]
+            diags = certifier.check_pair(fa, fb)
+            n_pairs += 1
+            codes = sorted({d.code for d in diags})
+            if "ACCL604" in codes:
+                ok = False
+                print(f" FAIL {fa.label} x {fb.label}: ACCL604 — a "
+                      "shipped program family must be liftable")
+            print(f"  {fa.label:8s} x {fb.label:8s} "
+                  + ("clean" if not codes else str(codes)))
+
+    dt = _time.monotonic() - t0
+    print(f"interference: {n_pairs} pairs certified across the family "
+          f"sweep, adversarial rows and recorded model programs "
+          + ("clean" if ok else "WITH DEFECTS") + f" in {dt:.1f}s")
+    return ok
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--corpus", nargs="?", const=str(DEFAULT_CORPUS),
@@ -608,16 +885,25 @@ def main(argv=None) -> int:
     ap.add_argument("--sample", type=int, default=0, metavar="N",
                     help="deterministically subsample --schedules to "
                          "~N configurations")
+    ap.add_argument("--interference", action="store_true",
+                    help="cross-program pair sweep: pairwise-certify "
+                         "concurrent footprints over the shipped "
+                         "schedule families, adversarial rows and the "
+                         "recorded model programs (ACCL601-604)")
     ap.add_argument("files", nargs="*", help="individual fixture files")
     args = ap.parse_args(argv)
-    if not (args.corpus or args.schedules or args.files):
-        ap.error("nothing to do: pass --corpus, --schedules, or files")
+    if not (args.corpus or args.schedules or args.interference
+            or args.files):
+        ap.error("nothing to do: pass --corpus, --schedules, "
+                 "--interference, or files")
     ok = True
     if args.corpus:
         ok &= run_corpus(pathlib.Path(args.corpus), deep=args.deep)
     if args.schedules:
         ok &= run_schedules(deep=args.deep, sample=args.sample,
                             semantic=args.semantic)
+    if args.interference:
+        ok &= run_interference()
     for f in args.files:
         fok, line = run_fixture_file(pathlib.Path(f), deep=args.deep)
         ok &= fok
