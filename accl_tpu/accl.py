@@ -824,6 +824,20 @@ class ACCL:
         enforce(diags, mode)
         return diags
 
+    def scheduler(self, **kwargs) -> "MultiTenantScheduler":
+        """Build a multi-tenant scheduler over this facade
+        (scheduler/MultiTenantScheduler, docs/scheduler.md): admission
+        control with live interference certificates (the scheduler
+        shares THIS facade's long-lived certifier, so verdicts cached
+        by certify_concurrent serve admission and vice versa), strict
+        priority classes with weighted fair queueing over predicted
+        cost, typed backpressure, and per-tenant accountability
+        through the metrics registry. Kwargs forward to the
+        MultiTenantScheduler constructor (capacity_s, registry, ...)."""
+        from .scheduler import MultiTenantScheduler
+
+        return MultiTenantScheduler(self, **kwargs)
+
     def split(self, rank_indices: list[int]) -> Communicator:
         """Create a sub-communicator over a subset of ranks (reference
         multi-communicator support: the firmware caches the addressed

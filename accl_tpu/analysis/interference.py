@@ -58,15 +58,24 @@ composed product any synthetic tags are namespaced per program
 
 Verdicts are cached per pair, keyed by the two composite signatures
 (order-normalized), so an admission-control loop re-checking a stable
-tenant set pays dict lookups. `InterferenceCertifier.escalations`
-counts pairs that needed the product model check — the summary-only
-fast path is provable by asserting it stayed at zero.
+tenant set pays dict lookups. The cache is LRU-BOUNDED
+(``ACCL_INTERFERENCE_CACHE_CAP``, default 4096 pairs): under tenant
+churn the signature universe is open-ended, and an admission-control
+certifier lives as long as the scheduler does — an unbounded verdict
+dict would be a slow leak. Evicting a verdict is always safe (the
+next check_pair on that pair recomputes it identically; verdicts are
+pure functions of the two footprints), it just costs the recheck.
+`InterferenceCertifier.escalations` counts pairs that needed the
+product model check — the summary-only fast path is provable by
+asserting it stayed at zero.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
+from collections import OrderedDict
 from typing import Callable, Iterable, Sequence
 
 from ..constants import TAG_ANY
@@ -89,6 +98,22 @@ __all__ = [
 # in a composed product: hop tags are step * _STEP_TAG_STRIDE + hop
 # (protocol.py), far below this, and real tags never get offset.
 _PROGRAM_TAG_STRIDE = 1 << 24
+
+# Default bound on the per-pair verdict cache: 4096 pairs covers a
+# ~90-program stable working set (N*(N-1)/2) while keeping a churning
+# multi-tenant admission loop O(1) in memory.
+DEFAULT_VERDICT_CACHE_CAP = 4096
+
+
+def _verdict_cache_cap() -> int:
+    """The env-tunable cache bound (ACCL_INTERFERENCE_CACHE_CAP);
+    clamped to >= 1 so the live pair can always be cached."""
+    raw = os.environ.get("ACCL_INTERFERENCE_CACHE_CAP", "")
+    try:
+        cap = int(raw) if raw else DEFAULT_VERDICT_CACHE_CAP
+    except ValueError:
+        cap = DEFAULT_VERDICT_CACHE_CAP
+    return max(cap, 1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -435,13 +460,28 @@ class InterferenceCertifier:
     (order-normalized: check(A, B) and check(B, A) share one entry).
     `escalations` counts cache-miss pairs that needed the product model
     check; `pairs_checked` counts cache misses total — a summary-only
-    run is `escalations == 0`."""
+    run is `escalations == 0`.
 
-    def __init__(self, budget: Budget | None = None):
+    The cache is LRU-bounded at `cache_cap` pairs (default from
+    ``ACCL_INTERFERENCE_CACHE_CAP``, else 4096): an admission-control
+    certifier outlives any one tenant set, and under churn the pair
+    universe grows without limit. A hit refreshes the entry's recency;
+    storing past the cap evicts the least-recently-used verdict
+    (`cache_evictions` counts them). Eviction only ever costs a
+    recompute — verdicts are pure functions of the two footprints, so
+    a re-checked evicted pair gets the identical verdict back."""
+
+    def __init__(self, budget: Budget | None = None,
+                 cache_cap: int | None = None):
         self.budget = budget or Budget()
-        self._cache: dict[tuple[str, str], tuple[Diagnostic, ...]] = {}
+        self.cache_cap = (max(int(cache_cap), 1)
+                          if cache_cap is not None
+                          else _verdict_cache_cap())
+        self._cache: OrderedDict[tuple[str, str],
+                                 tuple[Diagnostic, ...]] = OrderedDict()
         self.escalations = 0
         self.pairs_checked = 0
+        self.cache_evictions = 0
 
     # -- summary tier -------------------------------------------------
 
@@ -582,6 +622,7 @@ class InterferenceCertifier:
         key = (lo, hi)
         hit = self._cache.get(key)
         if hit is not None:
+            self._cache.move_to_end(key)  # LRU refresh
             return hit
         self.pairs_checked += 1
         diags: list[Diagnostic]
@@ -600,6 +641,9 @@ class InterferenceCertifier:
                 diags += self._escalate(a, b)
         verdict = tuple(diags)
         self._cache[key] = verdict
+        while len(self._cache) > self.cache_cap:
+            self._cache.popitem(last=False)
+            self.cache_evictions += 1
         return verdict
 
     def certify(self, footprints: Sequence[ProgramFootprint]

@@ -492,6 +492,29 @@ class TPUDevice(CCLODevice):
         except (ValueError, KeyError, ZeroDivisionError):
             return None
 
+    def predict_sequence_cost(self, prepared) -> float | None:
+        """Predicted steady-state seconds for ONE dispatch of a
+        prepared batch under the shipped default link — the admission
+        price the multi-tenant scheduler budgets a tenant's program at
+        BEFORE dispatching it (timing.predict_prepared over the frozen
+        steps + plans, aggregate cost shape). None when no calibration
+        is committed or the batch has no priceable step (the scheduler
+        then falls back to its bytes proxy rather than admitting for
+        free)."""
+        from ..sequencer.timing import predict_prepared
+        from ..telemetry.feedback import default_link
+
+        link = default_link()
+        if link is None:
+            return None
+        try:
+            return predict_prepared(
+                link, prepared.desc.steps, prepared.plans,
+                prepared.ctx.world,
+                rx_buf_bytes=self.eager_rx_buf_size, aggregate=True)
+        except (ValueError, KeyError, ZeroDivisionError):
+            return None
+
     # -- call sequences (device-resident descriptor batches) ---------------
 
     def start_sequence(self, options_list, lint: str = "error",

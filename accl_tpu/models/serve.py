@@ -75,7 +75,8 @@ class DecodeServer:
 
     def __init__(self, accl, cfg, params, *, batch: int, max_len: int,
                  mode: str = "fused", lint: str = "error",
-                 registry=None, time_fn=time.perf_counter):
+                 registry=None, time_fn=time.perf_counter,
+                 scheduler=None, tenant: str = "serve"):
         if mode not in ("fused", "eager"):
             raise ValueError(f"mode must be 'fused'|'eager', got {mode!r}")
         self.cfg = cfg
@@ -99,6 +100,24 @@ class DecodeServer:
             self._program = None
             trf.register_decode_consumers(accl, cfg, self._params,
                                           self._buffers.dims)
+        # the multi-tenant seam (ROADMAP item 4's deferred "admission
+        # = item 1"): with a scheduler attached, request admission
+        # consults its backpressure (typed SchedulerSaturatedError
+        # when the ring is saturated) and every fused step dispatches
+        # through scheduler.dispatch_now — the same program, the same
+        # run(to_device=True), so batched==sequential bitwise parity
+        # is untouched; what the scheduler adds is tenant metering,
+        # SLO residuals and the concurrency/certificate discipline
+        # next to any co-running tenants.
+        self._scheduler = scheduler
+        self._tenant = tenant
+        self._step_cost_s: float | None = None
+        if scheduler is not None:
+            if tenant not in scheduler.tenants:
+                scheduler.register_tenant(tenant, priority=0)
+            if self._program is not None:
+                self._step_cost_s = scheduler.predict_cost_s(
+                    self._program)
         self._slots: list[_Slot | None] = [None] * batch
         self._queue: deque[DecodeRequest] = deque()
         self._next_rid = 0
@@ -124,6 +143,16 @@ class DecodeServer:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        if self._scheduler is not None:
+            # admission through the scheduler seam: the request's
+            # predicted cost is (steps it will occupy) x (one fused
+            # step's price); a saturated scheduler rejects HERE with
+            # the typed error, before the request ever holds a slot
+            step_cost = (self._step_cost_s
+                         if self._step_cost_s is not None else 1e-5)
+            n_steps = len(prompt) + int(max_new_tokens)
+            self._scheduler.admit_request(self._tenant,
+                                          cost_s=step_cost * n_steps)
         req = DecodeRequest(rid=self._next_rid, prompt=prompt,
                             max_new_tokens=int(max_new_tokens))
         self._next_rid += 1
@@ -170,7 +199,12 @@ class DecodeServer:
         t0 = self._time()
         if self._program is not None:
             # steady state: one dispatch; kv caches stay device-resident
-            self._program.run(to_device=True)
+            if self._scheduler is not None:
+                self._scheduler.dispatch_now(self._tenant,
+                                             self._program,
+                                             to_device=True)
+            else:
+                self._program.run(to_device=True)
             logits = trf.read_decode_logits(self._buffers, sync=True)
         else:
             trf.run_decode_step_eager(self._accl, self.cfg, self._buffers)

@@ -1,0 +1,45 @@
+"""Multi-tenant scheduler: certified concurrent streams, QoS, and
+admission control over SequenceProgram dispatches.
+
+The subsystem that turns the interference certifier's pairwise proofs
+(analysis/interference.py, ACCL601-604) and the calibrated timing
+model (sequencer/timing.py) into an actual scheduler: tenants register
+with a priority class and a fair-queue weight, programs are priced and
+certified at admission, uncertifiable pairs serialize instead of
+silently failing, every dispatch carries the certificate id of the set
+it overlapped with, and per-tenant p99s / SLO residuals /
+noisy-neighbor attribution ride the always-on metrics registry.
+
+    sched = accl.scheduler(capacity_s=10.0)
+    sched.register_tenant("interactive", priority=0, weight=4.0)
+    sched.register_tenant("bulk", priority=1, weight=1.0)
+    sched.submit("interactive", small_program, repeats=100)
+    sched.submit("bulk", big_program, repeats=8)
+    sched.drain(workers=2)
+    sched.report()  # fairness, certificates, SLO residuals, neighbors
+
+docs/scheduler.md has the admission/QoS/backpressure semantics, the
+certificate lifecycle and the fairness math.
+"""
+
+from .errors import (
+    DuplicateTenantError,
+    SchedulerError,
+    SchedulerSaturatedError,
+    UnknownTenantError,
+)
+from .qos import FairQueue, QueueEntry
+from .scheduler import MultiTenantScheduler
+from .tenant import Tenant, TenantRegistry
+
+__all__ = [
+    "MultiTenantScheduler",
+    "Tenant",
+    "TenantRegistry",
+    "FairQueue",
+    "QueueEntry",
+    "SchedulerError",
+    "SchedulerSaturatedError",
+    "UnknownTenantError",
+    "DuplicateTenantError",
+]

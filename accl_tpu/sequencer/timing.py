@@ -778,6 +778,40 @@ def predict_sequence(
     return t
 
 
+def predict_prepared(
+    params: LinkParams,
+    steps,
+    plans,
+    world: int,
+    *,
+    rx_buf_bytes: int,
+    aggregate: bool = True,
+    dispatch_alpha: float = 0.0,
+) -> float:
+    """Expected seconds for ONE dispatch of a prepared descriptor batch
+    — the admission-control price of a tenant's steady-state step.
+
+    `steps` are the batch's resolved CallOptions and `plans` the Plans
+    they froze to (a _PreparedSequence's `desc.steps` / `plans`); steps
+    whose plan never resolved (stream endpoints spliced at the seams)
+    carry no wire cost and are skipped. Aggregate cost shape by default
+    — the regime the shipped emulator fit calibrates, and the shape the
+    per-step dispatch telemetry already predicts with."""
+    calls = []
+    for opts, plan in zip(steps, plans):
+        if plan is None:
+            continue
+        calls.append((opts.scenario, plan, int(opts.count),
+                      dtype_nbytes(opts.data_type)))
+    if not calls:
+        raise ValueError("prepared batch has no priceable steps "
+                         "(every plan is None)")
+    return predict_sequence(params, calls, world,
+                            rx_buf_bytes=rx_buf_bytes,
+                            aggregate=aggregate,
+                            dispatch_alpha=dispatch_alpha, fused=True)
+
+
 def calibrate(samples: list[tuple[float, float, float]]) -> LinkParams:
     """Least-squares fit of (alpha, 1/beta) from samples of
     (messages, bytes, measured_seconds): t ~= alpha*m + bytes*inv_beta.

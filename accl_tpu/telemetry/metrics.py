@@ -38,6 +38,7 @@ WAN-shaper regime change while staying quiet on a stable control run.
 from __future__ import annotations
 
 import math
+import os
 import threading
 from collections import deque
 from typing import Any, Callable, Iterable
@@ -47,6 +48,29 @@ from .export import measured_seconds, median as _median
 # label key order is FIXED: the registry keys series by this tuple so
 # exposition and snapshots are deterministic across runs
 LABEL_KEYS = ("op", "algorithm", "protocol", "world")
+
+# Cardinality-guarded label keys: every other label in this module draws
+# from a closed set (collectives x algorithms x protocols x worlds), but
+# a TENANT id is caller-supplied — an abusive or buggy tenant-id stream
+# must not be able to mint unbounded series in an always-on registry or
+# blow up the Prometheus exposition. Values past the cap collapse into
+# the `other` overflow bucket (their observations still count — only
+# the per-value attribution is lost) and the overflow is itself counted
+# (accl_label_overflow_total), so saturation is visible, never silent.
+GUARDED_LABEL_KEYS = ("tenant",)
+LABEL_OVERFLOW_BUCKET = "other"
+DEFAULT_LABEL_VALUE_CAP = 64
+
+
+def _label_value_cap() -> int:
+    """Env-tunable per-key cardinality cap (ACCL_METRICS_LABEL_CAP);
+    clamped to >= 1 so at least one real value is always attributable."""
+    raw = os.environ.get("ACCL_METRICS_LABEL_CAP", "")
+    try:
+        cap = int(raw) if raw else DEFAULT_LABEL_VALUE_CAP
+    except ValueError:
+        cap = DEFAULT_LABEL_VALUE_CAP
+    return max(cap, 1)
 
 DEFAULT_HISTOGRAM_WINDOW = 512
 # p99.9 rides the same 512-sample window as the rest: nearest-rank over
@@ -167,18 +191,69 @@ def _fmt_labels(key: LabelsKey, extra: tuple[tuple[str, str], ...] = ()) -> str:
 
 class MetricsRegistry:
     """Thread-safe named-series registry. Series are created lazily on
-    first touch and keyed by (metric name, sorted label tuple)."""
+    first touch and keyed by (metric name, sorted label tuple).
 
-    def __init__(self, histogram_window: int = DEFAULT_HISTOGRAM_WINDOW):
+    Caller-supplied label keys (``GUARDED_LABEL_KEYS``, i.e. `tenant`)
+    are cardinality-guarded: the first `label_value_cap` distinct
+    values get their own series, every later value lands in the
+    ``other`` overflow bucket and bumps ``accl_label_overflow_total``
+    — so a hostile tenant-id stream bounds the registry instead of
+    growing it."""
+
+    def __init__(self, histogram_window: int = DEFAULT_HISTOGRAM_WINDOW,
+                 label_value_cap: int | None = None):
         self._mu = threading.Lock()
         self._histogram_window = histogram_window
+        self._label_value_cap = (max(int(label_value_cap), 1)
+                                 if label_value_cap is not None
+                                 else _label_value_cap())
+        self._guarded_values: dict[str, set[str]] = {}
         self._counters: dict[tuple[str, LabelsKey], Counter] = {}
         self._gauges: dict[tuple[str, LabelsKey], Gauge] = {}
         self._histograms: dict[tuple[str, LabelsKey], Histogram] = {}
 
+    # -- label cardinality guard -------------------------------------------
+
+    def _guard_labels(self, labels: dict[str, Any]) -> dict[str, Any]:
+        """Map guarded label values past the cap onto the overflow
+        bucket. Admission is first-come: the set of attributed values
+        freezes once full, so the series space is bounded for the
+        process lifetime no matter what ids arrive later."""
+        overflowed: list[str] = []
+        for k in GUARDED_LABEL_KEYS:
+            if k not in labels:
+                continue
+            v = str(labels[k])
+            if v == LABEL_OVERFLOW_BUCKET:
+                continue
+            seen = self._guarded_values.get(k)
+            if seen is not None and v in seen:
+                continue
+            with self._mu:
+                seen = self._guarded_values.setdefault(k, set())
+                if v in seen:
+                    continue
+                if len(seen) < self._label_value_cap:
+                    seen.add(v)
+                    continue
+            labels = {**labels, k: LABEL_OVERFLOW_BUCKET}
+            overflowed.append(k)
+        # outside _mu: counter() re-acquires the registry lock on a
+        # first-touch miss
+        for k in overflowed:
+            self.counter("accl_label_overflow_total", label=k).inc()
+        return labels
+
+    def guarded_values(self, key: str) -> frozenset[str]:
+        """The attributed value set for a guarded label key (what got a
+        series of its own before the cap)."""
+        with self._mu:
+            return frozenset(self._guarded_values.get(key, ()))
+
     # -- series access -----------------------------------------------------
 
     def counter(self, name: str, **labels: Any) -> Counter:
+        labels = self._guard_labels(labels)
         key = (name, _labels_key(labels))
         c = self._counters.get(key)
         if c is None:
@@ -187,6 +262,7 @@ class MetricsRegistry:
         return c
 
     def gauge(self, name: str, **labels: Any) -> Gauge:
+        labels = self._guard_labels(labels)
         key = (name, _labels_key(labels))
         g = self._gauges.get(key)
         if g is None:
@@ -195,6 +271,7 @@ class MetricsRegistry:
         return g
 
     def histogram(self, name: str, **labels: Any) -> Histogram:
+        labels = self._guard_labels(labels)
         key = (name, _labels_key(labels))
         h = self._histograms.get(key)
         if h is None:
@@ -208,6 +285,7 @@ class MetricsRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()
+            self._guarded_values.clear()
 
     # -- readout -----------------------------------------------------------
 

@@ -223,6 +223,44 @@ def test_verdict_cache_hits_by_signature_pair():
     assert c.pairs_checked == 1
 
 
+def test_verdict_cache_lru_evicts_and_reverdicts():
+    """The LRU bound (satellite: admission-control certifiers outlive
+    any tenant set): a hit refreshes recency, storing past the cap
+    evicts the LRU pair, and a re-checked evicted pair recomputes to
+    the IDENTICAL verdict (verdicts are pure in the footprints)."""
+    fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")
+    fc = footprint_from_rank_programs(_ring(4, 17), 4, label="C")
+    c = InterferenceCertifier(cache_cap=2)
+    vab = c.check_pair(fa, fb)
+    c.check_pair(fa, fc)
+    assert c.pairs_checked == 2 and c.cache_evictions == 0
+    # refresh (A,B) -> (A,C) is now the LRU entry
+    assert c.check_pair(fb, fa) is vab  # hit, either order
+    assert c.pairs_checked == 2
+    c.check_pair(fb, fc)  # third pair: evicts (A,C), not (A,B)
+    assert c.cache_evictions == 1
+    assert c.check_pair(fa, fb) is vab  # survived (recency)
+    assert c.pairs_checked == 3
+    c.check_pair(fa, fc)  # evicted: recomputed...
+    assert c.pairs_checked == 4 and c.cache_evictions == 2
+    assert c.check_pair(fc, fa) == ()  # ...to the identical verdict
+    assert len(c._cache) <= 2  # bounded throughout
+
+
+def test_verdict_cache_cap_env_tunable(monkeypatch):
+    from accl_tpu.analysis.interference import DEFAULT_VERDICT_CACHE_CAP
+
+    assert InterferenceCertifier().cache_cap == DEFAULT_VERDICT_CACHE_CAP
+    monkeypatch.setenv("ACCL_INTERFERENCE_CACHE_CAP", "7")
+    assert InterferenceCertifier().cache_cap == 7
+    monkeypatch.setenv("ACCL_INTERFERENCE_CACHE_CAP", "0")
+    assert InterferenceCertifier().cache_cap == 1  # clamped: live pair
+    monkeypatch.setenv("ACCL_INTERFERENCE_CACHE_CAP", "bogus")
+    assert InterferenceCertifier().cache_cap == DEFAULT_VERDICT_CACHE_CAP
+    assert InterferenceCertifier(cache_cap=3).cache_cap == 3
+
+
 def test_certificate_id_is_order_independent():
     fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
     fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")

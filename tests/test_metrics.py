@@ -170,6 +170,94 @@ def test_registry_thread_safety_smoke():
 
 
 # ---------------------------------------------------------------------------
+# label-cardinality guard (the tenant-label satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_label_overflows_into_other_bucket():
+    """First-come admission up to the cap; later tenant ids collapse
+    into `other` (observations still counted — attribution is what
+    saturates), and the overflow is itself a visible series."""
+    reg = MetricsRegistry(label_value_cap=2)
+    reg.counter("accl_tenant_dispatches_total", tenant="a").inc()
+    reg.counter("accl_tenant_dispatches_total", tenant="b").inc()
+    reg.counter("accl_tenant_dispatches_total", tenant="c").inc()
+    reg.counter("accl_tenant_dispatches_total", tenant="d").inc(2)
+    assert reg.guarded_values("tenant") == {"a", "b"}
+    snap = reg.snapshot()
+    by_tenant = {r["labels"]["tenant"]: r["value"]
+                 for r in snap["counters"]["accl_tenant_dispatches_total"]}
+    assert by_tenant == {"a": 1.0, "b": 1.0, "other": 3.0}
+    (ovf,) = snap["counters"]["accl_label_overflow_total"]
+    assert ovf["labels"] == {"label": "tenant"} and ovf["value"] == 2.0
+    # histograms and gauges ride the same guard
+    reg.histogram("accl_tenant_dispatch_seconds", tenant="zzz") \
+        .observe(1.0)
+    reg.gauge("accl_tenant_depth", tenant="zzz").set(1)
+    snap = reg.snapshot()
+    (h,) = snap["histograms"]["accl_tenant_dispatch_seconds"]
+    assert h["labels"]["tenant"] == "other"
+    (g,) = snap["gauges"]["accl_tenant_depth"]
+    assert g["labels"]["tenant"] == "other"
+
+
+def test_guard_bounds_hostile_id_stream():
+    """10x the cap in distinct ids mints exactly cap+1 series."""
+    reg = MetricsRegistry(label_value_cap=8)
+    for i in range(80):
+        reg.counter("accl_tenant_dispatches_total",
+                    tenant=f"t{i:03d}").inc()
+    rows = reg.snapshot()["counters"]["accl_tenant_dispatches_total"]
+    assert len(rows) == 9  # 8 attributed + `other`
+    (other,) = [r for r in rows if r["labels"]["tenant"] == "other"]
+    assert other["value"] == 72.0
+    # an attributed value keeps its own series afterwards
+    reg.counter("accl_tenant_dispatches_total", tenant="t000").inc()
+    rows = reg.snapshot()["counters"]["accl_tenant_dispatches_total"]
+    (t0,) = [r for r in rows if r["labels"]["tenant"] == "t000"]
+    assert t0["value"] == 2.0
+
+
+def test_guard_leaves_closed_label_sets_alone():
+    """Only GUARDED_LABEL_KEYS are capped: op/world/… draw from closed
+    sets and keep full attribution past any cap."""
+    reg = MetricsRegistry(label_value_cap=1)
+    for i in range(5):
+        reg.counter("accl_calls_total", op=f"op{i}").inc()
+    rows = reg.snapshot()["counters"]["accl_calls_total"]
+    assert {r["labels"]["op"] for r in rows} == \
+        {f"op{i}" for i in range(5)}
+
+
+def test_guard_explicit_other_and_env_cap(monkeypatch):
+    from accl_tpu.telemetry.metrics import (
+        DEFAULT_LABEL_VALUE_CAP,
+        _label_value_cap,
+    )
+
+    reg = MetricsRegistry(label_value_cap=1)
+    # writing to the bucket directly is not an overflow event
+    reg.counter("accl_tenant_dispatches_total", tenant="other").inc()
+    assert reg.guarded_values("tenant") == set()
+    assert "accl_label_overflow_total" not in \
+        reg.snapshot()["counters"]
+    assert _label_value_cap() == DEFAULT_LABEL_VALUE_CAP
+    monkeypatch.setenv("ACCL_METRICS_LABEL_CAP", "3")
+    assert _label_value_cap() == 3
+    assert MetricsRegistry()._label_value_cap == 3
+    monkeypatch.setenv("ACCL_METRICS_LABEL_CAP", "0")
+    assert _label_value_cap() == 1  # clamped
+    monkeypatch.setenv("ACCL_METRICS_LABEL_CAP", "junk")
+    assert _label_value_cap() == DEFAULT_LABEL_VALUE_CAP
+    # clear() resets the admitted set with the series
+    reg2 = MetricsRegistry(label_value_cap=1)
+    reg2.counter("n", tenant="a").inc()
+    assert reg2.guarded_values("tenant") == {"a"}
+    reg2.clear()
+    assert reg2.guarded_values("tenant") == set()
+
+
+# ---------------------------------------------------------------------------
 # the span -> metrics observer rule
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,529 @@
+"""Multi-tenant scheduler (accl_tpu/scheduler/): certified concurrent
+streams, QoS, and admission control over SequenceProgram dispatches.
+
+The contract under test (docs/scheduler.md):
+  - tenants register with priority/weight/SLO budget; duplicate names
+    and nonsensical QoS parameters fail typed at the registry seam;
+  - admission prices every dispatch (calibrated model or the honest
+    fallback — never free) and certifies it against the admitted set;
+    an uncertifiable pair queues in SERIAL-FALLBACK mode (accounted,
+    never silently dropped), saturation raises the typed backpressure
+    error;
+  - within a class dispatch order is start-time WFQ over predicted
+    cost; across classes priority is strict (a blocked higher class
+    does NOT yield — no priority inversion); preemption points are
+    program boundaries;
+  - concurrent dispatch happens ONLY under a clean group certificate
+    (a two-worker barrier proves genuine overlap; a conflicting pair
+    provably never overlaps; `uncertified_concurrent` stays 0);
+  - accountability: per-tenant metric series, SLO residuals against
+    model-derived deadlines, noisy-neighbor attribution naming the
+    co-running tenant whose cost overlapped the miss windows;
+  - the DecodeServer admission seam keeps bitwise parity with the
+    scheduler-less server while riding the same discipline.
+"""
+
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCL, ReduceFunction
+from accl_tpu.analysis.interference import (
+    InterferenceCertifier,
+    certificate_id,
+    footprint_from_rank_programs,
+)
+from accl_tpu.analysis.protocol import recv, send
+from accl_tpu.scheduler import (
+    DuplicateTenantError,
+    FairQueue,
+    MultiTenantScheduler,
+    QueueEntry,
+    SchedulerSaturatedError,
+    UnknownTenantError,
+)
+from accl_tpu.telemetry.metrics import MetricsRegistry
+
+
+def _ring(n_ranks, tag, count=4):
+    return [
+        [send((r + 1) % n_ranks, tag, count),
+         recv((r - 1) % n_ranks, tag, count)]
+        for r in range(n_ranks)
+    ]
+
+
+def _fake_accl():
+    """The minimum facade surface the scheduler touches: the shared
+    certifier slot and the (absent) device pricing seam."""
+    return types.SimpleNamespace(_interference=None, cclo=None)
+
+
+class _FakeProgram:
+    """A dispatchable handle: .run, .footprint/.signature, and a
+    _prepared carrying the certificate slot — everything the scheduler
+    reads off a real SequenceProgram."""
+
+    def __init__(self, fp=None, run_fn=None):
+        self.footprint = fp
+        self.signature = fp.signature if fp is not None else None
+        self._prepared = types.SimpleNamespace(
+            cert=None, desc=types.SimpleNamespace(steps=[]))
+        self._run_fn = run_fn
+
+    @property
+    def certificate(self):
+        return self._prepared.cert
+
+    def run(self, **kwargs):
+        if self._run_fn is not None:
+            self._run_fn(**kwargs)
+
+
+class _Clock:
+    """Deterministic time_fn: tests advance it inside run()."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# tenant registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_register_duplicate_unknown():
+    s = MultiTenantScheduler(_fake_accl())
+    t = s.register_tenant("alpha", priority=0, weight=4.0,
+                          slo_budget_s=0.5)
+    assert t.priority == 0 and t.weight == 4.0 and t.slo_budget_s == 0.5
+    assert "alpha" in s.tenants and len(s.tenants) == 1
+    with pytest.raises(DuplicateTenantError):
+        s.register_tenant("alpha")
+    with pytest.raises(UnknownTenantError) as ei:
+        s.tenants.get("ghost")
+    assert "ghost" in str(ei.value)
+    with pytest.raises(UnknownTenantError):
+        s.submit("ghost", _FakeProgram(), cost_s=1.0)
+
+
+@pytest.mark.parametrize("kw", [dict(priority=-1), dict(weight=0.0),
+                                dict(weight=-2.0),
+                                dict(slo_budget_s=0.0)])
+def test_registry_rejects_nonsense_qos(kw):
+    s = MultiTenantScheduler(_fake_accl())
+    with pytest.raises(ValueError):
+        s.register_tenant("t", **kw)
+
+
+def test_registry_rejects_non_string_names():
+    s = MultiTenantScheduler(_fake_accl())
+    for bad in ("", None, 7):
+        with pytest.raises(ValueError):
+            s.register_tenant(bad)
+
+
+# ---------------------------------------------------------------------------
+# WFQ + priority (deterministic: pinned costs, single worker)
+# ---------------------------------------------------------------------------
+
+
+def test_wfq_dispatch_tracks_weights_not_fifo():
+    """Same class, weight 4 vs 1, equal unit costs, the LIGHT tenant
+    submitted LAST: WFQ interleaves by finish tag (a,a,a,b,a,b,b,b) —
+    plain FIFO would drain b entirely first."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("a", priority=1, weight=4.0)
+    s.register_tenant("b", priority=1, weight=1.0)
+    order = []
+    pb = _FakeProgram(run_fn=lambda **kw: order.append("b"))
+    pa = _FakeProgram(run_fn=lambda **kw: order.append("a"))
+    s.submit("b", pb, repeats=4, cost_s=1.0)
+    s.submit("a", pa, repeats=4, cost_s=1.0)
+    assert s.drain() == 8
+    assert order == ["a", "a", "a", "b", "a", "b", "b", "b"]
+    acc = s.tenants.get("a").account()
+    assert acc["submitted"] == acc["dispatched"] == 4
+    assert acc["dispatched_cost_s"] == pytest.approx(4.0)
+
+
+def test_fair_queue_virtual_time_math():
+    """The SFQ tags directly: S = max(V, F_prev(tenant)),
+    F = S + cost/weight, V advances to the dispatched start tag."""
+    fq = FairQueue()
+    ta = types.SimpleNamespace(finish_tag=0.0, weight=2.0)
+    e1 = QueueEntry(tenant="a", priority=1, program=None, footprint=None,
+                    cost_s=1.0, seq=0)
+    fq.push(ta, e1)
+    assert (e1.start_tag, e1.finish_tag) == (0.0, 0.5)
+    e2 = QueueEntry(tenant="a", priority=1, program=None, footprint=None,
+                    cost_s=1.0, seq=1)
+    fq.push(ta, e2)
+    assert (e2.start_tag, e2.finish_tag) == (0.5, 1.0)
+    assert fq.pop_best(lambda e: True) is e1
+    assert fq.virtual_time == 0.0
+    assert fq.pop_best(lambda e: True) is e2
+    assert fq.virtual_time == 0.5
+    assert fq.pop_best(lambda e: True) is None and len(fq) == 0
+
+
+def test_strict_priority_and_boundary_preemption():
+    """Class 0 work submitted AFTER class 1 queued still wins the next
+    program boundary (selection re-runs per dispatch)."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("hi", priority=0)
+    s.register_tenant("lo", priority=1)
+    order = []
+    plo = _FakeProgram(run_fn=lambda **kw: order.append("lo"))
+    phi = _FakeProgram(run_fn=lambda **kw: order.append("hi"))
+    s.submit("lo", plo, repeats=2, cost_s=1.0)
+    assert s.step()  # boundary 1: only lo queued
+    s.submit("hi", phi, repeats=2, cost_s=1.0)
+    s.drain()
+    assert order == ["lo", "hi", "hi", "lo"]
+
+
+def test_blocked_higher_class_does_not_yield_the_link():
+    """Priority inversion guard: while the class-0 head conflicts with
+    the in-flight program, class 1 does NOT overtake it — step()
+    returns False until the conflict drains, then hi runs first."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("blk", priority=1)
+    s.register_tenant("hi", priority=0)
+    s.register_tenant("lo", priority=1)
+    r3 = footprint_from_rank_programs(_ring(4, 3), 4, label="R3")
+    r9 = footprint_from_rank_programs(_ring(4, 9), 4, label="R9")
+    gate = threading.Event()
+    order = []
+    blocker = _FakeProgram(r3, run_fn=lambda **kw: gate.wait(5))
+    th = threading.Thread(
+        target=lambda: s.dispatch_now("blk", blocker))
+    th.start()
+    deadline = time.monotonic() + 5
+    while s.stats["max_inflight"] < 1:  # blocker is in flight
+        assert time.monotonic() < deadline
+        time.sleep(0.001)
+    # hi shares the blocker's SIGNATURE (self-conflict by construction);
+    # lo is certified clean next to it — but must not overtake class 0
+    s.submit("hi", _FakeProgram(r3, run_fn=lambda **kw:
+                                order.append("hi")), cost_s=1.0)
+    s.submit("lo", _FakeProgram(r9, run_fn=lambda **kw:
+                                order.append("lo")), cost_s=1.0)
+    assert s.step() is False
+    assert order == []
+    gate.set()
+    th.join(5)
+    assert not th.is_alive()
+    assert s.step() and s.step()
+    assert order == ["hi", "lo"]
+
+
+# ---------------------------------------------------------------------------
+# admission: backpressure + pricing
+# ---------------------------------------------------------------------------
+
+
+def test_saturation_is_typed_backpressure():
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1.0)
+    s.register_tenant("t")
+    s.submit("t", _FakeProgram(), cost_s=0.6)
+    with pytest.raises(SchedulerSaturatedError) as ei:
+        s.submit("t", _FakeProgram(), cost_s=0.6)
+    err = ei.value
+    assert err.tenant == "t"
+    assert err.requested_s == pytest.approx(0.6)
+    assert err.queued_s == pytest.approx(0.6)
+    assert err.capacity_s == pytest.approx(1.0)
+    assert s.stats["rejected_saturated"] == 1
+    # admit_request (the serve seam) rides the same check, no mutation
+    with pytest.raises(SchedulerSaturatedError):
+        s.admit_request("t", cost_s=0.6)
+    assert s.queued_cost_s() == pytest.approx(0.6)
+    s.admit_request("t", cost_s=0.1)  # headroom passes silently
+
+
+def test_predict_cost_never_free_and_cached(mesh8):
+    accl = ACCL(mesh8)
+    sched = accl.scheduler(capacity_s=1e9)
+    a, b = (accl.create_buffer(4096, np.float32) for _ in range(2))
+    seq = accl.sequence()
+    seq.allreduce(a, b, 4096, ReduceFunction.SUM)
+    prog = seq.compile()
+    cost = sched.predict_cost_s(prog)
+    assert cost > 0
+    assert sched._cost_cache[prog.signature] == cost
+    assert sched.predict_cost_s(prog) == cost
+    # footprint-less fake with no steps: the fallback floor, never 0
+    assert MultiTenantScheduler(_fake_accl()).predict_cost_s(
+        _FakeProgram()) > 0
+
+
+def test_slo_deadline_model_derived_and_armed():
+    s = MultiTenantScheduler(_fake_accl())
+    t = s.register_tenant("t")
+    # unarmed reference 1.0: tol = max(1*3.0, 1+0.25) = 3.0
+    assert s.slo_deadline_s(t, 0.1) == pytest.approx(0.1 * 4.0 + 0.05)
+    s.arm_slo_reference(0.1)  # tol = max(0.3, 0.35) = 0.35
+    assert s.slo_deadline_s(t, 0.1) == pytest.approx(0.1 * 1.35 + 0.05)
+    b = s.register_tenant("budgeted", slo_budget_s=0.2)
+    assert s.slo_deadline_s(b, 123.0) == 0.2  # explicit wins
+
+
+# ---------------------------------------------------------------------------
+# the concurrency discipline
+# ---------------------------------------------------------------------------
+
+
+def test_two_workers_overlap_only_under_certificate():
+    """A certified-clean pair GENUINELY overlaps under drain(workers=2)
+    — both sides meet at a barrier that can only release if they are in
+    flight together — and the dispatch carries the group certificate."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("a")
+    s.register_tenant("b")
+    fa = footprint_from_rank_programs(_ring(4, 3), 4, label="A")
+    fb = footprint_from_rank_programs(_ring(4, 9), 4, label="B")
+    bar = threading.Barrier(2, timeout=10)
+    pa = _FakeProgram(fa, run_fn=lambda **kw: bar.wait())
+    pb = _FakeProgram(fb, run_fn=lambda **kw: bar.wait())
+    s.submit("a", pa, cost_s=1.0)
+    s.submit("b", pb, cost_s=1.0)
+    assert s.drain(workers=2) == 2
+    assert s.stats["serialized_admissions"] == 0
+    assert s.stats["concurrent_dispatches"] == 1
+    assert s.stats["certified_concurrent"] == 1
+    assert s.stats["uncertified_concurrent"] == 0
+    assert s.stats["max_inflight"] == 2
+    # the second admission was stamped with the PAIR certificate; the
+    # first went in flight alone (its singleton cert)
+    pair = certificate_id([fa, fb])
+    singles = {certificate_id([fa]), certificate_id([fb])}
+    assert {pa.certificate, pb.certificate} <= singles | {pair}
+    assert pair in {pa.certificate, pb.certificate}
+
+
+def test_uncertifiable_pair_serializes_never_drops():
+    """An ACCL602 pair under TWO workers: both dispatches still happen
+    (never silently rejected) but their wall-clock intervals provably
+    do not overlap, and the serial fallback is accounted."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("a")
+    s.register_tenant("b")
+    # the wildcard-steal pair: A's TAG_ANY recv is matchable by B's
+    # tag-9 send — the certifier escalates and rejects (ACCL602)
+    from accl_tpu.constants import TAG_ANY
+    fa = footprint_from_rank_programs(
+        [[recv(1, TAG_ANY, 4)], [send(0, 3, 4)]], 2, label="A")
+    fb = footprint_from_rank_programs(
+        [[recv(1, 9, 4)], [send(0, 9, 4)]], 2, label="B")
+    assert s._certifier.check_pair(fa, fb)  # the pair really conflicts
+    mu = threading.Lock()
+    intervals = {}
+
+    def mk(name):
+        def run(**kw):
+            t0 = time.perf_counter()
+            time.sleep(0.05)
+            with mu:
+                intervals[name] = (t0, time.perf_counter())
+        return run
+
+    s.submit("a", _FakeProgram(fa, run_fn=mk("a")), cost_s=1.0)
+    s.submit("b", _FakeProgram(fb, run_fn=mk("b")), cost_s=1.0)
+    assert s.stats["serialized_admissions"] == 1
+    assert s.tenants.get("b").serialized == 1
+    assert s.drain(workers=2) == 2
+    (a0, a1), (b0, b1) = intervals["a"], intervals["b"]
+    assert a1 <= b0 or b1 <= a0, "conflicting pair overlapped!"
+    assert s.stats["concurrent_dispatches"] == 0
+    assert s.stats["uncertified_concurrent"] == 0
+
+
+def test_footprintless_program_runs_exclusively():
+    """No footprint -> no proof -> never overlaps anything."""
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9)
+    s.register_tenant("a")
+    s.submit("a", _FakeProgram(), cost_s=1.0)
+    assert s.stats["serialized_admissions"] == 1
+    assert s.drain(workers=2) == 1
+    assert s.stats["concurrent_dispatches"] == 0
+
+
+def test_end_to_end_two_tenants_on_the_mesh(mesh8):
+    """Real compiled programs through the whole stack: two tenants'
+    disjoint allreduces drain under two workers, results stay
+    numerically exact, and nothing ran uncertified."""
+    accl = ACCL(mesh8)
+    sched = accl.scheduler(capacity_s=1e9)
+    assert sched._certifier is accl._interference  # shared cache
+    sched.register_tenant("a", priority=0, weight=2.0)
+    sched.register_tenant("b", priority=1)
+    world, n = accl.world, 256
+    a_in, a_out, b_in, b_out = (accl.create_buffer(n, np.float32)
+                                for _ in range(4))
+    sa = accl.sequence()
+    sa.allreduce(a_in, a_out, n, ReduceFunction.SUM)
+    pa = sa.compile()
+    sb = accl.sequence()
+    sb.allreduce(b_in, b_out, n, ReduceFunction.SUM)
+    pb = sb.compile()
+    xa = np.arange(world * n, dtype=np.float32).reshape(world, n)
+    xb = np.ones((world, n), np.float32)
+    a_in.write(xa)
+    b_in.write(xb)
+    sched.submit("a", pa, repeats=2)
+    sched.submit("b", pb, repeats=2)
+    assert sched.drain(workers=2) == 4
+    np.testing.assert_array_equal(
+        np.asarray(a_out.host)[0], xa.sum(axis=0))
+    np.testing.assert_array_equal(
+        np.asarray(b_out.host)[0], xb.sum(axis=0))
+    assert sched.stats["dispatches"] == 4
+    assert sched.stats["uncertified_concurrent"] == 0
+    assert pa.certificate is not None and pb.certificate is not None
+    rep = sched.report()
+    assert rep["stats"]["dispatches"] == 4
+    assert rep["namespaces"]["shared"] == []  # disjoint by construction
+
+
+# ---------------------------------------------------------------------------
+# accountability: metrics, SLO residuals, noisy neighbors
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_series_ride_the_registry():
+    reg = MetricsRegistry()
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9, registry=reg)
+    s.register_tenant("alpha")
+    s.submit("alpha", _FakeProgram(), repeats=3, cost_s=0.5)
+    s.drain()
+    snap = reg.snapshot()
+    disp = {tuple(sorted(r["labels"].items())): r["value"]
+            for r in snap["counters"]["accl_tenant_dispatches_total"]}
+    assert disp[(("tenant", "alpha"),)] == 3.0
+    (h,) = [r for r in snap["histograms"]["accl_tenant_dispatch_seconds"]
+            if r["labels"]["tenant"] == "alpha"]
+    assert h["count"] == 3
+    (res,) = snap["histograms"]["accl_tenant_slo_residual_seconds"]
+    assert res["count"] == 3
+    cost = {r["labels"]["tenant"]: r["value"]
+            for r in snap["counters"]["accl_tenant_cost_seconds_total"]}
+    assert cost["alpha"] == pytest.approx(1.5)
+
+
+def test_noisy_neighbor_attribution_names_the_bulk_tenant():
+    """A deterministic clock: bulk occupies [0, 5], then small misses
+    its 10ms budget at [5, 5.1] — the report blames bulk with full
+    share, and the SLO residual went negative exactly once."""
+    clock = _Clock()
+    reg = MetricsRegistry()
+    s = MultiTenantScheduler(_fake_accl(), capacity_s=1e9,
+                             registry=reg, time_fn=clock)
+    s.register_tenant("bulk", priority=1)
+    s.register_tenant("small", priority=0, slo_budget_s=0.01)
+    s.submit("bulk", _FakeProgram(
+        run_fn=lambda **kw: clock.advance(5.0)), cost_s=4.0)
+    assert s.step()
+    s.submit("small", _FakeProgram(
+        run_fn=lambda **kw: clock.advance(0.1)), cost_s=0.001)
+    assert s.step()
+    assert s.tenants.get("small").slo_misses == 1
+    assert s.tenants.get("bulk").slo_misses == 0
+    (row,) = s.noisy_neighbor_report()
+    assert row["tenant"] == "small" and row["slo_misses"] == 1
+    assert row["noisy_neighbor"] == "bulk"
+    assert row["neighbor_share"] == pytest.approx(1.0)
+    assert row["neighbor_cost_s"]["bulk"] == pytest.approx(4.0)
+    (miss,) = reg.snapshot()["counters"]["accl_tenant_slo_miss_total"]
+    assert miss["labels"]["tenant"] == "small" and miss["value"] == 1.0
+    assert s.report()["noisy_neighbors"] == [row]
+
+
+def test_namespace_ledger_flags_cross_tenant_sharing(mesh8):
+    accl = ACCL(mesh8)
+    sched = accl.scheduler(capacity_s=1e9)
+    sched.register_tenant("a")
+    sched.register_tenant("b")
+    n = 64
+    a_in, b_in, shared = (accl.create_buffer(n, np.float32)
+                          for _ in range(3))
+    sa = accl.sequence()
+    sa.allreduce(a_in, shared, n, ReduceFunction.SUM)
+    pa = sa.compile()
+    sb = accl.sequence()
+    sb.allreduce(b_in, shared, n, ReduceFunction.SUM)
+    pb = sb.compile()
+    sched.submit("a", pa)
+    sched.submit("b", pb)  # conflicting: serial fallback, and the
+    assert sched.stats["serialized_admissions"] == 1
+    sched.drain(workers=2)
+    ledger = sched.tenants.disjointness_report()
+    assert any(row["tenants"] == ["a", "b"] and row["resource"] == "addrs"
+               for row in ledger["shared"])
+    assert sched.stats["uncertified_concurrent"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the DecodeServer admission seam (satellite: serve routes through it)
+# ---------------------------------------------------------------------------
+
+
+def _serve_setup():
+    import jax
+    from jax.sharding import Mesh
+
+    from accl_tpu.models import serve
+    from accl_tpu.models import transformer as trf
+
+    cfg = trf.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_kv_heads=2, n_layers=2, d_ff=64)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("ccl",))
+    params = jax.tree.map(np.asarray,
+                          trf.init_params(cfg, jax.random.key(0)))
+    return serve, trf, cfg, mesh, params
+
+
+def test_decode_server_scheduler_seam_keeps_bitwise_parity():
+    import jax
+    from jax.sharding import Mesh
+
+    serve, trf, cfg, mesh, params = _serve_setup()
+    rng = np.random.default_rng(5)
+    prompts = [list(map(int, rng.integers(1, cfg.vocab,
+                                          int(rng.integers(1, 5)))))
+               for _ in range(5)]
+    plain = serve.DecodeServer(ACCL(mesh), cfg, params, batch=3,
+                               max_len=12)
+    out_plain = serve.generate(plain, prompts, 4)
+    accl = ACCL(Mesh(np.array(jax.devices()[:2]), ("ccl",)))
+    sched = accl.scheduler(capacity_s=1e9)
+    srv = serve.DecodeServer(accl, cfg, params, batch=3, max_len=12,
+                             scheduler=sched)
+    assert serve.generate(srv, prompts, 4) == out_plain
+    # the serve tenant registered at the interactive class and every
+    # fused step went through the metered dispatch path
+    t = sched.tenants.get("serve")
+    assert t.priority == 0
+    assert t.dispatched == srv.n_steps > 0
+    assert sched.stats["uncertified_concurrent"] == 0
+
+
+def test_decode_server_saturation_rejects_before_queueing():
+    serve, trf, cfg, mesh, params = _serve_setup()
+    accl = ACCL(mesh)
+    sched = accl.scheduler(capacity_s=1e-12)
+    srv = serve.DecodeServer(accl, cfg, params, batch=3, max_len=12,
+                             scheduler=sched)
+    with pytest.raises(SchedulerSaturatedError):
+        srv.submit([1, 2, 3], 4)
+    assert not srv.active  # nothing queued
+    assert sched.stats["rejected_saturated"] == 1

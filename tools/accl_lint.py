@@ -859,9 +859,26 @@ def run_interference() -> bool:
             print(f"  {fa.label:8s} x {fb.label:8s} "
                   + ("clean" if not codes else str(codes)))
 
+    # -- 4. the multi-tenant corpus rows: every kind=="concurrent"
+    #       fixture replays through run_fixture_file, so the sweep and
+    #       the corpus can never disagree about a tenant mix -----------
+    n_corpus = 0
+    for path in sorted(DEFAULT_CORPUS.glob("*.json")):
+        try:
+            if json.loads(path.read_text()).get("kind") != "concurrent":
+                continue
+            fok, line = run_fixture_file(path)
+        except Exception as e:  # a crashing fixture is a failing one
+            fok, line = False, (f"{path.name:40s} ERROR "
+                                f"{type(e).__name__}: {e}")
+        n_corpus += 1
+        ok &= fok
+        print(("  ok  " if fok else " FAIL ") + line)
+
     dt = _time.monotonic() - t0
     print(f"interference: {n_pairs} pairs certified across the family "
-          f"sweep, adversarial rows and recorded model programs "
+          f"sweep, adversarial rows and recorded model programs, "
+          f"{n_corpus} concurrent corpus fixtures replayed "
           + ("clean" if ok else "WITH DEFECTS") + f" in {dt:.1f}s")
     return ok
 

@@ -287,6 +287,76 @@ def section_serving(out: list[str]) -> None:
                "prediction. See docs/serving.md.\n")
 
 
+def section_tenant(out: list[str]) -> None:
+    """The multi-tenant scheduler soak (`bench.py --tenant-gate`
+    verdict): small-tenant tail under a saturating bulk tenant, the
+    certification counters, WFQ share, and noisy-neighbor blame.
+    CPU-emulator numbers — the scheduler's own seams, not hardware."""
+    p = LOG / "tenant_gate.json"
+    out.append("## Multi-tenant scheduler — certified concurrent soak "
+               "(`tenant_gate.json`)\n")
+    if not p.exists():
+        out.append("*absent — no tenant-gate run committed*\n")
+        return
+    try:
+        d = json.loads(p.read_text())
+    except ValueError:
+        out.append("*unreadable*\n")
+        return
+    stats = d.get("stats", {})
+    worst = d.get("worst", {})
+    band = d.get("band", {})
+    bulk = d.get("bulk", {})
+    wfq = d.get("wfq", {})
+    fails = d.get("fails", [])
+    out.append(
+        f"**Headline:** worst small-tenant p99 "
+        f"{worst.get('p99_ms', '?')} ms = **{d.get('value', '?')}x** "
+        f"its solo baseline ({d.get('small_p99_solo_ms', '?')} ms) "
+        f"while the bulk tenant moved "
+        f"{_fmt_bytes(int(bulk.get('wire_bytes', 0) or 0))} of "
+        f"ring-wire traffic — band {worst.get('band_ms', '?')} ms "
+        f"(solo x {band.get('p99_band', '?')} + "
+        f"{band.get('hol_chunks', '?')} head-of-line chunks at "
+        f"{band.get('bulk_chunk_p50_ms', '?')} ms). Platform: "
+        f"{d.get('platform', '?')} — functional regime, not a "
+        "hardware claim.\n")
+    out.append("| Lane | Result |\n|---|---|")
+    out.append(f"| dispatches (soak {d.get('soak_s', '?')} s) | "
+               f"{stats.get('dispatches', '?')} total, "
+               f"{stats.get('concurrent_dispatches', '?')} concurrent,"
+               f" max {stats.get('max_inflight', '?')} in flight |")
+    out.append(f"| certification | "
+               f"{stats.get('certified_concurrent', '?')} certified / "
+               f"{stats.get('uncertified_concurrent', '?')} "
+               f"uncertified concurrent; "
+               f"{stats.get('serialized_admissions', '?')} "
+               f"serial-fallback admissions |")
+    out.append(f"| bulk tenant | {bulk.get('chunks', '?')} chunks x "
+               f"{_fmt_bytes(int(bulk.get('chunk_elems', 0) or 0) * 4)}"
+               f" payload = "
+               f"{_fmt_bytes(int(bulk.get('wire_bytes', 0) or 0))} "
+               f"wire (budget "
+               f"{_fmt_bytes(int(bulk.get('wire_budget', 0) or 0))}) |")
+    out.append(f"| WFQ 4:1 first-10 share | "
+               f"{wfq.get('first10_heavy_share', '?')} "
+               f"(want {wfq.get('want', '?')} +- "
+               f"{wfq.get('tol', '?')}) |")
+    noisy = d.get("noisy_neighbors") or []
+    blamed = [f"{r.get('tenant')}<-{r.get('noisy_neighbor')}"
+              for r in noisy if r.get("noisy_neighbor")]
+    out.append(f"| SLO misses / noisy neighbors | "
+               f"{sum((d.get('slo_misses') or {}).values())} misses; "
+               f"{', '.join(blamed) if blamed else 'none blamed'} |")
+    out.append(f"| gate verdict | "
+               f"{'FAIL: ' + '; '.join(fails) if fails else 'pass'} |")
+    out.append("")
+    out.append("Every concurrent admission carries a group certificate "
+               "id; an uncertifiable pair queues in serial-fallback "
+               "mode (counted above), never silently dropped. See "
+               "docs/scheduler.md.\n")
+
+
 def section_rt_stats(out: list[str]) -> None:
     """Sequencer counter evidence (tools/rt_stats_sweep.py) and what it
     established about the emulator's cost structure."""
@@ -407,6 +477,7 @@ def main() -> int:
     section_tpu(out)
     section_flagship(out)
     section_serving(out)
+    section_tenant(out)
     section_emulator(out)
     section_rt_stats(out)
     section_timing(out)
